@@ -1,0 +1,17 @@
+"""lzy-tpu: a TPU-native platform for hybrid execution of ML workflows.
+
+A brand-new framework with the capabilities of lambdazy/lzy (reference surveyed in
+SURVEY.md), designed TPU-first: ``@op`` functions compose into lazy dataflow graphs,
+the platform provisions TPU slices on demand, gang-schedules multi-host SPMD ops
+(JAX/XLA/pjit), moves typed data between ops via channels that keep ``jax.Array``
+shards device-resident over ICI, and versions results as queryable whiteboards.
+
+Public API mirrors the reference's ``pylzy/lzy/api/v1/__init__.py:1-40`` exports,
+re-designed for TPU pools instead of GPU VM pools.
+"""
+
+__version__ = "0.1.0"
+
+from lzy_tpu.types import File, TpuPoolSpec, VmSpec, DataScheme
+
+__all__ = ["File", "TpuPoolSpec", "VmSpec", "DataScheme"]

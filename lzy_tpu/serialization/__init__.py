@@ -1,0 +1,7 @@
+from lzy_tpu.serialization.registry import (
+    Serializer,
+    SerializerRegistry,
+    default_registry,
+)
+
+__all__ = ["Serializer", "SerializerRegistry", "default_registry"]

@@ -1,0 +1,60 @@
+"""Primitive (JSON) and cloudpickle serializers.
+
+Counterparts of serialzy's primitive and cloudpickle serializers used by the
+reference registry (``pylzy/lzy/serialization/registry.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, BinaryIO, Optional, Type
+
+import cloudpickle
+
+from lzy_tpu.serialization.registry import Serializer
+from lzy_tpu.types import DataScheme
+
+_PRIMITIVES = (int, float, str, bool, type(None))
+
+
+class PrimitiveSerializer(Serializer):
+    def format_name(self) -> str:
+        return "primitive"
+
+    def supports_type(self, typ: Type) -> bool:
+        return typ in _PRIMITIVES
+
+    def serialize(self, obj: Any, dest: BinaryIO) -> None:
+        dest.write(json.dumps(obj).encode("utf-8"))
+
+    def deserialize(self, src: BinaryIO, typ: Optional[Type] = None) -> Any:
+        return json.loads(src.read().decode("utf-8"))
+
+
+class CloudpickleSerializer(Serializer):
+    """Universal fallback; format is pinned to the producing python version, like
+    serialzy's cloudpickle serializer (unstable scheme)."""
+
+    def format_name(self) -> str:
+        return "cloudpickle"
+
+    def supports_type(self, typ: Type) -> bool:
+        return True
+
+    def serialize(self, obj: Any, dest: BinaryIO) -> None:
+        cloudpickle.dump(obj, dest)
+
+    def deserialize(self, src: BinaryIO, typ: Optional[Type] = None) -> Any:
+        import pickle
+
+        return pickle.load(src)
+
+    def data_scheme(self, obj: Any) -> DataScheme:
+        scheme = super().data_scheme(obj)
+        scheme.meta["python"] = "%d.%d" % sys.version_info[:2]
+        scheme.meta["cloudpickle"] = cloudpickle.__version__
+        return scheme
+
+    def stable(self) -> bool:
+        return False

@@ -1,0 +1,198 @@
+"""TPU-first array serialization.
+
+The reference moves every value through serialzy/cloudpickle
+(``pylzy/lzy/serialization/``). On TPU that is the wrong default for tensors: a
+``jax.Array`` pickled via numpy loses dtype fidelity guarantees (bfloat16), does a
+host round-trip eagerly, and can't be streamed chunk-wise. This module defines a
+stable raw binary format for arrays and array pytrees (model params / optimizer
+states):
+
+    magic 'LZYA'|'LZYP', u32 header-len, JSON header, [pickled treedef], raw leaf bytes
+
+Raw bytes are C-order; bfloat16 and other ml_dtypes survive exactly (stored by
+dtype name, reconstructed via jax.numpy's dtype resolution). The channels layer
+(``lzy_tpu/channels``) short-circuits this entirely for same-slice transfers and
+keeps shards in HBM; this format is the durable spill path (S3/DCN/disk).
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import struct
+from typing import Any, BinaryIO, List, Optional, Tuple, Type
+
+import cloudpickle
+import numpy as np
+
+from lzy_tpu.serialization.registry import Serializer
+from lzy_tpu.types import DataScheme
+
+_MAGIC_ARRAY = b"LZYA"
+_MAGIC_PYTREE = b"LZYP"
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _to_host(arr: Any) -> np.ndarray:
+    import jax
+
+    if isinstance(arr, jax.Array):
+        arr = jax.device_get(arr)
+    return np.ascontiguousarray(np.asarray(arr))
+
+
+def _is_array(obj: Any) -> bool:
+    import jax
+
+    return isinstance(obj, (np.ndarray, np.generic, jax.Array))
+
+
+def _write_header(dest: BinaryIO, magic: bytes, header: dict) -> None:
+    hb = json.dumps(header).encode("utf-8")
+    dest.write(magic)
+    dest.write(struct.pack("<I", len(hb)))
+    dest.write(hb)
+
+
+def _raw_view(host: np.ndarray) -> memoryview:
+    """Zero-copy byte view of a contiguous host array (avoids tobytes() doubling
+    peak RAM for checkpoint-sized values). ml_dtypes (bfloat16, fp8) don't speak
+    the buffer protocol, so reinterpret as uint8 first — a view, not a copy."""
+    return memoryview(np.atleast_1d(host).view(np.uint8))
+
+
+def _read_header(src: BinaryIO, magic: bytes) -> dict:
+    got = src.read(4)
+    if got != magic:
+        raise ValueError(f"bad magic {got!r}, expected {magic!r}")
+    (hlen,) = struct.unpack("<I", src.read(4))
+    return json.loads(src.read(hlen).decode("utf-8"))
+
+
+class JaxArraySerializer(Serializer):
+    """Single ``jax.Array`` / ``np.ndarray`` / numpy scalar."""
+
+    def format_name(self) -> str:
+        return "jax_array"
+
+    def supports_type(self, typ: Type) -> bool:
+        import jax
+
+        return isinstance(typ, type) and issubclass(typ, (np.ndarray, np.generic, jax.Array))
+
+    def supports_instance(self, obj: Any) -> bool:
+        return _is_array(obj)
+
+    def serialize(self, obj: Any, dest: BinaryIO) -> None:
+        host = _to_host(obj)
+        header = {
+            "dtype": host.dtype.name,
+            "shape": list(host.shape),
+            "kind": "jax" if not isinstance(obj, (np.ndarray, np.generic)) else "numpy",
+        }
+        _write_header(dest, _MAGIC_ARRAY, header)
+        dest.write(_raw_view(host))
+
+    def deserialize(self, src: BinaryIO, typ: Optional[Type] = None) -> Any:
+        header = _read_header(src, _MAGIC_ARRAY)
+        dtype = _resolve_dtype(header["dtype"])
+        shape = tuple(header["shape"])
+        n = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        arr = np.frombuffer(src.read(n), dtype=dtype).reshape(shape)
+        if header.get("kind") == "jax":
+            import jax.numpy as jnp
+
+            return jnp.asarray(arr)
+        return arr.copy()
+
+    def data_scheme(self, obj: Any) -> DataScheme:
+        host_dtype = obj.dtype
+        return DataScheme(
+            data_format=self.format_name(),
+            schema_content=f"array[{host_dtype}]{tuple(obj.shape)}",
+        )
+
+
+class ArrayPytreeSerializer(Serializer):
+    """Pytrees (dict/list/tuple/namedtuple/flax state) whose leaves are all arrays
+    or python scalars — the shape of model params and optimizer states."""
+
+    def format_name(self) -> str:
+        return "jax_pytree"
+
+    def supports_type(self, typ: Type) -> bool:
+        return False  # instance- or format-driven only
+
+    def supports_instance(self, obj: Any) -> bool:
+        import jax
+
+        if not isinstance(obj, (dict, list, tuple)) or isinstance(obj, (str, bytes)):
+            return False
+        leaves = jax.tree_util.tree_leaves(obj)
+        return len(leaves) > 0 and all(
+            _is_array(x) or isinstance(x, (int, float, bool)) for x in leaves
+        )
+
+    def serialize(self, obj: Any, dest: BinaryIO) -> None:
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(obj)
+        treedef_b = cloudpickle.dumps(treedef)
+        # one host copy per array leaf (unavoidable device→host transfer); raw
+        # bytes are then written as zero-copy views, never a second full copy
+        hosts: List[Optional[np.ndarray]] = []
+        metas = []
+        for leaf in leaves:
+            if _is_array(leaf):
+                host = _to_host(leaf)
+                hosts.append(host)
+                metas.append({
+                    "dtype": host.dtype.name,
+                    "shape": list(host.shape),
+                    "kind": "numpy" if isinstance(leaf, (np.ndarray, np.generic)) else "jax",
+                })
+            else:
+                hosts.append(None)
+                metas.append({"scalar": leaf})
+        header = {"leaves": metas, "treedef_len": len(treedef_b)}
+        _write_header(dest, _MAGIC_PYTREE, header)
+        dest.write(treedef_b)
+        for host in hosts:
+            if host is not None:
+                dest.write(_raw_view(host))
+
+    def deserialize(self, src: BinaryIO, typ: Optional[Type] = None) -> Any:
+        import jax
+        import jax.numpy as jnp
+
+        header = _read_header(src, _MAGIC_PYTREE)
+        treedef = pickle.loads(src.read(header["treedef_len"]))
+        leaves = []
+        for meta in header["leaves"]:
+            if "scalar" in meta:
+                leaves.append(meta["scalar"])
+                continue
+            dtype = _resolve_dtype(meta["dtype"])
+            shape = tuple(meta["shape"])
+            n = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+            arr = np.frombuffer(src.read(n), dtype=dtype).reshape(shape)
+            # restore the producer's leaf kind: numpy stays numpy (mutable,
+            # host-resident), jax goes back through the device path
+            leaves.append(arr.copy() if meta.get("kind") == "numpy" else jnp.asarray(arr))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def data_scheme(self, obj: Any) -> DataScheme:
+        import jax
+
+        n = len(jax.tree_util.tree_leaves(obj))
+        return DataScheme(
+            data_format=self.format_name(), schema_content=f"pytree[{n} leaves]"
+        )

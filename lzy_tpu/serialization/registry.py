@@ -1,0 +1,102 @@
+"""Serializer registry.
+
+The analog of the reference's ``LzySerializerRegistry``
+(``pylzy/lzy/serialization/registry.py:21-82``), which delegates to the external
+``serialzy`` package. We implement the registry natively: serializers are looked up
+by instance/type (first match in priority order) or by stored data format, and users
+can register their own with a priority. TPU-first difference: ``jax.Array`` and
+array pytrees get a dedicated zero-copy-friendly binary format
+(``lzy_tpu/serialization/jax_ser.py``) instead of always round-tripping through
+pickle.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, BinaryIO, Dict, List, Optional, Type
+
+from lzy_tpu.types import DataScheme
+
+
+class Serializer(abc.ABC):
+    """One serialization format."""
+
+    @abc.abstractmethod
+    def format_name(self) -> str: ...
+
+    @abc.abstractmethod
+    def supports_type(self, typ: Type) -> bool: ...
+
+    def supports_instance(self, obj: Any) -> bool:
+        return self.supports_type(type(obj))
+
+    @abc.abstractmethod
+    def serialize(self, obj: Any, dest: BinaryIO) -> None: ...
+
+    @abc.abstractmethod
+    def deserialize(self, src: BinaryIO, typ: Optional[Type] = None) -> Any: ...
+
+    def data_scheme(self, obj: Any) -> DataScheme:
+        t = type(obj)
+        return DataScheme(
+            data_format=self.format_name(),
+            schema_content=f"{t.__module__}.{t.__qualname__}",
+        )
+
+    def stable(self) -> bool:
+        """Stable formats are readable from any environment (primitives, raw
+        arrays, files); unstable ones (pickle) pin the python env."""
+        return True
+
+
+class SerializerRegistry:
+    def __init__(self) -> None:
+        self._serializers: List[Serializer] = []
+        self._by_format: Dict[str, Serializer] = {}
+
+    def register(self, serializer: Serializer, priority: Optional[int] = None) -> None:
+        if serializer.format_name() in self._by_format:
+            raise ValueError(f"serializer {serializer.format_name()!r} already registered")
+        if priority is None:
+            self._serializers.append(serializer)
+        else:
+            self._serializers.insert(priority, serializer)
+        self._by_format[serializer.format_name()] = serializer
+
+    def unregister(self, format_name: str) -> None:
+        ser = self._by_format.pop(format_name, None)
+        if ser is not None:
+            self._serializers.remove(ser)
+
+    def find_by_instance(self, obj: Any) -> Serializer:
+        for s in self._serializers:
+            if s.supports_instance(obj):
+                return s
+        raise TypeError(f"no serializer for instance of {type(obj)!r}")
+
+    def find_by_type(self, typ: Type) -> Serializer:
+        for s in self._serializers:
+            if s.supports_type(typ):
+                return s
+        raise TypeError(f"no serializer for type {typ!r}")
+
+    def find_by_format(self, format_name: str) -> Serializer:
+        try:
+            return self._by_format[format_name]
+        except KeyError:
+            raise TypeError(f"no serializer registered for format {format_name!r}")
+
+
+def default_registry() -> SerializerRegistry:
+    # imports here to keep registry importable without jax for pure-SDK uses
+    from lzy_tpu.serialization.basic import PrimitiveSerializer, CloudpickleSerializer
+    from lzy_tpu.serialization.file_ser import FileSerializer
+    from lzy_tpu.serialization.jax_ser import JaxArraySerializer, ArrayPytreeSerializer
+
+    reg = SerializerRegistry()
+    reg.register(PrimitiveSerializer())
+    reg.register(FileSerializer())
+    reg.register(JaxArraySerializer())
+    reg.register(ArrayPytreeSerializer())
+    reg.register(CloudpickleSerializer())  # universal fallback, lowest priority
+    return reg

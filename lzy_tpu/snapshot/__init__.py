@@ -1,0 +1,3 @@
+from lzy_tpu.snapshot.snapshot import Snapshot, SnapshotEntry
+
+__all__ = ["Snapshot", "SnapshotEntry"]

@@ -1,0 +1,136 @@
+"""Workflow snapshot: the client-side registry of every data entry a workflow
+touches (op args, results, exceptions, whiteboard fields).
+
+Counterpart of ``Snapshot``/``DefaultSnapshot``/``SnapshotEntry``
+(``pylzy/lzy/api/v1/snapshot.py:25-191``). Each entry carries an id, a
+human-readable name, the python type, the resolved data scheme, a storage URI
+under the workflow prefix, and a content hash used for cache keys. ``put``/``get``
+stream through the serializer registry; ``copy`` is a storage-level byte copy used
+when whiteboard fields alias op results (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import tempfile
+import threading
+from typing import Any, Dict, Optional, Type
+
+from lzy_tpu.serialization.registry import SerializerRegistry
+from lzy_tpu.storage.api import StorageClient, join_uri
+from lzy_tpu.types import DataScheme
+from lzy_tpu.utils import hashing
+from lzy_tpu.utils.ids import gen_id
+
+
+@dataclasses.dataclass
+class SnapshotEntry:
+    id: str
+    name: str
+    typ: Optional[Type]
+    storage_uri: str
+    data_scheme: Optional[DataScheme] = None
+    hash: Optional[str] = None            # content hash once materialized
+
+    @property
+    def materialized(self) -> bool:
+        return self.hash is not None
+
+
+class Snapshot:
+    def __init__(
+        self,
+        *,
+        workflow_name: str,
+        execution_id: str,
+        storage_client: StorageClient,
+        storage_prefix: str,
+        serializers: SerializerRegistry,
+    ):
+        self._wf_name = workflow_name
+        self._execution_id = execution_id
+        self._client = storage_client
+        self._prefix = join_uri(storage_prefix, "lzy_runs", workflow_name, execution_id)
+        self._serializers = serializers
+        self._entries: Dict[str, SnapshotEntry] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def execution_id(self) -> str:
+        return self._execution_id
+
+    @property
+    def storage_prefix(self) -> str:
+        return self._prefix
+
+    @property
+    def storage_client(self) -> StorageClient:
+        return self._client
+
+    @property
+    def serializers(self) -> SerializerRegistry:
+        return self._serializers
+
+    def create_entry(self, name: str, typ: Optional[Type] = None,
+                     uri: Optional[str] = None) -> SnapshotEntry:
+        eid = gen_id("entry")
+        entry = SnapshotEntry(
+            id=eid,
+            name=name,
+            typ=typ,
+            storage_uri=uri or join_uri(self._prefix, "data", eid),
+        )
+        with self._lock:
+            self._entries[eid] = entry
+        return entry
+
+    def get_entry(self, entry_id: str) -> SnapshotEntry:
+        with self._lock:
+            return self._entries[entry_id]
+
+    def update_entry_uri(self, entry_id: str, uri: str) -> None:
+        """Re-point an entry (e.g. at a cache hit's existing object)."""
+        with self._lock:
+            self._entries[entry_id].storage_uri = uri
+
+    def put(self, entry_id: str, value: Any) -> SnapshotEntry:
+        """Serialize into a spooled temp stream (spills to disk past 64 MB),
+        then stream to storage while hashing — a checkpoint-sized value never
+        holds more than one serialized copy in RAM."""
+        entry = self.get_entry(entry_id)
+        serializer = self._serializers.find_by_instance(value)
+        with tempfile.SpooledTemporaryFile(max_size=64 << 20) as tmp:
+            serializer.serialize(value, tmp)
+            tmp.seek(0)
+            reader = hashing.HashingReader(tmp)
+            self._client.write(entry.storage_uri, reader)
+            entry.hash = reader.hexdigest()
+        entry.data_scheme = serializer.data_scheme(value)
+        return entry
+
+    def get(self, entry_id: str) -> Any:
+        entry = self.get_entry(entry_id)
+        serializer = self._resolve_serializer(entry)
+        with contextlib.closing(self._client.open_read(entry.storage_uri)) as src:
+            return serializer.deserialize(src, entry.typ)
+
+    def copy_from_uri(self, entry_id: str, src_uri: str,
+                      scheme: Optional[DataScheme] = None) -> SnapshotEntry:
+        """Stream-copy an existing object into this entry (whiteboard aliasing,
+        cache hits)."""
+        entry = self.get_entry(entry_id)
+        with contextlib.closing(self._client.open_read(src_uri)) as src:
+            reader = hashing.HashingReader(src)
+            self._client.write(entry.storage_uri, reader)
+            entry.hash = reader.hexdigest()
+        if scheme is not None:
+            entry.data_scheme = scheme
+        return entry
+
+    def _resolve_serializer(self, entry: SnapshotEntry):
+        if entry.data_scheme is not None:
+            return self._serializers.find_by_format(entry.data_scheme.data_format)
+        if entry.typ is not None:
+            return self._serializers.find_by_type(entry.typ)
+        raise TypeError(f"entry {entry.id} has neither data scheme nor type")

@@ -1,0 +1,13 @@
+from lzy_tpu.storage.api import StorageClient, StorageConfig
+from lzy_tpu.storage.fs import FsStorageClient
+from lzy_tpu.storage.mem import MemStorageClient
+from lzy_tpu.storage.registry import StorageRegistry, DefaultStorageRegistry
+
+__all__ = [
+    "StorageClient",
+    "StorageConfig",
+    "FsStorageClient",
+    "MemStorageClient",
+    "StorageRegistry",
+    "DefaultStorageRegistry",
+]

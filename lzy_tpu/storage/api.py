@@ -1,0 +1,84 @@
+"""Storage client interface.
+
+The analog of the reference's ``AsyncStorageClient``
+(``pylzy/lzy/storage/api.py:58-96``) and credential dataclasses (``api.py:8-56``).
+Differences: the interface is synchronous (callers parallelize with threads; JAX
+host code is thread-friendly and this removes the reference's background-event-loop
+bridge ``pylzy/lzy/utils/event_loop.py``), and it is chunk-streaming first — ``read``
+and ``write`` move file-like objects so large checkpoints never materialize in RAM.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import BinaryIO, Iterator, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class StorageConfig:
+    """Named storage destination: where a workflow's entries live."""
+
+    uri: str                       # prefix, e.g. "file:///tmp/lzy" or "mem://bucket"
+    endpoint: Optional[str] = None
+    access_key: Optional[str] = None
+    secret_key: Optional[str] = None
+
+
+class StorageClient(abc.ABC):
+    scheme: str = ""
+
+    @abc.abstractmethod
+    def write(self, uri: str, src: BinaryIO) -> int:
+        """Store all bytes from ``src`` at ``uri``; returns byte count."""
+
+    @abc.abstractmethod
+    def read(self, uri: str, dest: BinaryIO) -> int:
+        """Read the object at ``uri`` into ``dest``; returns byte count."""
+
+    @abc.abstractmethod
+    def read_range(self, uri: str, offset: int, length: int = -1) -> bytes:
+        """Ranged read for offset-resumable transfers (SURVEY.md §3.4)."""
+
+    @abc.abstractmethod
+    def exists(self, uri: str) -> bool: ...
+
+    @abc.abstractmethod
+    def size(self, uri: str) -> int: ...
+
+    @abc.abstractmethod
+    def delete(self, uri: str) -> None: ...
+
+    @abc.abstractmethod
+    def list(self, prefix: str) -> Iterator[str]: ...
+
+    def sign_uri(self, uri: str) -> str:
+        """Presigned/shareable URL; default is the URI itself (fs/mem)."""
+        return uri
+
+    def open_read(self, uri: str) -> BinaryIO:
+        """Readable stream over the object. Default buffers in RAM; backends
+        with native streams (fs) override so large checkpoints never fully
+        materialize."""
+        import io
+
+        buf = io.BytesIO()
+        self.read(uri, buf)
+        buf.seek(0)
+        return buf
+
+    def write_bytes(self, uri: str, data: bytes) -> int:
+        import io
+
+        return self.write(uri, io.BytesIO(data))
+
+    def read_bytes(self, uri: str) -> bytes:
+        import io
+
+        buf = io.BytesIO()
+        self.read(uri, buf)
+        return buf.getvalue()
+
+
+def join_uri(prefix: str, *parts: str) -> str:
+    return "/".join([prefix.rstrip("/"), *[p.strip("/") for p in parts]])

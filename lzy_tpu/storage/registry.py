@@ -1,0 +1,95 @@
+"""Storage registry: named storage configs + client construction.
+
+Counterpart of ``DefaultStorageRegistry`` (``pylzy/lzy/storage/registry.py:8-60``).
+A workflow resolves its storage by name ("default" unless overridden); clients are
+constructed from the URI scheme. S3 (``s3://``) is gated: the boto stack is not a
+baked-in dependency, so it resolves lazily and raises a clear error if unavailable.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from typing import Dict, Optional, Tuple
+
+from lzy_tpu.storage.api import StorageClient, StorageConfig
+from lzy_tpu.storage.fs import FsStorageClient
+from lzy_tpu.storage.mem import MemStorageClient
+
+DEFAULT_NAME = "default"
+
+
+def client_for(config: StorageConfig) -> StorageClient:
+    scheme = config.uri.split("://", 1)[0]
+    if scheme == "file":
+        return FsStorageClient()
+    if scheme == "mem":
+        return MemStorageClient()
+    if scheme == "s3":
+        from lzy_tpu.storage.s3 import S3StorageClient
+
+        return S3StorageClient(config)
+    raise ValueError(f"unsupported storage scheme {scheme!r} in {config.uri!r}")
+
+
+class StorageRegistry(abc.ABC):
+    @abc.abstractmethod
+    def register_storage(self, name: str, config: StorageConfig, default: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    def unregister_storage(self, name: str) -> None: ...
+
+    @abc.abstractmethod
+    def config(self, name: str = DEFAULT_NAME) -> Optional[StorageConfig]: ...
+
+    @abc.abstractmethod
+    def client(self, name: str = DEFAULT_NAME) -> Optional[StorageClient]: ...
+
+    @abc.abstractmethod
+    def default_config(self) -> Optional[StorageConfig]: ...
+
+    @abc.abstractmethod
+    def default_client(self) -> Optional[StorageClient]: ...
+
+
+class DefaultStorageRegistry(StorageRegistry):
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._items: Dict[str, Tuple[StorageConfig, StorageClient]] = {}
+        self._default: Optional[str] = None
+
+    def register_storage(self, name: str, config: StorageConfig, default: bool = False) -> None:
+        with self._lock:
+            self._items[name] = (config, client_for(config))
+            if default or self._default is None:
+                self._default = name
+
+    def unregister_storage(self, name: str) -> None:
+        with self._lock:
+            self._items.pop(name, None)
+            if self._default == name:
+                self._default = next(iter(self._items), None)
+
+    def config(self, name: str = DEFAULT_NAME) -> Optional[StorageConfig]:
+        with self._lock:
+            item = self._items.get(name)
+        return item[0] if item else None
+
+    def client(self, name: str = DEFAULT_NAME) -> Optional[StorageClient]:
+        with self._lock:
+            item = self._items.get(name)
+        return item[1] if item else None
+
+    def default_config(self) -> Optional[StorageConfig]:
+        with self._lock:
+            name = self._default
+        return self.config(name) if name else None
+
+    def default_client(self) -> Optional[StorageClient]:
+        with self._lock:
+            name = self._default
+        return self.client(name) if name else None
+
+    def default_name(self) -> Optional[str]:
+        with self._lock:
+            return self._default

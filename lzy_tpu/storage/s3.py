@@ -1,0 +1,113 @@
+"""S3 storage backend (gated).
+
+The reference's S3 path is aioboto3 multipart (``pylzy/lzy/storage/async_/s3.py``,
+``util/util-s3`` transmitter loops). boto is not a baked-in dependency of this
+image, so this client resolves it lazily; environments that have boto3 get real
+multipart S3, others get a clear ImportError at construction time.
+"""
+
+from __future__ import annotations
+
+from typing import BinaryIO, Iterator
+from urllib.parse import urlparse
+
+from lzy_tpu.storage.api import StorageClient, StorageConfig
+
+
+class _CountingReader:
+    def __init__(self, inner: BinaryIO):
+        self._inner = inner
+        self.count = 0
+
+    def read(self, n: int = -1) -> bytes:
+        data = self._inner.read(n)
+        self.count += len(data)
+        return data
+
+
+class _CountingWriter:
+    def __init__(self, inner: BinaryIO):
+        self._inner = inner
+        self.count = 0
+
+    def write(self, data: bytes) -> int:
+        n = self._inner.write(data)
+        self.count += len(data)
+        return n if n is not None else len(data)
+
+
+class S3StorageClient(StorageClient):
+    scheme = "s3"
+
+    def __init__(self, config: StorageConfig):
+        try:
+            import boto3  # type: ignore
+        except ImportError as e:
+            raise ImportError(
+                "s3:// storage requires boto3, which is not installed in this "
+                "environment; use file:// or mem:// storage instead"
+            ) from e
+        self._s3 = boto3.client(
+            "s3",
+            endpoint_url=config.endpoint,
+            aws_access_key_id=config.access_key,
+            aws_secret_access_key=config.secret_key,
+        )
+
+    @staticmethod
+    def _split(uri: str):
+        p = urlparse(uri)
+        return p.netloc, p.path.lstrip("/")
+
+    def write(self, uri: str, src: BinaryIO) -> int:
+        bucket, key = self._split(uri)
+        counted = _CountingReader(src)
+        self._s3.upload_fileobj(counted, bucket, key)
+        return counted.count
+
+    def read(self, uri: str, dest: BinaryIO) -> int:
+        bucket, key = self._split(uri)
+        counted = _CountingWriter(dest)
+        self._s3.download_fileobj(bucket, key, counted)
+        return counted.count
+
+    def read_range(self, uri: str, offset: int, length: int = -1) -> bytes:
+        bucket, key = self._split(uri)
+        rng = f"bytes={offset}-" if length < 0 else f"bytes={offset}-{offset + length - 1}"
+        resp = self._s3.get_object(Bucket=bucket, Key=key, Range=rng)
+        return resp["Body"].read()
+
+    def exists(self, uri: str) -> bool:
+        bucket, key = self._split(uri)
+        from botocore.exceptions import ClientError  # type: ignore
+
+        try:
+            self._s3.head_object(Bucket=bucket, Key=key)
+            return True
+        except ClientError as e:
+            # only "object missing" means False; auth/throttling/network errors
+            # must surface, or cache layers silently recompute and clobber
+            if e.response.get("Error", {}).get("Code") in ("404", "NoSuchKey", "NotFound"):
+                return False
+            raise
+
+    def size(self, uri: str) -> int:
+        bucket, key = self._split(uri)
+        return self._s3.head_object(Bucket=bucket, Key=key)["ContentLength"]
+
+    def delete(self, uri: str) -> None:
+        bucket, key = self._split(uri)
+        self._s3.delete_object(Bucket=bucket, Key=key)
+
+    def list(self, prefix: str) -> Iterator[str]:
+        bucket, key = self._split(prefix)
+        paginator = self._s3.get_paginator("list_objects_v2")
+        for page in paginator.paginate(Bucket=bucket, Prefix=key):
+            for item in page.get("Contents", []):
+                yield f"s3://{bucket}/{item['Key']}"
+
+    def sign_uri(self, uri: str) -> str:
+        bucket, key = self._split(uri)
+        return self._s3.generate_presigned_url(
+            "get_object", Params={"Bucket": bucket, "Key": key}, ExpiresIn=3600
+        )

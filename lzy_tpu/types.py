@@ -1,0 +1,110 @@
+"""Shared value types.
+
+Mirrors the reference's ``pylzy/lzy/types.py:20-66`` (``File``, ``VmSpec``) and
+``lzy/allocator/.../vmpool/VmPoolSpec.java:7-16``, re-designed for TPU pools:
+instead of ``gpu_type`` in {V100, A100, T4} a pool is an accelerator *slice* with a
+type (e.g. ``v5e``), a topology (e.g. ``4x4``), a chip count, and a host count —
+gang scheduling allocates all hosts of a slice atomically (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Dict, Optional, Tuple
+
+
+class File(Path):
+    """Marker type for file-valued op arguments/results.
+
+    A ``File`` result is stored as raw bytes in storage (no pickling) and
+    re-materialized as a local file on the consumer side, like the reference's
+    ``File`` serializer (``pylzy/lzy/serialization/file.py:16``).
+    """
+
+
+# TPU accelerator generations the allocator knows how to provision, the analog of
+# GpuTypes {V100, A100, T4} (`lzy/allocator/.../vmpool/GpuTypes.java:3-8`).
+TPU_TYPES = ("v4", "v5e", "v5p", "v6e")
+
+# chips per host for each generation's standard host form factor
+_CHIPS_PER_HOST = {"v4": 4, "v5e": 8, "v5p": 4, "v6e": 8}
+
+
+def parse_topology(topology: str) -> Tuple[int, ...]:
+    """``"4x4" -> (4, 4)``; ``"8" -> (8,)``."""
+    try:
+        dims = tuple(int(d) for d in topology.lower().split("x"))
+    except ValueError:
+        raise ValueError(f"bad TPU topology {topology!r}; expected like '2x4' or '8'")
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"bad TPU topology {topology!r}")
+    return dims
+
+
+def chips_in_topology(topology: str) -> int:
+    n = 1
+    for d in parse_topology(topology):
+        n *= d
+    return n
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuPoolSpec:
+    """One allocatable slice shape, the analog of VmPoolSpec.
+
+    ``hosts`` is the gang size: an op scheduled on this pool runs SPMD across all
+    hosts of one slice.
+    """
+
+    label: str                    # e.g. "tpu-v5e-16"
+    tpu_type: str                 # e.g. "v5e"
+    topology: str                 # e.g. "4x4"
+    cpu_count: int = 0            # host vCPUs (per host)
+    ram_gb: int = 0
+    zones: Tuple[str, ...] = ()
+
+    def __post_init__(self) -> None:
+        if self.tpu_type and self.tpu_type not in TPU_TYPES:
+            raise ValueError(f"unknown tpu_type {self.tpu_type!r}; known: {TPU_TYPES}")
+        if self.topology:
+            parse_topology(self.topology)
+
+    @property
+    def chips(self) -> int:
+        return chips_in_topology(self.topology) if self.topology else 0
+
+    @property
+    def hosts(self) -> int:
+        if not self.tpu_type:
+            return 1
+        per_host = _CHIPS_PER_HOST[self.tpu_type]
+        return max(1, self.chips // per_host)
+
+
+@dataclasses.dataclass(frozen=True)
+class VmSpec:
+    """A CPU-only pool (data/preprocessing ops), like the reference's default
+    4 vCPU / 32 GB pool (``docs/tutorials/3-basics.md:42``)."""
+
+    label: str
+    cpu_count: int
+    ram_gb: int
+    zones: Tuple[str, ...] = ()
+
+    @property
+    def hosts(self) -> int:
+        return 1
+
+
+PoolSpec = TpuPoolSpec | VmSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class DataScheme:
+    """Typed-data descriptor carried alongside every stored entry, the analog of
+    the reference's ``LMD`` DataScheme proto (``model/.../data-scheme.proto``)."""
+
+    data_format: str              # serializer format name, e.g. "cloudpickle"
+    schema_content: str           # type description (qualified type name / dtype+shape)
+    meta: Dict[str, str] = dataclasses.field(default_factory=dict)

@@ -1,0 +1,73 @@
+"""Content hashing for cache keys and module sync.
+
+The reference keys op-result caches by md5-of-input-hashes
+(``pylzy/lzy/core/workflow.py:247-281``) and content-hashes local module zips before
+upload (``pylzy/lzy/api/v1/remote/runtime.py:249-281``). We use blake2b (faster,
+no crypto baggage) but keep the same structure: a stable hash per entry, combined
+into a cache key per call.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from pathlib import Path
+from typing import Iterable
+
+
+def hash_bytes(data: bytes) -> str:
+    return hashlib.blake2b(data, digest_size=16).hexdigest()
+
+
+def hash_str(s: str) -> str:
+    return hash_bytes(s.encode("utf-8"))
+
+
+def hash_file(path: str | Path, chunk: int = 1 << 20) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            h.update(buf)
+    return h.hexdigest()
+
+
+class HashingReader:
+    """Wraps a readable stream, hashing bytes as a consumer pulls them —
+    lets storage writes and cache-key hashing share one pass."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._hasher = hashlib.blake2b(digest_size=16)
+
+    def read(self, n: int = -1) -> bytes:
+        data = self._inner.read(n)
+        self._hasher.update(data)
+        return data
+
+    def hexdigest(self) -> str:
+        return self._hasher.hexdigest()
+
+
+def combine_hashes(hashes: Iterable[str]) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    for x in hashes:
+        h.update(x.encode("utf-8"))
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def hash_dir(path: str | Path) -> str:
+    """Deterministic hash of a directory tree (paths + contents), for module sync."""
+    root = Path(path)
+    h = hashlib.blake2b(digest_size=16)
+    for p in sorted(root.rglob("*")):
+        if p.is_file() and "__pycache__" not in p.parts:
+            rel = p.relative_to(root).as_posix()
+            h.update(rel.encode("utf-8"))
+            h.update(b"\x00")
+            h.update(hash_file(p).encode("utf-8"))
+            h.update(b"\x00")
+    return h.hexdigest()

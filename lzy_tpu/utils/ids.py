@@ -1,0 +1,19 @@
+"""ID generation helpers.
+
+The reference generates prefixed UUIDs for executions/ops/tasks/VMs throughout its
+Java services; we centralize the convention here.
+"""
+
+from __future__ import annotations
+
+import secrets
+import time
+
+
+def gen_id(prefix: str) -> str:
+    """Sortable-ish unique id: ``<prefix>-<millis-hex>-<rand>``."""
+    return f"{prefix}-{int(time.time() * 1000):x}-{secrets.token_hex(6)}"
+
+
+def entry_id(wf_name: str, name: str) -> str:
+    return gen_id(f"entry-{wf_name}-{name}")

@@ -1,0 +1,84 @@
+"""Structured logging with propagated context.
+
+The reference propagates request-id/execution-id/task-id via gRPC headers and
+log4j2 ThreadContext (``util/util-grpc``, ``util/util-common/.../logs/LogUtils.java``).
+Here a contextvar dict plays that role; it crosses threads explicitly via
+``logging_context()`` and is attached to every record by ``ContextFilter``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Iterator
+
+_LOG_CTX: contextvars.ContextVar[Dict[str, str]] = contextvars.ContextVar(
+    "lzy_log_ctx", default={}
+)
+
+_CONFIGURED = False
+_CONFIG_LOCK = threading.Lock()
+
+
+def current_context() -> Dict[str, str]:
+    return dict(_LOG_CTX.get())
+
+
+@contextlib.contextmanager
+def logging_context(**kwargs: str) -> Iterator[None]:
+    merged = {**_LOG_CTX.get(), **{k: str(v) for k, v in kwargs.items()}}
+    token = _LOG_CTX.set(merged)
+    try:
+        yield
+    finally:
+        _LOG_CTX.reset(token)
+
+
+class ContextFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        ctx = _LOG_CTX.get()
+        record.lzy_ctx = " ".join(f"{k}={v}" for k, v in ctx.items()) if ctx else "-"
+        return True
+
+
+def get_logger(name: str) -> logging.Logger:
+    global _CONFIGURED
+    if not _CONFIGURED:
+        with _CONFIG_LOCK:
+            if not _CONFIGURED:
+                level = os.environ.get("LZY_TPU_LOG_LEVEL", "WARNING").upper()
+                handler = logging.StreamHandler(sys.stderr)
+                handler.setFormatter(
+                    logging.Formatter(
+                        "%(asctime)s %(levelname)s %(name)s [%(lzy_ctx)s] %(message)s"
+                    )
+                )
+                handler.addFilter(ContextFilter())
+                root = logging.getLogger("lzy_tpu")
+                root.addHandler(handler)
+                root.setLevel(level)
+                _CONFIGURED = True
+    return logging.getLogger(name)
+
+
+class MetricEventLogger:
+    """Timing helper in the spirit of the reference's MetricEventLogger
+    (``util/util-common/.../logs/MetricEventLogger.java``)."""
+
+    def __init__(self, logger: logging.Logger):
+        self._log = logger
+
+    @contextlib.contextmanager
+    def timed(self, event: str, **tags: Any) -> Iterator[None]:
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            dt = (time.monotonic() - t0) * 1000
+            self._log.info("metric %s took_ms=%.1f %s", event, dt,
+                           " ".join(f"{k}={v}" for k, v in tags.items()))

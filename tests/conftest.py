@@ -1,0 +1,28 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; all sharding/SPMD tests run on
+8 virtual CPU devices (same XLA partitioner, same collectives), mirroring the
+driver's dryrun. Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_storage_uri(tmp_path):
+    return f"file://{tmp_path}/storage"
+
+
+@pytest.fixture(autouse=True)
+def _clear_mem_storage():
+    yield
+    from lzy_tpu.storage.mem import MemStorageClient
+
+    MemStorageClient.clear_all()

@@ -1,0 +1,118 @@
+"""Serialization tests, modeled on the reference's
+``pylzy/tests/serialization`` tier (SURVEY.md §4.1)."""
+
+import dataclasses
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lzy_tpu.serialization import default_registry
+from lzy_tpu.types import File
+
+
+@pytest.fixture(scope="module")
+def reg():
+    return default_registry()
+
+
+def roundtrip(reg, value):
+    ser = reg.find_by_instance(value)
+    buf = io.BytesIO()
+    ser.serialize(value, buf)
+    buf.seek(0)
+    # read side resolves by stored format, like Snapshot.get
+    reader = reg.find_by_format(ser.data_scheme(value).data_format)
+    return ser, reader.deserialize(buf, type(value))
+
+
+@pytest.mark.parametrize("value", [42, 3.14, "hello", True, None])
+def test_primitives(reg, value):
+    ser, out = roundtrip(reg, value)
+    assert ser.format_name() == "primitive"
+    assert out == value
+
+
+def test_numpy_array(reg):
+    arr = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    ser, out = roundtrip(reg, arr)
+    assert ser.format_name() == "jax_array"
+    np.testing.assert_array_equal(out, arr)
+    assert isinstance(out, np.ndarray)
+
+
+def test_jax_array_bfloat16(reg):
+    arr = jnp.linspace(-2, 2, 16, dtype=jnp.bfloat16).reshape(4, 4)
+    ser, out = roundtrip(reg, arr)
+    assert ser.format_name() == "jax_array"
+    assert isinstance(out, jax.Array)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(arr))
+
+
+def test_pytree_params(reg):
+    params = {
+        "dense": {"kernel": jnp.ones((8, 8), jnp.bfloat16), "bias": jnp.zeros((8,))},
+        "steps": 7,
+    }
+    ser, out = roundtrip(reg, params)
+    assert ser.format_name() == "jax_pytree"
+    assert out["steps"] == 7
+    assert out["dense"]["kernel"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(out["dense"]["bias"]), np.zeros((8,))
+    )
+
+
+def test_arbitrary_object_falls_to_cloudpickle(reg):
+    @dataclasses.dataclass
+    class Model:
+        name: str
+        score: float
+
+    ser, out = roundtrip(reg, Model("m", 0.9))
+    assert ser.format_name() == "cloudpickle"
+    assert out == Model("m", 0.9)
+    assert not ser.stable()
+
+
+def test_file_serializer(reg, tmp_path):
+    p = tmp_path / "data.bin"
+    p.write_bytes(b"\x00\x01payload")
+    f = File(p)
+    ser = reg.find_by_instance(f)
+    assert ser.format_name() == "raw_file"
+    buf = io.BytesIO()
+    ser.serialize(f, buf)
+    buf.seek(0)
+    out = ser.deserialize(buf)
+    assert isinstance(out, File)
+    assert out.read_bytes() == b"\x00\x01payload"
+    assert str(out) != str(f)
+
+
+def test_custom_serializer_registration(reg):
+    from lzy_tpu.serialization.registry import Serializer, SerializerRegistry
+
+    class UpperStr(Serializer):
+        def format_name(self):
+            return "upper_str"
+
+        def supports_type(self, typ):
+            return typ is str
+
+        def serialize(self, obj, dest):
+            dest.write(obj.upper().encode())
+
+        def deserialize(self, src, typ=None):
+            return src.read().decode()
+
+    r = SerializerRegistry()
+    r.register(UpperStr())
+    assert r.find_by_instance("x").format_name() == "upper_str"
+    with pytest.raises(TypeError):
+        r.find_by_instance(42)
+    with pytest.raises(ValueError):
+        r.register(UpperStr())

@@ -1,0 +1,102 @@
+"""Storage + snapshot tests (reference tier: ``pylzy/tests/storage``)."""
+
+import io
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lzy_tpu.serialization import default_registry
+from lzy_tpu.snapshot import Snapshot
+from lzy_tpu.storage import (
+    DefaultStorageRegistry,
+    FsStorageClient,
+    MemStorageClient,
+    StorageConfig,
+)
+from lzy_tpu.storage.api import join_uri
+
+
+@pytest.mark.parametrize("kind", ["fs", "mem"])
+def test_storage_roundtrip(kind, tmp_storage_uri):
+    client = FsStorageClient() if kind == "fs" else MemStorageClient()
+    prefix = tmp_storage_uri if kind == "fs" else "mem://bucket"
+    uri = join_uri(prefix, "a/b/obj")
+    assert not client.exists(uri)
+    client.write_bytes(uri, b"hello world")
+    assert client.exists(uri)
+    assert client.size(uri) == 11
+    assert client.read_bytes(uri) == b"hello world"
+    assert client.read_range(uri, 6) == b"world"
+    assert client.read_range(uri, 0, 5) == b"hello"
+    assert list(client.list(prefix)) == [uri]
+    client.delete(uri)
+    assert not client.exists(uri)
+
+
+def test_fs_write_atomic(tmp_storage_uri):
+    """A failing source stream must not leave a partial object behind."""
+    client = FsStorageClient()
+    uri = join_uri(tmp_storage_uri, "obj")
+
+    class Boom(io.RawIOBase):
+        def read(self, n=-1):
+            raise RuntimeError("stream died")
+
+    with pytest.raises(RuntimeError):
+        client.write(uri, Boom())
+    assert not client.exists(uri)
+
+
+def test_storage_registry_default():
+    reg = DefaultStorageRegistry()
+    assert reg.default_client() is None
+    reg.register_storage("a", StorageConfig(uri="mem://a"))
+    reg.register_storage("b", StorageConfig(uri="mem://b"), default=True)
+    assert reg.default_name() == "b"
+    assert reg.config("a").uri == "mem://a"
+    reg.unregister_storage("b")
+    assert reg.default_name() == "a"
+
+
+def test_snapshot_put_get_entries():
+    snap = Snapshot(
+        workflow_name="wf",
+        execution_id="exec-1",
+        storage_client=MemStorageClient(),
+        storage_prefix="mem://bucket",
+        serializers=default_registry(),
+    )
+    e1 = snap.create_entry("arg_0", int)
+    snap.put(e1.id, 41)
+    assert snap.get(e1.id) == 41
+    assert e1.materialized and e1.hash
+
+    arr = jnp.arange(8, dtype=jnp.bfloat16)
+    e2 = snap.create_entry("ret_0")
+    snap.put(e2.id, arr)
+    out = snap.get(e2.id)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(arr))
+
+    # copy (whiteboard aliasing path)
+    e3 = snap.create_entry("wb_field")
+    snap.copy_from_uri(e3.id, e2.storage_uri, e2.data_scheme)
+    assert e3.hash == e2.hash
+    np.testing.assert_array_equal(np.asarray(snap.get(e3.id)), np.asarray(arr))
+
+
+def test_snapshot_same_value_same_hash():
+    snap = Snapshot(
+        workflow_name="wf",
+        execution_id="exec-2",
+        storage_client=MemStorageClient(),
+        storage_prefix="mem://bucket",
+        serializers=default_registry(),
+    )
+    a = snap.create_entry("a")
+    b = snap.create_entry("b")
+    snap.put(a.id, {"x": 1})
+    snap.put(b.id, {"x": 1})
+    assert a.hash == b.hash
+    assert a.storage_uri != b.storage_uri
