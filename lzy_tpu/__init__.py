@@ -12,6 +12,43 @@ re-designed for TPU pools instead of GPU VM pools.
 
 __version__ = "0.1.0"
 
-from lzy_tpu.types import File, TpuPoolSpec, VmSpec, DataScheme
+from lzy_tpu.core.op import op
+from lzy_tpu.core.lzy import Lzy, lzy_auth
+from lzy_tpu.env.environment import LzyEnvironment
+from lzy_tpu.env.container import DockerContainer, NoContainer
+from lzy_tpu.env.provisioning import Any as AnyRequirement
+from lzy_tpu.env.provisioning import Provisioning, TpuProvisioning
+from lzy_tpu.env.python_env import AutoPythonEnv, ManualPythonEnv
+from lzy_tpu.env.shortcuts import (
+    docker_container,
+    env_vars,
+    provisioning,
+    python_env,
+    tpu,
+)
+from lzy_tpu.whiteboards.decl import whiteboard
+from lzy_tpu.types import DataScheme, File, TpuPoolSpec, VmSpec
 
-__all__ = ["File", "TpuPoolSpec", "VmSpec", "DataScheme"]
+__all__ = [
+    "op",
+    "Lzy",
+    "lzy_auth",
+    "LzyEnvironment",
+    "DockerContainer",
+    "NoContainer",
+    "Provisioning",
+    "TpuProvisioning",
+    "AnyRequirement",
+    "AutoPythonEnv",
+    "ManualPythonEnv",
+    "env_vars",
+    "provisioning",
+    "tpu",
+    "python_env",
+    "docker_container",
+    "whiteboard",
+    "File",
+    "TpuPoolSpec",
+    "VmSpec",
+    "DataScheme",
+]

@@ -1,0 +1,16 @@
+from lzy_tpu.core.op import LzyOp, op
+from lzy_tpu.core.lzy import Lzy, lzy_auth
+from lzy_tpu.core.workflow import LzyWorkflow, RemoteCallError, WorkflowError
+from lzy_tpu.core.call import CacheSettings, LzyCall
+
+__all__ = [
+    "LzyOp",
+    "op",
+    "Lzy",
+    "lzy_auth",
+    "LzyWorkflow",
+    "RemoteCallError",
+    "WorkflowError",
+    "CacheSettings",
+    "LzyCall",
+]

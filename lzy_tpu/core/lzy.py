@@ -1,0 +1,123 @@
+"""The ``Lzy`` facade.
+
+Counterpart of ``Lzy`` (``pylzy/lzy/core/lzy.py:45-176``): holds the environment,
+the runtime, the serializer and storage registries, and constructs workflows and
+whiteboard accessors. ``lzy_auth`` configures remote credentials
+(``lzy.py:27``).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional, Sequence, Type
+
+from lzy_tpu.core.workflow import LzyWorkflow
+from lzy_tpu.env.environment import LzyEnvironment, WithEnvironmentMixin
+from lzy_tpu.runtime.api import Runtime
+from lzy_tpu.serialization import SerializerRegistry, default_registry
+from lzy_tpu.storage import DefaultStorageRegistry, StorageConfig, StorageRegistry
+
+
+def lzy_auth(*, user: str, key_path: Optional[str] = None,
+             endpoint: Optional[str] = None,
+             whiteboards_endpoint: Optional[str] = None) -> None:
+    """Set remote credentials via env vars, the reference contract
+    (``LZY_USER``/``LZY_KEY_PATH``/``LZY_ENDPOINT``,
+    ``pylzy/lzy/api/v1/remote/lzy_service_client.py:39-41``)."""
+    os.environ["LZY_USER"] = user
+    if key_path is not None:
+        os.environ["LZY_KEY_PATH"] = key_path
+    if endpoint is not None:
+        os.environ["LZY_ENDPOINT"] = endpoint
+    if whiteboards_endpoint is not None:
+        os.environ["LZY_WHITEBOARD_ENDPOINT"] = whiteboards_endpoint
+
+
+class Lzy(WithEnvironmentMixin):
+    def __init__(
+        self,
+        *,
+        runtime: Optional[Runtime] = None,
+        storage_registry: Optional[StorageRegistry] = None,
+        serializer_registry: Optional[SerializerRegistry] = None,
+        env: Optional[LzyEnvironment] = None,
+    ):
+        self.env = env or LzyEnvironment()
+        self._runtime = runtime or self._default_runtime()
+        self._storage_registry = storage_registry or self._default_storage()
+        self._serializer_registry = serializer_registry or default_registry()
+
+    @staticmethod
+    def _default_runtime() -> Runtime:
+        from lzy_tpu.runtime.local import LocalRuntime
+
+        return LocalRuntime()
+
+    @staticmethod
+    def _default_storage() -> StorageRegistry:
+        reg = DefaultStorageRegistry()
+        root = os.environ.get(
+            "LZY_TPU_LOCAL_STORAGE",
+            os.path.join(tempfile.gettempdir(), "lzy_tpu_storage"),
+        )
+        reg.register_storage("default", StorageConfig(uri=f"file://{root}"), default=True)
+        return reg
+
+    # -- registries ------------------------------------------------------------
+
+    @property
+    def runtime(self) -> Runtime:
+        return self._runtime
+
+    @property
+    def storage_registry(self) -> StorageRegistry:
+        return self._storage_registry
+
+    @property
+    def serializer_registry(self) -> SerializerRegistry:
+        return self._serializer_registry
+
+    def auth(self, *, user: str, key_path: Optional[str] = None,
+             endpoint: Optional[str] = None,
+             whiteboards_endpoint: Optional[str] = None) -> "Lzy":
+        lzy_auth(user=user, key_path=key_path, endpoint=endpoint,
+                 whiteboards_endpoint=whiteboards_endpoint)
+        return self
+
+    # -- workflows -------------------------------------------------------------
+
+    def workflow(
+        self,
+        name: str,
+        *,
+        eager: bool = False,
+        interactive: bool = True,
+        env: Optional[LzyEnvironment] = None,
+    ) -> LzyWorkflow:
+        return LzyWorkflow(
+            self,
+            name,
+            env or LzyEnvironment(),
+            eager=eager,
+            interactive=interactive,
+        )
+
+    # -- whiteboards (implemented in lzy_tpu/whiteboards) ----------------------
+
+    def whiteboard(self, *, id_: Optional[str] = None, storage_uri: Optional[str] = None):
+        from lzy_tpu.whiteboards.index import WhiteboardIndex
+        from lzy_tpu.whiteboards.wb import WhiteboardWrapper
+
+        manifest = WhiteboardIndex.for_lzy(self).get(id_=id_, storage_uri=storage_uri)
+        return WhiteboardWrapper(self, manifest)
+
+    def whiteboards(self, *, name: Optional[str] = None, tags: Sequence[str] = (),
+                    not_before=None, not_after=None):
+        from lzy_tpu.whiteboards.index import WhiteboardIndex
+        from lzy_tpu.whiteboards.wb import WhiteboardWrapper
+
+        manifests = WhiteboardIndex.for_lzy(self).query(
+            name=name, tags=tags, not_before=not_before, not_after=not_after
+        )
+        return [WhiteboardWrapper(self, m) for m in manifests]

@@ -1,0 +1,122 @@
+"""The ``@op`` decorator.
+
+Counterpart of ``op()`` (``pylzy/lzy/core/op.py:18-61``) + ``LazyCallWrapper``
+(``pylzy/lzy/core/call.py:191-268``). Inside an active workflow a decorated call
+registers lazily and returns proxies; outside one it just runs the function
+(reference behavior: ops are plain functions without a workflow).
+
+TPU-first additions: ``tpu="v5e-16"`` shorthand on the decorator and the implied
+gang semantics — an op with a TPU requirement is an SPMD program launched on
+every host of the resolved slice.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple, Type, overload
+
+from lzy_tpu.core.call import CacheSettings, LzyCall
+from lzy_tpu.core.signatures import infer_and_validate_call_signature
+from lzy_tpu.core.workflow import LzyWorkflow
+from lzy_tpu.env.environment import LzyEnvironment, WithEnvironmentMixin
+from lzy_tpu.env.provisioning import tpu_requirement
+
+
+class LzyOp(WithEnvironmentMixin):
+    """The wrapper object ``@op`` produces; carries per-op env overrides and
+    the fluent ``with_*`` modifiers from WithEnvironmentMixin."""
+
+    def __init__(
+        self,
+        func: Callable,
+        env: LzyEnvironment,
+        *,
+        output_types: Optional[Tuple[Type, ...]] = None,
+        description: str = "",
+        cache: bool = False,
+        version: str = "0.0",
+        lazy_arguments: bool = True,
+    ):
+        functools.update_wrapper(self, func)
+        self.func = func
+        self.env = env
+        self.output_types = output_types
+        self.description = description
+        self.cache_settings = CacheSettings(cache=cache, version=version)
+        self.lazy_arguments = lazy_arguments
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        wf = LzyWorkflow.get_active()
+        if wf is None:
+            return self.func(*args, **kwargs)
+
+        signature = infer_and_validate_call_signature(
+            self.func, *args, output_types=self.output_types, **kwargs
+        )
+        env = wf.owner.env.combine(wf.env).combine(self.env)
+        call = LzyCall(
+            workflow=wf,
+            signature=signature,
+            env=env,
+            cache=self.cache_settings,
+            description=self.description,
+            lazy_arguments=self.lazy_arguments,
+        )
+        wf.register_call(call)
+        return call.build_results()
+
+    def __get__(self, instance, owner=None):
+        if instance is None:
+            return self
+        return functools.partial(self, instance)
+
+
+@overload
+def op(func: Callable) -> LzyOp: ...
+
+
+@overload
+def op(
+    func: None = None,
+    *,
+    output_types: Optional[Sequence[Type]] = None,
+    description: str = "",
+    cache: bool = False,
+    version: str = "0.0",
+    lazy_arguments: bool = True,
+    env: Optional[LzyEnvironment] = None,
+    tpu: Optional[str] = None,
+) -> Callable[[Callable], LzyOp]: ...
+
+
+def op(
+    func: Optional[Callable] = None,
+    *,
+    output_types: Optional[Sequence[Type]] = None,
+    description: str = "",
+    cache: bool = False,
+    version: str = "0.0",
+    lazy_arguments: bool = True,
+    env: Optional[LzyEnvironment] = None,
+    tpu: Optional[str] = None,
+):
+    """Decorate a function as a workflow op.
+
+    ``@op`` bare or ``@op(cache=True, version="1.1", tpu="v5e-16", ...)``.
+    """
+
+    def wrap(f: Callable) -> LzyOp:
+        e = env or LzyEnvironment()
+        if tpu is not None:
+            e = e.with_provisioning(tpu_requirement(tpu))
+        return LzyOp(
+            f,
+            e,
+            output_types=tuple(output_types) if output_types is not None else None,
+            description=description,
+            cache=cache,
+            version=version,
+            lazy_arguments=lazy_arguments,
+        )
+
+    return wrap(func) if func is not None else wrap

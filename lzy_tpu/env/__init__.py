@@ -1,0 +1,26 @@
+from lzy_tpu.env.environment import LzyEnvironment, WithEnvironmentMixin
+from lzy_tpu.env.provisioning import (
+    Any,
+    NoPoolError,
+    Provisioning,
+    TpuProvisioning,
+    tpu_requirement,
+)
+from lzy_tpu.env.python_env import AutoPythonEnv, ManualPythonEnv, PythonEnvSpec
+from lzy_tpu.env.container import BaseContainer, DockerContainer, NoContainer
+
+__all__ = [
+    "LzyEnvironment",
+    "WithEnvironmentMixin",
+    "Any",
+    "NoPoolError",
+    "Provisioning",
+    "TpuProvisioning",
+    "tpu_requirement",
+    "AutoPythonEnv",
+    "ManualPythonEnv",
+    "PythonEnvSpec",
+    "BaseContainer",
+    "DockerContainer",
+    "NoContainer",
+]

@@ -1,0 +1,33 @@
+"""Container specs.
+
+Counterpart of ``pylzy/lzy/env/container/docker.py`` (DockerContainer /
+NoContainer). On TPU the image must bundle libtpu + jax; the worker validates
+that instead of CUDA runtimes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+class BaseContainer:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class NoContainer(BaseContainer):
+    """Run in the host process env of the worker VM."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DockerContainer(BaseContainer):
+    image: str
+    registry: Optional[str] = None
+    pull_policy: str = "if_not_present"         # or "always"
+    username: Optional[str] = None
+    password: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if self.pull_policy not in ("if_not_present", "always"):
+            raise ValueError(f"bad pull_policy {self.pull_policy!r}")

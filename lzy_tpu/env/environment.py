@@ -1,0 +1,82 @@
+"""Composable execution environment.
+
+Counterpart of ``LzyEnvironment`` (``pylzy/lzy/env/environment.py:27-96``) with
+the reference's merge semantics ``Lzy.env ⊕ workflow.env ⊕ call.env``
+(``pylzy/lzy/core/call.py:52-57``): the right-hand side's *set* fields win,
+env_vars dictionaries merge key-wise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Mapping, Optional
+
+from lzy_tpu.env.container import BaseContainer
+from lzy_tpu.env.provisioning import Provisioning
+from lzy_tpu.env.python_env import BasePythonEnv
+
+
+@dataclasses.dataclass(frozen=True)
+class LzyEnvironment:
+    env_vars: Dict[str, str] = dataclasses.field(default_factory=dict)
+    provisioning: Optional[Provisioning] = None
+    python_env: Optional[BasePythonEnv] = None
+    container: Optional[BaseContainer] = None
+
+    def combine(self, other: "LzyEnvironment") -> "LzyEnvironment":
+        if other.provisioning is None:
+            prov = self.provisioning
+        elif self.provisioning is None:
+            prov = other.provisioning
+        elif type(other.provisioning) is not type(self.provisioning):
+            # switching provisioning kind (e.g. CPU → TPU) replaces, field
+            # merge across kinds would be ill-defined
+            prov = other.provisioning
+        else:
+            prov = self.provisioning.combine(other.provisioning)
+        return LzyEnvironment(
+            env_vars={**self.env_vars, **other.env_vars},
+            provisioning=prov,
+            python_env=other.python_env or self.python_env,
+            container=other.container or self.container,
+        )
+
+    def with_env_vars(self, env_vars: Mapping[str, str]) -> "LzyEnvironment":
+        return dataclasses.replace(self, env_vars={**self.env_vars, **env_vars})
+
+    def with_provisioning(self, prov: Provisioning) -> "LzyEnvironment":
+        return dataclasses.replace(self, provisioning=prov)
+
+    def with_python_env(self, python_env: BasePythonEnv) -> "LzyEnvironment":
+        return dataclasses.replace(self, python_env=python_env)
+
+    def with_container(self, container: BaseContainer) -> "LzyEnvironment":
+        return dataclasses.replace(self, container=container)
+
+
+class WithEnvironmentMixin:
+    """Fluent env modifiers shared by Lzy / workflow / op wrappers, like the
+    reference's ``WithEnvironmentMixin`` (``pylzy/lzy/env/mixin.py``)."""
+
+    env: LzyEnvironment
+
+    def _replace_env(self, env: LzyEnvironment):
+        clone = self.__class__.__new__(self.__class__)
+        clone.__dict__.update(self.__dict__)
+        clone.env = env
+        return clone
+
+    def with_env(self, env: LzyEnvironment):
+        return self._replace_env(env)
+
+    def with_env_vars(self, env_vars: Mapping[str, str]):
+        return self._replace_env(self.env.with_env_vars(env_vars))
+
+    def with_provisioning(self, prov: Provisioning):
+        return self._replace_env(self.env.with_provisioning(prov))
+
+    def with_python_env(self, python_env: BasePythonEnv):
+        return self._replace_env(self.env.with_python_env(python_env))
+
+    def with_container(self, container: BaseContainer):
+        return self._replace_env(self.env.with_container(container))

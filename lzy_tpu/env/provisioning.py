@@ -1,0 +1,151 @@
+"""Compute requirements and pool resolution.
+
+Counterpart of the reference's ``Provisioning``
+(``pylzy/lzy/env/provisioning/provisioning.py:60-167``) and its score functions
+(``score.py``): requirements with an ``Any`` wildcard are matched against the
+available pools, scored, and the *minimum adequate* pool wins (never grab a
+v5e-64 when a v5e-8 satisfies the op).
+
+TPU-first redesign (SURVEY.md §2.4): instead of ``gpu_type``/``gpu_count`` the
+accelerator requirement is a slice — ``tpu_type`` + either an explicit
+``tpu_topology`` or a minimum chip count. A resolved TPU pool implies a gang:
+the op runs SPMD on every host of one slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Union
+
+from lzy_tpu.types import PoolSpec, TpuPoolSpec, VmSpec, chips_in_topology
+
+
+class _AnyType:
+    """Wildcard requirement, like the reference's ``Any`` score marker."""
+
+    _instance: Optional["_AnyType"] = None
+
+    def __new__(cls) -> "_AnyType":
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "Any"
+
+
+Any = _AnyType()
+IntReq = Union[int, _AnyType, None]
+StrReq = Union[str, _AnyType, None]
+
+
+def _is_set(req) -> bool:
+    return req is not None and not isinstance(req, _AnyType)
+
+
+@dataclasses.dataclass(frozen=True)
+class Provisioning:
+    """CPU-pool requirements (data/preprocessing ops)."""
+
+    cpu_count: IntReq = None
+    ram_gb: IntReq = None
+    zone: StrReq = None
+
+    def combine(self, other: "Provisioning") -> "Provisioning":
+        """``self ⊕ other`` with other's set fields winning (call env overrides
+        workflow env overrides Lzy env, ``pylzy/lzy/core/call.py:52-57``)."""
+        kwargs = {}
+        for f in dataclasses.fields(self):
+            mine, theirs = getattr(self, f.name), getattr(other, f.name)
+            kwargs[f.name] = theirs if theirs is not None else mine
+        return type(self)(**kwargs)
+
+    # -- matching --------------------------------------------------------------
+
+    def matches(self, pool: PoolSpec) -> bool:
+        if isinstance(pool, TpuPoolSpec):
+            return False  # plain Provisioning never claims a TPU slice
+        if _is_set(self.cpu_count) and pool.cpu_count < self.cpu_count:
+            return False
+        if _is_set(self.ram_gb) and pool.ram_gb < self.ram_gb:
+            return False
+        if _is_set(self.zone) and pool.zones and self.zone not in pool.zones:
+            return False
+        return True
+
+    def score(self, pool: PoolSpec) -> float:
+        """Lower is better: waste-minimizing, like the reference's default
+        minimum-score policy (``provisioning.py:126-160``)."""
+        return pool.cpu_count + pool.ram_gb / 8.0
+
+    def resolve_pool(self, pools: Sequence[PoolSpec]) -> PoolSpec:
+        candidates = [p for p in pools if self.matches(p)]
+        if not candidates:
+            raise NoPoolError(self, pools)
+        return min(candidates, key=self.score)
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuProvisioning(Provisioning):
+    """TPU slice requirements. Exactly one of ``tpu_topology`` (exact slice) or
+    ``min_chips`` (smallest adequate slice) is usually set; ``tpu_type`` may be
+    ``Any`` to accept any generation."""
+
+    tpu_type: StrReq = None
+    tpu_topology: StrReq = None
+    min_chips: IntReq = None
+
+    def matches(self, pool: PoolSpec) -> bool:
+        if not isinstance(pool, TpuPoolSpec):
+            return False
+        if _is_set(self.tpu_type) and pool.tpu_type != self.tpu_type:
+            return False
+        if _is_set(self.tpu_topology) and pool.topology != self.tpu_topology:
+            return False
+        if _is_set(self.min_chips) and pool.chips < self.min_chips:
+            return False
+        if _is_set(self.cpu_count) and pool.cpu_count < self.cpu_count:
+            return False
+        if _is_set(self.ram_gb) and pool.ram_gb < self.ram_gb:
+            return False
+        if _is_set(self.zone) and pool.zones and self.zone not in pool.zones:
+            return False
+        return True
+
+    def score(self, pool: PoolSpec) -> float:
+        assert isinstance(pool, TpuPoolSpec)
+        return float(pool.chips)
+
+    def resolve_pool(self, pools: Sequence[PoolSpec]) -> TpuPoolSpec:
+        pool = super().resolve_pool(pools)
+        assert isinstance(pool, TpuPoolSpec)
+        return pool
+
+
+class NoPoolError(LookupError):
+    def __init__(self, prov: Provisioning, pools: Sequence[PoolSpec]):
+        labels = ", ".join(p.label for p in pools) or "<none>"
+        super().__init__(
+            f"no pool satisfies {prov!r}; available pools: {labels}"
+        )
+        self.provisioning = prov
+        self.pools = tuple(pools)
+
+
+def tpu_requirement(spec: str) -> TpuProvisioning:
+    """Parse the user-facing shorthand ``"v5e-16"`` (type + chip count) or
+    ``"v5e:4x4"`` (type + exact topology)."""
+    if ":" in spec:
+        typ, topo = spec.split(":", 1)
+        chips_in_topology(topo)  # validate
+        return TpuProvisioning(tpu_type=typ, tpu_topology=topo)
+    if "-" in spec:
+        typ, _, chips = spec.rpartition("-")
+        try:
+            return TpuProvisioning(tpu_type=typ, min_chips=int(chips))
+        except ValueError:
+            pass
+    raise ValueError(
+        f"bad tpu spec {spec!r}; expected '<type>-<chips>' (v5e-16) or "
+        f"'<type>:<topology>' (v5e:4x4)"
+    )

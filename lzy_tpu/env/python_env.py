@@ -1,0 +1,115 @@
+"""Python environment capture.
+
+Counterpart of ``AutoPythonEnv`` (``pylzy/lzy/env/python/auto.py:24-55``) /
+``ManualPythonEnv``. The reference shells out to the external ``envzy`` explorer;
+we introspect natively: interpreter version, imported distributions (via
+``importlib.metadata``), and local modules (imported files outside site-packages)
+that must be synced to the remote env. The result feeds both conda-yaml
+generation (reference parity) and the worker's faster uv/venv overlay path
+(SURVEY.md §7 "Env sync on TPU VMs").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import sysconfig
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class PythonEnvSpec:
+    python_version: str                         # "3.12"
+    packages: Tuple[Tuple[str, str], ...]       # ((name, version), ...)
+    local_module_paths: Tuple[str, ...]         # dirs/files to sync
+
+    def to_conda_yaml(self, env_name: str = "py_env") -> str:
+        """Conda-yaml for reference parity with ``LzyCall`` conda generation
+        (``pylzy/lzy/core/call.py:152-188``)."""
+        lines = [
+            f"name: {env_name}",
+            "dependencies:",
+            f"- python=={self.python_version}",
+            "- pip",
+            "- pip:",
+        ]
+        lines += [f"  - {name}=={ver}" for name, ver in self.packages]
+        return "\n".join(lines) + "\n"
+
+
+class BasePythonEnv:
+    def spec(self) -> PythonEnvSpec:
+        raise NotImplementedError
+
+
+class AutoPythonEnv(BasePythonEnv):
+    """Capture the caller's live environment at graph-build time."""
+
+    def __init__(self, extra_packages: Optional[Dict[str, str]] = None,
+                 extra_local_paths: Sequence[str] = ()):
+        self._extra_packages = dict(extra_packages or {})
+        self._extra_local_paths = tuple(extra_local_paths)
+
+    def spec(self) -> PythonEnvSpec:
+        version = "%d.%d" % sys.version_info[:2]
+        packages = dict(self._iter_imported_distributions())
+        packages.update(self._extra_packages)
+        local = list(self._iter_local_modules())
+        local += [p for p in self._extra_local_paths if p not in local]
+        return PythonEnvSpec(
+            python_version=version,
+            packages=tuple(sorted(packages.items())),
+            local_module_paths=tuple(local),
+        )
+
+    @staticmethod
+    def _iter_imported_distributions():
+        import importlib.metadata as md
+
+        seen = set()
+        top_level = {name.split(".")[0] for name in sys.modules}
+        for dist in md.distributions():
+            name = dist.metadata["Name"]
+            if not name or name in seen:
+                continue
+            provided = (dist.read_text("top_level.txt") or "").split()
+            provided = provided or [name.replace("-", "_")]
+            if any(m in top_level for m in provided):
+                seen.add(name)
+                yield name, dist.version
+
+    @staticmethod
+    def _iter_local_modules():
+        stdlib = sysconfig.get_paths()["stdlib"]
+        purelib = sysconfig.get_paths()["purelib"]
+        seen = set()
+        for mod in list(sys.modules.values()):
+            f = getattr(mod, "__file__", None)
+            if not f:
+                continue
+            p = Path(f).resolve()
+            s = str(p)
+            if s.startswith(stdlib) or s.startswith(purelib) or "site-packages" in s:
+                continue
+            # sync the top package dir for packages, the file itself for modules
+            target = p.parent if p.name == "__init__.py" else p
+            t = str(target)
+            if t not in seen:
+                seen.add(t)
+                yield t
+
+
+class ManualPythonEnv(BasePythonEnv):
+    """Fully user-specified env, like the reference's ManualPythonEnv."""
+
+    def __init__(self, *, python_version: str, packages: Dict[str, str],
+                 local_module_paths: Sequence[str] = ()):
+        self._spec = PythonEnvSpec(
+            python_version=python_version,
+            packages=tuple(sorted(packages.items())),
+            local_module_paths=tuple(local_module_paths),
+        )
+
+    def spec(self) -> PythonEnvSpec:
+        return self._spec

@@ -1,0 +1,70 @@
+"""User-facing env shortcuts.
+
+Counterpart of ``pylzy/lzy/env/shortcuts.py:29-123``, with ``tpu(...)`` replacing
+the reference's gpu shortcuts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from lzy_tpu.env.container import DockerContainer
+from lzy_tpu.env.environment import LzyEnvironment
+from lzy_tpu.env.provisioning import (
+    IntReq,
+    Provisioning,
+    StrReq,
+    TpuProvisioning,
+    tpu_requirement,
+)
+from lzy_tpu.env.python_env import AutoPythonEnv, ManualPythonEnv
+
+
+def env_vars(**kwargs: str) -> LzyEnvironment:
+    return LzyEnvironment(env_vars=dict(kwargs))
+
+
+def provisioning(cpu_count: IntReq = None, ram_gb: IntReq = None,
+                 zone: StrReq = None) -> LzyEnvironment:
+    return LzyEnvironment(
+        provisioning=Provisioning(cpu_count=cpu_count, ram_gb=ram_gb, zone=zone)
+    )
+
+
+def tpu(spec: str, *, cpu_count: IntReq = None, ram_gb: IntReq = None,
+        zone: StrReq = None) -> LzyEnvironment:
+    """``tpu("v5e-16")`` — smallest v5e slice with ≥16 chips;
+    ``tpu("v5e:4x4")`` — exactly a 4x4 v5e slice."""
+    req = tpu_requirement(spec)
+    import dataclasses
+
+    req = dataclasses.replace(req, cpu_count=cpu_count, ram_gb=ram_gb, zone=zone)
+    return LzyEnvironment(provisioning=req)
+
+
+def python_env(*, python_version: Optional[str] = None,
+               packages: Optional[Dict[str, str]] = None,
+               local_module_paths: Sequence[str] = ()) -> LzyEnvironment:
+    if python_version is None and packages is None:
+        env = AutoPythonEnv(extra_local_paths=local_module_paths)
+    else:
+        import sys
+
+        env = ManualPythonEnv(
+            python_version=python_version or "%d.%d" % sys.version_info[:2],
+            packages=packages or {},
+            local_module_paths=local_module_paths,
+        )
+    return LzyEnvironment(python_env=env)
+
+
+def docker_container(image: str, *, registry: Optional[str] = None,
+                     pull_policy: str = "if_not_present",
+                     username: Optional[str] = None,
+                     password: Optional[str] = None) -> LzyEnvironment:
+    return LzyEnvironment(
+        container=DockerContainer(
+            image=image, registry=registry, pull_policy=pull_policy,
+            username=username, password=password,
+        )
+    )

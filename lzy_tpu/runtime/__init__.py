@@ -1,0 +1,4 @@
+from lzy_tpu.runtime.api import Runtime
+from lzy_tpu.runtime.local import LocalRuntime
+
+__all__ = ["Runtime", "LocalRuntime"]

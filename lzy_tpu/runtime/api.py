@@ -1,0 +1,36 @@
+"""Runtime interface.
+
+Counterpart of ``Runtime`` (``pylzy/lzy/api/v1/runtime.py:1-44``): the strategy a
+workflow uses to execute its call queue — in-process (LocalRuntime), or against
+the control plane (RemoteRuntime → workflow service → executor → allocator →
+workers).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import TYPE_CHECKING, Sequence
+
+if TYPE_CHECKING:
+    from lzy_tpu.core.call import LzyCall
+    from lzy_tpu.core.workflow import LzyWorkflow
+
+
+class Runtime(abc.ABC):
+    @abc.abstractmethod
+    def start(self, workflow: "LzyWorkflow") -> None:
+        """Begin an execution session for the workflow."""
+
+    @abc.abstractmethod
+    def exec(self, workflow: "LzyWorkflow", calls: Sequence["LzyCall"]) -> None:
+        """Execute a batch of calls; must not return until every call's results
+        (or its exception) are durably stored. Raises RemoteCallError on op
+        failure."""
+
+    @abc.abstractmethod
+    def finish(self, workflow: "LzyWorkflow") -> None:
+        """Graceful teardown after a successful workflow exit."""
+
+    @abc.abstractmethod
+    def abort(self, workflow: "LzyWorkflow") -> None:
+        """Teardown after a failed workflow; running tasks are stopped."""

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import json
 import tempfile
 import threading
 from typing import Any, Dict, Optional, Type
@@ -107,6 +108,7 @@ class Snapshot:
             self._client.write(entry.storage_uri, reader)
             entry.hash = reader.hexdigest()
         entry.data_scheme = serializer.data_scheme(value)
+        self._write_meta(entry)
         return entry
 
     def get(self, entry_id: str) -> Any:
@@ -126,7 +128,42 @@ class Snapshot:
             entry.hash = reader.hexdigest()
         if scheme is not None:
             entry.data_scheme = scheme
+        self._write_meta(entry)
         return entry
+
+    # -- durable entry metadata ------------------------------------------------
+    # A sidecar ``<uri>.meta`` JSON travels with every stored object so a later
+    # execution (cache hit, whiteboard read) can recover the serializer format
+    # and the content hash — hashes feed downstream cache keys, which must be
+    # stable across runs (SURVEY.md §5.4).
+
+    def _write_meta(self, entry: SnapshotEntry) -> None:
+        doc = {
+            "hash": entry.hash,
+            "data_format": entry.data_scheme.data_format if entry.data_scheme else None,
+            "schema_content": entry.data_scheme.schema_content if entry.data_scheme else None,
+            "meta": entry.data_scheme.meta if entry.data_scheme else {},
+        }
+        self._client.write_bytes(
+            entry.storage_uri + ".meta", json.dumps(doc).encode("utf-8")
+        )
+
+    def try_restore_entry(self, entry_id: str) -> bool:
+        """Rehydrate scheme+hash from the sidecar for an entry whose object
+        already exists in storage (cache hit). Returns False if absent."""
+        entry = self.get_entry(entry_id)
+        meta_uri = entry.storage_uri + ".meta"
+        if not self._client.exists(entry.storage_uri) or not self._client.exists(meta_uri):
+            return False
+        doc = json.loads(self._client.read_bytes(meta_uri).decode("utf-8"))
+        entry.hash = doc["hash"]
+        if doc.get("data_format"):
+            entry.data_scheme = DataScheme(
+                data_format=doc["data_format"],
+                schema_content=doc.get("schema_content") or "",
+                meta=doc.get("meta") or {},
+            )
+        return True
 
     def _resolve_serializer(self, entry: SnapshotEntry):
         if entry.data_scheme is not None:

@@ -1,0 +1,12 @@
+from lzy_tpu.whiteboards.decl import whiteboard, whiteboard_name
+from lzy_tpu.whiteboards.index import WhiteboardIndex, WhiteboardManifest
+from lzy_tpu.whiteboards.wb import WhiteboardWrapper, WritableWhiteboard
+
+__all__ = [
+    "whiteboard",
+    "whiteboard_name",
+    "WhiteboardIndex",
+    "WhiteboardManifest",
+    "WhiteboardWrapper",
+    "WritableWhiteboard",
+]

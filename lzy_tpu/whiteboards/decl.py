@@ -1,0 +1,31 @@
+"""``@whiteboard`` declaration decorator.
+
+Counterpart of the reference's ``whiteboard_`` decorator
+(``pylzy/lzy/api/v1/whiteboards.py:32``): marks a dataclass as a whiteboard
+schema with a durable name.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Type
+
+WB_NAME_ATTR = "__lzy_wb_name__"
+
+
+def whiteboard(name: str):
+    """``@whiteboard("best_model")`` above a ``@dataclass``."""
+    if not name or not isinstance(name, str):
+        raise ValueError("whiteboard name must be a non-empty string")
+
+    def wrap(cls: Type) -> Type:
+        if not dataclasses.is_dataclass(cls):
+            cls = dataclasses.dataclass(cls)
+        setattr(cls, WB_NAME_ATTR, name)
+        return cls
+
+    return wrap
+
+
+def whiteboard_name(typ: Type) -> Optional[str]:
+    return getattr(typ, WB_NAME_ATTR, None)

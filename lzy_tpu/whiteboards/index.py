@@ -1,0 +1,135 @@
+"""Whiteboard index: register/finalize/get/query over storage manifests.
+
+The reference runs a dedicated whiteboard service with Postgres
+(``lzy/whiteboard/.../WhiteboardService.java:45``, proto
+``whiteboard-api/.../whiteboard-service.proto:11-17``). Here the index is a
+storage-native manifest layout — ``<root>/whiteboards/<id>/manifest.json`` plus
+one object per field — so whiteboards survive with the data itself and need no
+extra service for single-tenant deployments; a service-backed index can slot in
+behind the same interface later.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+from typing import TYPE_CHECKING, Any, Dict, List, Optional, Sequence
+
+from lzy_tpu.storage.api import StorageClient, join_uri
+from lzy_tpu.types import DataScheme
+
+if TYPE_CHECKING:
+    from lzy_tpu.core.lzy import Lzy
+
+CREATED = "CREATED"
+FINALIZED = "FINALIZED"
+
+
+class WhiteboardManifest:
+    def __init__(self, doc: Dict[str, Any]):
+        self.doc = doc
+
+    @property
+    def id(self) -> str:
+        return self.doc["id"]
+
+    @property
+    def name(self) -> str:
+        return self.doc["name"]
+
+    @property
+    def status(self) -> str:
+        return self.doc["status"]
+
+    @property
+    def tags(self) -> List[str]:
+        return list(self.doc.get("tags", []))
+
+    @property
+    def created_at(self) -> datetime.datetime:
+        return datetime.datetime.fromisoformat(self.doc["created_at"])
+
+    @property
+    def fields(self) -> Dict[str, Dict[str, Any]]:
+        return self.doc.get("fields", {})
+
+    @property
+    def base_uri(self) -> str:
+        return self.doc["base_uri"]
+
+
+class WhiteboardIndex:
+    def __init__(self, client: StorageClient, root_uri: str):
+        self._client = client
+        self._root = join_uri(root_uri, "whiteboards")
+
+    @classmethod
+    def for_lzy(cls, lzy: "Lzy") -> "WhiteboardIndex":
+        client = lzy.storage_registry.default_client()
+        config = lzy.storage_registry.default_config()
+        if client is None or config is None:
+            raise RuntimeError("no storage registered for whiteboard index")
+        return cls(client, config.uri)
+
+    def base_uri(self, wb_id: str) -> str:
+        return join_uri(self._root, wb_id)
+
+    def _manifest_uri(self, wb_id: str) -> str:
+        return join_uri(self._root, wb_id, "manifest.json")
+
+    def register(self, *, wb_id: str, name: str, tags: Sequence[str]) -> WhiteboardManifest:
+        doc = {
+            "id": wb_id,
+            "name": name,
+            "status": CREATED,
+            "tags": list(tags),
+            "created_at": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+            "base_uri": self.base_uri(wb_id),
+            "fields": {},
+        }
+        self._write(wb_id, doc)
+        return WhiteboardManifest(doc)
+
+    def finalize(self, wb_id: str, fields: Dict[str, Dict[str, Any]]) -> None:
+        manifest = self.get(id_=wb_id)
+        manifest.doc["fields"] = fields
+        manifest.doc["status"] = FINALIZED
+        self._write(wb_id, manifest.doc)
+
+    def _write(self, wb_id: str, doc: Dict[str, Any]) -> None:
+        self._client.write_bytes(
+            self._manifest_uri(wb_id), json.dumps(doc, indent=1).encode("utf-8")
+        )
+
+    def get(self, *, id_: Optional[str] = None,
+            storage_uri: Optional[str] = None) -> WhiteboardManifest:
+        if id_ is None and storage_uri is None:
+            raise ValueError("pass id_ or storage_uri")
+        uri = storage_uri or self._manifest_uri(id_)
+        if not uri.endswith("manifest.json"):
+            uri = join_uri(uri, "manifest.json")
+        if not self._client.exists(uri):
+            raise KeyError(f"whiteboard not found: {id_ or storage_uri}")
+        return WhiteboardManifest(json.loads(self._client.read_bytes(uri)))
+
+    def query(self, *, name: Optional[str] = None, tags: Sequence[str] = (),
+              not_before: Optional[datetime.datetime] = None,
+              not_after: Optional[datetime.datetime] = None) -> List[WhiteboardManifest]:
+        out = []
+        for uri in self._client.list(self._root):
+            if not uri.endswith("/manifest.json"):
+                continue
+            m = WhiteboardManifest(json.loads(self._client.read_bytes(uri)))
+            if m.status != FINALIZED:
+                continue
+            if name is not None and m.name != name:
+                continue
+            if tags and not set(tags).issubset(m.tags):
+                continue
+            if not_before is not None and m.created_at < not_before:
+                continue
+            if not_after is not None and m.created_at > not_after:
+                continue
+            out.append(m)
+        out.sort(key=lambda m: m.created_at, reverse=True)
+        return out

@@ -1,0 +1,112 @@
+"""Env-system tests (reference tier: ``pylzy/tests/env``)."""
+
+import pytest
+
+from lzy_tpu.env import (
+    Any,
+    AutoPythonEnv,
+    DockerContainer,
+    LzyEnvironment,
+    ManualPythonEnv,
+    NoPoolError,
+    Provisioning,
+    TpuProvisioning,
+    tpu_requirement,
+)
+from lzy_tpu.env.shortcuts import env_vars, provisioning, tpu
+from lzy_tpu.types import TpuPoolSpec, VmSpec
+
+POOLS = [
+    VmSpec(label="s", cpu_count=4, ram_gb=32),
+    VmSpec(label="m", cpu_count=16, ram_gb=64),
+    TpuPoolSpec(label="tpu-v5e-8", tpu_type="v5e", topology="2x4"),
+    TpuPoolSpec(label="tpu-v5e-16", tpu_type="v5e", topology="4x4"),
+    TpuPoolSpec(label="tpu-v5e-64", tpu_type="v5e", topology="8x8"),
+    TpuPoolSpec(label="tpu-v5p-8", tpu_type="v5p", topology="2x2x2"),
+]
+
+
+class TestProvisioning:
+    def test_default_picks_smallest_cpu_pool(self):
+        assert Provisioning().resolve_pool(POOLS).label == "s"
+
+    def test_cpu_requirements_filter(self):
+        assert Provisioning(cpu_count=8).resolve_pool(POOLS).label == "m"
+
+    def test_no_pool_error_lists_pools(self):
+        with pytest.raises(NoPoolError, match="tpu-v5e-16"):
+            Provisioning(cpu_count=64).resolve_pool(POOLS)
+
+    def test_cpu_provisioning_never_claims_tpu(self):
+        pool = Provisioning(cpu_count=Any, ram_gb=Any).resolve_pool(POOLS)
+        assert isinstance(pool, VmSpec)
+
+
+class TestTpuProvisioning:
+    def test_min_chips_picks_smallest_adequate_slice(self):
+        assert TpuProvisioning(tpu_type="v5e", min_chips=12).resolve_pool(POOLS).label == "tpu-v5e-16"
+
+    def test_exact_topology(self):
+        assert TpuProvisioning(tpu_type="v5e", tpu_topology="8x8").resolve_pool(POOLS).label == "tpu-v5e-64"
+
+    def test_any_type_matches_all_generations(self):
+        pool = TpuProvisioning(tpu_type=Any, min_chips=8).resolve_pool(POOLS)
+        assert pool.chips == 8
+
+    def test_gang_size(self):
+        pool = TpuProvisioning(tpu_type="v5e", min_chips=64).resolve_pool(POOLS)
+        assert pool.hosts == 8  # v5e has 8 chips/host
+
+    def test_shorthand_parsing(self):
+        req = tpu_requirement("v5e-16")
+        assert req.tpu_type == "v5e" and req.min_chips == 16
+        req = tpu_requirement("v5p:2x2x2")
+        assert req.tpu_topology == "2x2x2"
+        with pytest.raises(ValueError):
+            tpu_requirement("16")
+        with pytest.raises(ValueError):
+            tpu_requirement("v5e:4yy4")
+
+
+class TestEnvironmentMerge:
+    def test_env_vars_merge_rightmost_wins(self):
+        merged = env_vars(A="1", B="1").combine(env_vars(B="2", C="2"))
+        assert merged.env_vars == {"A": "1", "B": "2", "C": "2"}
+
+    def test_provisioning_fieldwise_merge(self):
+        base = provisioning(cpu_count=8)
+        call = provisioning(ram_gb=64)
+        merged = base.combine(call)
+        assert merged.provisioning.cpu_count == 8
+        assert merged.provisioning.ram_gb == 64
+
+    def test_kind_switch_replaces(self):
+        base = provisioning(cpu_count=8)
+        call = tpu("v5e-16")
+        merged = base.combine(call)
+        assert isinstance(merged.provisioning, TpuProvisioning)
+        assert merged.provisioning.cpu_count is None  # replaced, not merged
+
+    def test_three_level_merge_order(self):
+        lzy = env_vars(X="lzy").with_container(DockerContainer(image="base"))
+        wf = env_vars(X="wf")
+        call = LzyEnvironment()
+        final = lzy.combine(wf).combine(call)
+        assert final.env_vars["X"] == "wf"
+        assert final.container.image == "base"
+
+
+class TestPythonEnv:
+    def test_auto_captures_interpreter_and_jax(self):
+        spec = AutoPythonEnv().spec()
+        assert spec.python_version.startswith("3.")
+        names = [n.lower() for n, _ in spec.packages]
+        assert "jax" in names  # imported by conftest
+
+    def test_manual_conda_yaml(self):
+        spec = ManualPythonEnv(
+            python_version="3.12", packages={"jax": "0.9.0", "flax": "0.12.0"}
+        ).spec()
+        yaml = spec.to_conda_yaml()
+        assert "python==3.12" in yaml
+        assert "  - jax==0.9.0" in yaml

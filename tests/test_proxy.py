@@ -1,0 +1,111 @@
+"""Lazy-proxy tests (reference tier: ``pylzy/tests/proxy``)."""
+
+import numpy as np
+import pytest
+
+from lzy_tpu.proxy import (
+    get_proxy_entry_id,
+    is_lzy_proxy,
+    lzy_proxy,
+    materialize,
+    materialized,
+)
+
+
+def make(value, typ=None, counter=None):
+    def fn():
+        if counter is not None:
+            counter.append(1)
+        return value
+
+    return lzy_proxy(fn, "entry-1", typ or type(value))
+
+
+def test_materialize_on_touch_only_once():
+    calls = []
+    p = make(41, counter=calls)
+    assert not materialized(p)
+    assert p + 1 == 42
+    assert materialized(p)
+    assert p * 2 == 82
+    assert len(calls) == 1  # cached after first touch
+
+
+def test_attribute_and_method_forwarding():
+    p = make("hello world")
+    assert p.upper() == "HELLO WORLD"
+    assert p.split() == ["hello", "world"]
+    assert len(p) == 11
+    assert "world" in p
+
+
+def test_isinstance_via_fake_class():
+    p = make([1, 2, 3], typ=list)
+    assert isinstance(p, list)
+    assert p.__class__ is list
+
+
+def test_isinstance_before_materialization_uses_declared_type():
+    touched = []
+    p = make({"a": 1}, typ=dict, counter=touched)
+    assert isinstance(p, dict)
+    assert not touched  # isinstance must not trigger materialization
+
+
+def test_arithmetic_both_sides():
+    p = make(10)
+    assert p + 5 == 15
+    assert 5 + p == 15
+    assert 2 * p == 20
+    assert p / 4 == 2.5
+    assert 100 - p == 90
+
+
+def test_comparison_and_hash():
+    p = make(7)
+    assert p == 7 and p < 8 and p >= 7
+    assert hash(p) == hash(7)
+    assert {p: "x"}[7] == "x"
+
+
+def test_container_mutation():
+    p = make([1, 2])
+    p.append(3)
+    p[0] = 0
+    assert materialize(p) == [0, 2, 3]
+    assert list(reversed(p)) == [3, 2, 0]
+
+
+def test_numpy_interop():
+    p = make(np.arange(4.0))
+    out = p + np.ones(4)
+    np.testing.assert_array_equal(out, [1.0, 2.0, 3.0, 4.0])
+    np.testing.assert_array_equal(np.asarray(p), np.arange(4.0))
+
+
+def test_proxy_of_proxy_argument():
+    a = make(3)
+    b = make(4)
+    assert a + b == 7
+
+
+def test_entry_id_and_helpers():
+    p = make(1)
+    assert is_lzy_proxy(p)
+    assert not is_lzy_proxy(1)
+    assert get_proxy_entry_id(p) == "entry-1"
+    assert materialize(5) == 5
+
+
+def test_str_repr_format():
+    p = make(3.5)
+    assert str(p) == "3.5"
+    assert repr(p) == "3.5"
+    assert f"{p:.1f}" == "3.5"
+
+
+def test_pickle_materializes():
+    import pickle
+
+    p = make({"k": 1})
+    assert pickle.loads(pickle.dumps(p)) == {"k": 1}

@@ -1,0 +1,370 @@
+"""SDK-core tests over LocalRuntime (reference tiers: ``pylzy/tests/core`` unit
+tests + the local slices of the scenario suite, SURVEY.md §4.1/§4.4)."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lzy_tpu import Lzy, op, whiteboard
+from lzy_tpu.core.workflow import RemoteCallError, WorkflowError
+from lzy_tpu.proxy import is_lzy_proxy, materialized
+from lzy_tpu.storage import DefaultStorageRegistry, StorageConfig
+
+
+@pytest.fixture()
+def lzy():
+    reg = DefaultStorageRegistry()
+    reg.register_storage("default", StorageConfig(uri="mem://wf"), default=True)
+    return Lzy(storage_registry=reg)
+
+
+@op
+def inc(x: int) -> int:
+    return x + 1
+
+
+@op
+def add(a: int, b: int) -> int:
+    return a + b
+
+
+@op
+def duo(x: int) -> tuple[int, str]:
+    return x * 2, f"v{x}"
+
+
+def test_op_without_workflow_runs_directly():
+    assert inc(1) == 2
+
+
+def test_single_op_lazy_then_materialize(lzy):
+    with lzy.workflow("wf") as wf:
+        r = inc(1)
+        assert is_lzy_proxy(r)
+        assert not materialized(r)
+        assert r == 2  # touch triggers barrier
+        assert materialized(r)
+
+
+def test_chained_ops(lzy):
+    with lzy.workflow("wf") as wf:
+        r = add(inc(1), inc(2))
+    assert r == 5
+
+
+def test_multi_output_op(lzy):
+    with lzy.workflow("wf"):
+        a, b = duo(21)
+        assert a == 42
+        assert b == "v21"
+
+
+def test_barrier_on_exit_without_touch(lzy):
+    log = []
+
+    @op
+    def record(x: int) -> int:
+        log.append(x)
+        return x
+
+    with lzy.workflow("wf"):
+        record(5)
+    assert log == [5]  # executed on workflow exit even untouched
+
+
+def test_exception_reraised_with_remote_traceback(lzy):
+    @op
+    def boom() -> int:
+        raise ValueError("inner failure")
+
+    with pytest.raises(RemoteCallError) as exc_info:
+        with lzy.workflow("wf"):
+            r = boom()
+            _ = r + 1
+    cause = exc_info.value.__cause__
+    assert isinstance(cause, ValueError)
+    assert "inner failure" in str(cause)
+    assert any("remote traceback" in n for n in getattr(cause, "__notes__", []))
+
+
+def test_type_validation_rejects_wrong_arg():
+    lzy_local = Lzy(storage_registry=_mem_registry())
+    with pytest.raises(TypeError, match="expected int"):
+        with lzy_local.workflow("wf"):
+            inc("not an int")
+
+
+def _mem_registry():
+    reg = DefaultStorageRegistry()
+    reg.register_storage("default", StorageConfig(uri="mem://wf2"), default=True)
+    return reg
+
+
+def test_jax_array_through_ops(lzy):
+    @op
+    def scale(x: jnp.ndarray) -> jnp.ndarray:
+        return x * 2.0
+
+    with lzy.workflow("wf"):
+        out = scale(jnp.ones((4, 4), jnp.bfloat16))
+        arr = np.asarray(out)
+    assert arr.shape == (4, 4)
+    np.testing.assert_array_equal(arr, np.full((4, 4), 2.0))
+
+
+def test_bool_and_none_results_materialize_eagerly(lzy):
+    @op
+    def check(x: int) -> bool:
+        return x > 0
+
+    @op
+    def nothing() -> None:
+        return None
+
+    with lzy.workflow("wf"):
+        b = check(3)
+        assert b is True  # real bool, not proxy
+        n = nothing()
+        assert n is None
+
+
+def test_optional_annotations_supported(lzy):
+    from typing import Optional
+
+    @op
+    def maybe(x: Optional[int]) -> Optional[int]:
+        return x
+
+    with lzy.workflow("wf"):
+        assert maybe(5) == 5
+
+
+def test_failed_exit_barrier_aborts_runtime(lzy):
+    """An op failing in the implicit exit barrier must abort, not finish."""
+    from lzy_tpu.runtime.local import LocalRuntime
+
+    events = []
+
+    class SpyRuntime(LocalRuntime):
+        def finish(self, workflow):
+            events.append("finish")
+
+        def abort(self, workflow):
+            events.append("abort")
+
+    spy_lzy = Lzy(storage_registry=_mem_registry(), runtime=SpyRuntime())
+
+    @op
+    def boom() -> int:
+        raise ValueError("late failure")
+
+    with pytest.raises(RemoteCallError):
+        with spy_lzy.workflow("wf"):
+            boom()  # only fails at exit barrier
+    assert events == ["abort"]
+
+
+def test_lazy_arguments_false_forces_producer(lzy):
+    order = []
+
+    @op
+    def produce() -> int:
+        order.append("produce")
+        return 1
+
+    @op(lazy_arguments=False)
+    def consume(x: int) -> int:
+        order.append("consume")
+        return x
+
+    with lzy.workflow("wf"):
+        p = produce()
+        order.append("registering-consume")
+        consume(p)  # registration forces produce() via barrier
+    assert order == ["registering-consume", "produce", "consume"]
+
+
+def test_nested_workflow_forbidden(lzy):
+    with lzy.workflow("outer"):
+        with pytest.raises(WorkflowError, match="already active"):
+            with lzy.workflow("inner"):
+                pass
+
+
+def test_abort_on_user_exception_skips_queue(lzy):
+    log = []
+
+    @op
+    def record(x: int) -> int:
+        log.append(x)
+        return x
+
+    with pytest.raises(RuntimeError, match="user code"):
+        with lzy.workflow("wf"):
+            record(1)
+            raise RuntimeError("user code")
+    assert log == []  # queued call was aborted, not executed
+
+
+class TestCaching:
+    def test_repeated_execs_use_cache(self, lzy):
+        runs = []
+
+        @op(cache=True, version="1.0")
+        def heavy(x: int) -> int:
+            runs.append(x)
+            return x * 10
+
+        for _ in range(2):
+            with lzy.workflow("wf"):
+                r = heavy(4)
+                assert r == 40
+        assert runs == [4]  # second run served from cache
+
+    def test_version_bump_invalidates(self, lzy):
+        runs = []
+
+        def make_op(version):
+            @op(cache=True, version=version)
+            def heavy(x: int) -> int:
+                runs.append(version)
+                return x
+
+            return heavy
+
+        with lzy.workflow("wf"):
+            make_op("1.0")(1)
+        with lzy.workflow("wf"):
+            make_op("2.0")(1)
+        assert runs == ["1.0", "2.0"]
+
+    def test_different_inputs_different_cache_keys(self, lzy):
+        runs = []
+
+        @op(cache=True, version="1.0")
+        def heavy(x: int) -> int:
+            runs.append(x)
+            return x
+
+        with lzy.workflow("wf"):
+            heavy(1)
+        with lzy.workflow("wf"):
+            heavy(2)
+        assert runs == [1, 2]
+
+    def test_cached_op_downstream_of_noncached_producer(self, lzy):
+        """Cache key must be lineage-stable even when the producer is not
+        cached (its output URI is execution-scoped and random)."""
+        runs = []
+
+        @op
+        def produce(n: int) -> int:
+            runs.append("produce")
+            return n + 1
+
+        @op(cache=True, version="1.0")
+        def consume(x: int) -> int:
+            runs.append("consume")
+            return x * 2
+
+        for _ in range(2):
+            with lzy.workflow("wf"):
+                assert consume(produce(1)) == 4
+        assert runs == ["produce", "consume", "produce"]
+
+    def test_kwarg_names_in_cache_key(self, lzy):
+        """f(x=5) and f(y=5) must not collide in the cache."""
+        runs = []
+
+        @op(cache=True, version="1.0")
+        def f(x: int = 0, y: int = 0) -> int:
+            runs.append((x, y))
+            return x - y
+
+        with lzy.workflow("wf"):
+            assert f(x=5) == 5
+        with lzy.workflow("wf"):
+            assert f(y=5) == -5
+        assert runs == [(5, 0), (0, 5)]
+
+    def test_chained_cache_keys_stable_across_runs(self, lzy):
+        runs = []
+
+        @op(cache=True, version="1.0")
+        def first(x: int) -> int:
+            runs.append("first")
+            return x + 1
+
+        @op(cache=True, version="1.0")
+        def second(x: int) -> int:
+            runs.append("second")
+            return x * 2
+
+        for _ in range(2):
+            with lzy.workflow("wf"):
+                r = second(first(1))
+                assert r == 4
+        assert runs == ["first", "second"]
+
+
+class TestWhiteboards:
+    def test_write_finalize_read(self, lzy):
+        @whiteboard("best_model")
+        @dataclasses.dataclass
+        class BestModel:
+            score: float
+            params: dict
+
+        @op
+        def train(seed: int) -> dict:
+            return {"w": seed * 1.5}
+
+        with lzy.workflow("wf") as wf:
+            wb = wf.create_whiteboard(BestModel, tags=["exp1"])
+            wb.params = train(2)  # proxy assignment
+            wb.score = 0.9        # local assignment
+            wb_id = wb.id
+
+        loaded = lzy.whiteboard(id_=wb_id)
+        assert loaded.score == 0.9
+        assert loaded.params == {"w": 3.0}
+        assert loaded.name == "best_model"
+
+    def test_query_by_name_and_tags(self, lzy):
+        @whiteboard("query_wb")
+        @dataclasses.dataclass
+        class Wb:
+            x: int
+
+        for i, tags in enumerate([["a"], ["a", "b"]]):
+            with lzy.workflow("wf") as wf:
+                wb = wf.create_whiteboard(Wb, tags=tags)
+                wb.x = i
+
+        assert len(lzy.whiteboards(name="query_wb")) == 2
+        both = lzy.whiteboards(name="query_wb", tags=["b"])
+        assert len(both) == 1
+        assert both[0].x == 1
+        assert lzy.whiteboards(name="missing") == []
+
+    def test_unassigned_field_fails_finalize(self, lzy):
+        @whiteboard("partial_wb")
+        @dataclasses.dataclass
+        class Wb:
+            x: int
+            y: int
+
+        with pytest.raises(ValueError, match="unassigned"):
+            with lzy.workflow("wf") as wf:
+                wb = wf.create_whiteboard(Wb)
+                wb.x = 1
+
+    def test_non_whiteboard_type_rejected(self, lzy):
+        class Plain:
+            pass
+
+        with lzy.workflow("wf") as wf:
+            with pytest.raises(TypeError, match="not a whiteboard type"):
+                wf.create_whiteboard(Plain)
