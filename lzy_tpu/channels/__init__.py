@@ -1,0 +1,17 @@
+from lzy_tpu.channels.manager import (
+    CONSUMER,
+    PRODUCER,
+    Channel,
+    ChannelFailed,
+    ChannelManager,
+    DeviceResidency,
+)
+
+__all__ = [
+    "CONSUMER",
+    "PRODUCER",
+    "Channel",
+    "ChannelFailed",
+    "ChannelManager",
+    "DeviceResidency",
+]

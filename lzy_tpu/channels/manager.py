@@ -1,0 +1,151 @@
+"""Channel manager: typed data rendezvous between ops.
+
+Counterpart of the reference's channel-manager + slots stack
+(``lzy/channel-manager/.../services/{ChannelService,SlotsService}.java``,
+``lzy/slots/``): a channel is the meeting point of one producer and N consumers
+for one data entry; the *storage peer* is always the durable default consumer,
+so every value lands in storage and any consumer can read it even after the
+producer is gone (SURVEY.md §3.4).
+
+TPU-first redesign: the reference moves every byte through S3 or a gRPC stream.
+Here a channel can additionally hold a **device-resident peer**: when producer
+and consumer share the process (LocalRuntime) or the same slice, a ``jax.Array``
+is handed over by reference — shards stay in HBM, transfers ride ICI when the
+consumer re-shards, and the serialized storage copy is only made for durability
+or cross-slice hops (lazily, on first remote/durable need).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+PRODUCER = "PRODUCER"
+CONSUMER = "CONSUMER"
+
+
+@dataclasses.dataclass
+class Channel:
+    id: str                      # == entry id
+    execution_id: str
+    storage_uri: str             # durable rendezvous (the storage peer)
+    producer_task: Optional[str] = None
+    consumer_tasks: List[str] = dataclasses.field(default_factory=list)
+    completed: bool = False      # storage peer has full data
+    failed: Optional[str] = None
+
+
+class DeviceResidency:
+    """Process-global registry of live device values (jax.Array / pytrees)
+    keyed by entry id — the ICI fast path. Values are kept at most once;
+    eviction is explicit (execution teardown)."""
+
+    def __init__(self) -> None:
+        self._values: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def offer(self, entry_id: str, value: Any) -> None:
+        with self._lock:
+            self._values[entry_id] = value
+
+    def take(self, entry_id: str) -> Optional[Any]:
+        with self._lock:
+            return self._values.get(entry_id)
+
+    def evict_execution(self, entry_ids) -> None:
+        with self._lock:
+            for eid in entry_ids:
+                self._values.pop(eid, None)
+
+    def __contains__(self, entry_id: str) -> bool:
+        with self._lock:
+            return entry_id in self._values
+
+
+class ChannelManager:
+    def __init__(self) -> None:
+        self._channels: Dict[str, Channel] = {}
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self.device = DeviceResidency()
+
+    # -- private API (per-execution lifecycle, ChannelService parity) ----------
+
+    def get_or_create(self, execution_id: str, entry_id: str, storage_uri: str) -> Channel:
+        with self._lock:
+            ch = self._channels.get(entry_id)
+            if ch is None:
+                ch = Channel(id=entry_id, execution_id=execution_id,
+                             storage_uri=storage_uri)
+                self._channels[entry_id] = ch
+            return ch
+
+    def destroy_all(self, execution_id: str) -> None:
+        with self._lock:
+            dead = [cid for cid, ch in self._channels.items()
+                    if ch.execution_id == execution_id]
+            for cid in dead:
+                del self._channels[cid]
+        self.device.evict_execution(dead)
+
+    def get(self, entry_id: str) -> Channel:
+        with self._lock:
+            return self._channels[entry_id]
+
+    # -- public API (slots parity: bind / transfer lifecycle) ------------------
+
+    def bind(self, entry_id: str, role: str, task_id: str) -> Channel:
+        with self._lock:
+            ch = self._channels[entry_id]
+            if role == PRODUCER:
+                ch.producer_task = task_id
+            else:
+                ch.consumer_tasks.append(task_id)
+            return ch
+
+    def transfer_completed(self, entry_id: str) -> None:
+        """Producer finished writing the storage peer; wake waiting consumers."""
+        with self._cv:
+            ch = self._channels[entry_id]
+            ch.completed = True
+            self._cv.notify_all()
+
+    def transfer_failed(self, entry_id: str, error: str) -> None:
+        with self._cv:
+            ch = self._channels[entry_id]
+            ch.failed = error
+            self._cv.notify_all()
+
+    def wait_available(self, entry_id: str,
+                       timeout_s: Optional[float] = 300.0) -> Channel:
+        """Block a consumer until the channel's data is durably available (or a
+        device-resident value exists — the ICI short-circuit). ``timeout_s=None``
+        waits indefinitely (gang peers waiting on a long-running producer;
+        graph-level deadlines govern instead)."""
+        deadline = None if timeout_s is None else time.time() + timeout_s
+        with self._cv:
+            while True:
+                ch = self._channels[entry_id]
+                if ch.failed:
+                    raise ChannelFailed(entry_id, ch.failed)
+                if ch.completed or entry_id in self.device:
+                    return ch
+                if deadline is None:
+                    self._cv.wait(1.0)
+                    continue
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(f"channel {entry_id} not available after {timeout_s}s")
+                self._cv.wait(min(remaining, 1.0))
+
+
+class ChannelFailed(RuntimeError):
+    def __init__(self, entry_id: str, error: str):
+        super().__init__(f"channel {entry_id} failed: {error}")
+        self.entry_id = entry_id

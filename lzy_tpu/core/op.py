@@ -51,7 +51,7 @@ class LzyOp(WithEnvironmentMixin):
             return self.func(*args, **kwargs)
 
         signature = infer_and_validate_call_signature(
-            self.func, *args, output_types=self.output_types, **kwargs
+            self.func, *args, output_types=self.output_types, payload=self, **kwargs
         )
         env = wf.owner.env.combine(wf.env).combine(self.env)
         call = LzyCall(
@@ -69,6 +69,33 @@ class LzyOp(WithEnvironmentMixin):
         if instance is None:
             return self
         return functools.partial(self, instance)
+
+    def __reduce__(self):
+        """Pickle by module reference when this op is a module-level attribute
+        (the common case) — the remote worker then resolves the very same
+        object instead of receiving a closure copy. Matters for in-process
+        workers (shared state stays shared) and keeps payloads tiny for real
+        remote ones. Falls back to by-value for notebook/local defs."""
+        import sys
+
+        target = sys.modules.get(getattr(self, "__module__", None))
+        try:
+            for part in self.__qualname__.split("."):
+                target = getattr(target, part)
+        except AttributeError:
+            target = None
+        if target is self:
+            return (_resolve_op, (self.__module__, self.__qualname__))
+        return super().__reduce__()
+
+
+def _resolve_op(module: str, qualname: str) -> "LzyOp":
+    import importlib
+
+    obj = importlib.import_module(module)
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    return obj
 
 
 @overload

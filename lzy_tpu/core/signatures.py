@@ -26,6 +26,14 @@ class CallSignature:
     arg_types: Tuple[Optional[Type], ...]
     kwarg_types: Dict[str, Optional[Type]]
     output_types: Tuple[Optional[Type], ...]
+    # the object to ship to remote workers; for module-level @op functions this
+    # is the LzyOp wrapper, which cloudpickle serializes BY REFERENCE (the
+    # module attribute is the wrapper itself), avoiding closure copies
+    payload: Optional[Any] = None
+
+    @property
+    def remote_payload(self) -> Any:
+        return self.payload if self.payload is not None else self.func
 
     @property
     def name(self) -> str:
@@ -79,6 +87,7 @@ def infer_and_validate_call_signature(
     func: Callable,
     *args: Any,
     output_types: Optional[Tuple[Type, ...]] = None,
+    payload: Optional[Any] = None,
     **kwargs: Any,
 ) -> CallSignature:
     sig = inspect.signature(func)
@@ -119,6 +128,7 @@ def infer_and_validate_call_signature(
         arg_types=tuple(arg_types),
         kwarg_types=kwarg_types,
         output_types=tuple(output_types),
+        payload=payload,
     )
 
 

@@ -1,0 +1,22 @@
+from lzy_tpu.durable.store import DONE, FAILED, RUNNING, OperationStore, OpRecord
+from lzy_tpu.durable.runner import (
+    OperationRunner,
+    OperationsExecutor,
+    Outcome,
+    StepResult,
+)
+from lzy_tpu.durable.failures import InjectedCrash, InjectedFailures
+
+__all__ = [
+    "DONE",
+    "FAILED",
+    "RUNNING",
+    "OperationStore",
+    "OpRecord",
+    "OperationRunner",
+    "OperationsExecutor",
+    "Outcome",
+    "StepResult",
+    "InjectedCrash",
+    "InjectedFailures",
+]

@@ -1,0 +1,63 @@
+"""Injected-failure hook points.
+
+Counterpart of the reference's ``InjectedFailures`` lists
+(``lzy-service/.../debug/InjectedFailures.java:9-53``, allocator's 15 hook
+points, GE2's list): tests arm a named hook with a failure; when execution
+passes the hook the process "crashes" (the durable op is left RUNNING in the
+store, exactly as a killed service would leave it) and restart tests assert
+resume-from-step behavior.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+
+class InjectedCrash(BaseException):
+    """Simulated hard crash; the operation runner does NOT mark the op failed —
+    it simply stops, like a killed process."""
+
+
+class InjectedFailures:
+    _hooks: Dict[str, Callable[[], Optional[BaseException]]] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def arm(cls, point: str, n_hits: int = 1) -> None:
+        """Crash the n-th time execution reaches ``point``."""
+        counter = {"left": n_hits}
+
+        def hook() -> Optional[BaseException]:
+            counter["left"] -= 1
+            if counter["left"] <= 0:
+                cls.disarm(point)
+                return InjectedCrash(f"injected crash at {point}")
+            return None
+
+        with cls._lock:
+            cls._hooks[point] = hook
+
+    @classmethod
+    def disarm(cls, point: str) -> None:
+        with cls._lock:
+            cls._hooks.pop(point, None)
+
+    @classmethod
+    def clear(cls) -> None:
+        with cls._lock:
+            cls._hooks.clear()
+
+    @classmethod
+    def hit(cls, point: str) -> None:
+        """Call at a hook point; raises InjectedCrash if armed."""
+        with cls._lock:
+            hook = cls._hooks.get(point)
+        if hook is not None:
+            err = hook()
+            if err is not None:
+                raise err
+
+    @staticmethod
+    def is_injected(e: BaseException) -> bool:
+        return isinstance(e, InjectedCrash)

@@ -1,0 +1,174 @@
+"""RemoteRuntime: executes the call queue through the control plane.
+
+Counterpart of ``RemoteRuntime`` (``pylzy/lzy/api/v1/remote/runtime.py:100-441``):
+``__build_graph`` converts the queue into task descriptions (pickled op function,
+slot/entry assignments, pool resolution via provisioning scoring), submits to the
+workflow service, polls graph status, streams remote std-logs with
+``[LZY-REMOTE-<task>]`` prefixes, and on failure downloads the pickled exception
+and re-raises it with the remote traceback (``runtime.py:193-205``).
+
+The ``client`` is any object with the WorkflowService method surface — the
+in-process service itself, or a gRPC stub with the same signatures.
+"""
+
+from __future__ import annotations
+
+import pickle
+import sys
+import time
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence
+
+import cloudpickle
+
+from lzy_tpu.core.workflow import RemoteCallError
+from lzy_tpu.env.provisioning import Provisioning
+from lzy_tpu.runtime.api import Runtime
+from lzy_tpu.service.graph import EntryRef, GraphDesc, TaskDesc
+from lzy_tpu.storage.api import join_uri
+from lzy_tpu.utils.ids import gen_id
+from lzy_tpu.utils.log import get_logger
+
+if TYPE_CHECKING:
+    from lzy_tpu.core.call import LzyCall
+    from lzy_tpu.core.workflow import LzyWorkflow
+
+_LOG = get_logger(__name__)
+
+
+class RemoteRuntime(Runtime):
+    def __init__(self, client, *, user: str = "local-user",
+                 poll_period_s: float = 0.05, stream_logs: bool = True,
+                 graph_timeout_s: float = 600.0):
+        self._client = client
+        self._user = user
+        self._poll_period_s = poll_period_s
+        self._stream_logs = stream_logs
+        self._graph_timeout_s = graph_timeout_s
+        self._executions: Dict[str, str] = {}   # workflow exec id (client side = server side)
+        self._printed_logs: Dict[str, int] = {}
+
+    # -- Runtime ---------------------------------------------------------------
+
+    def start(self, workflow: "LzyWorkflow") -> None:
+        config = workflow.owner.storage_registry.default_config()
+        execution_id = self._client.start_workflow(
+            self._user, workflow.name, config.uri,
+            execution_id=workflow.execution_id,
+        )
+        self._executions[workflow.execution_id] = execution_id
+
+    def finish(self, workflow: "LzyWorkflow") -> None:
+        self._client.finish_workflow(workflow.execution_id)
+        self._executions.pop(workflow.execution_id, None)
+
+    def abort(self, workflow: "LzyWorkflow") -> None:
+        try:
+            self._client.abort_workflow(workflow.execution_id)
+        finally:
+            self._executions.pop(workflow.execution_id, None)
+
+    def exec(self, workflow: "LzyWorkflow", calls: Sequence["LzyCall"]) -> None:
+        graph = self._build_graph(workflow, calls)
+        graph_op_id = self._client.execute_graph(workflow.execution_id, graph.to_doc())
+        if graph_op_id is None:
+            _LOG.info("results of all graph operations are cached")
+        else:
+            self._poll_until_done(workflow, graph_op_id, calls)
+        for call in calls:
+            for eid in call.result_entry_ids:
+                workflow.snapshot.try_restore_entry(eid)
+
+    # -- graph build (reference __build_graph) ---------------------------------
+
+    def _build_graph(self, workflow: "LzyWorkflow",
+                     calls: Sequence["LzyCall"]) -> GraphDesc:
+        snapshot = workflow.snapshot
+        config = workflow.owner.storage_registry.default_config()
+        pools = self._client.get_pool_specs()
+        tasks: List[TaskDesc] = []
+        for call in calls:
+            prov = call.env.provisioning or Provisioning()
+            pool = prov.resolve_pool(pools)
+            func_uri = join_uri(snapshot.storage_prefix, "fns", call.id)
+            snapshot.storage_client.write_bytes(
+                func_uri, cloudpickle.dumps(call.signature.remote_payload)
+            )
+
+            def ref(eid: str, name: str = "") -> EntryRef:
+                entry = snapshot.get_entry(eid)
+                return EntryRef(id=eid, uri=entry.storage_uri, name=name)
+
+            tasks.append(TaskDesc(
+                id=call.id,
+                name=call.op_name,
+                func_uri=func_uri,
+                args=[ref(eid, n) for n, eid in
+                      zip(call.signature.param_names, call.arg_entry_ids)],
+                kwargs={k: ref(eid, k) for k, eid in call.kwarg_entry_ids.items()},
+                outputs=[ref(eid, f"return_{i}")
+                         for i, eid in enumerate(call.result_entry_ids)],
+                exception=ref(call.exception_entry_id, "exception"),
+                pool_label=pool.label,
+                gang_size=pool.hosts,
+                env_vars=dict(call.env.env_vars),
+                std_logs_uri=join_uri(snapshot.storage_prefix, "logs"),
+            ))
+        return GraphDesc(
+            id=gen_id("graph"),
+            execution_id=workflow.execution_id,
+            storage_uri=config.uri,
+            tasks=tasks,
+        )
+
+    # -- polling (reference poll loop, runtime.py:178-205) ---------------------
+
+    def _poll_until_done(self, workflow: "LzyWorkflow", graph_op_id: str,
+                         calls: Sequence["LzyCall"]) -> None:
+        deadline = time.time() + self._graph_timeout_s
+        while True:
+            status = self._client.graph_status(workflow.execution_id, graph_op_id)
+            if self._stream_logs:
+                self._pump_logs(workflow)
+            if status["status"] == "DONE":
+                return
+            if status["status"] == "FAILED":
+                self._raise_remote(workflow, status, calls)
+            if time.time() > deadline:
+                self._client.stop_graph(workflow.execution_id, graph_op_id)
+                raise TimeoutError(
+                    f"graph {graph_op_id} still running after {self._graph_timeout_s}s"
+                )
+            time.sleep(self._poll_period_s)
+
+    def _pump_logs(self, workflow: "LzyWorkflow") -> None:
+        try:
+            logs = self._client.read_std_logs(
+                workflow.execution_id, dict(self._printed_logs)
+            )
+        except Exception:
+            return
+        for task_id, fresh in logs.items():
+            self._printed_logs[task_id] = (
+                self._printed_logs.get(task_id, 0) + len(fresh.encode("utf-8"))
+            )
+            for line in fresh.splitlines():
+                print(f"[LZY-REMOTE-{task_id}] {line}", file=sys.stderr)
+
+    def _raise_remote(self, workflow: "LzyWorkflow", status: Dict,
+                      calls: Sequence["LzyCall"]) -> None:
+        exception_uri = status.get("exception_uri")
+        failed_call = next(
+            (c for c in calls if c.id == status.get("failed_task")), None
+        )
+        name = failed_call.op_name if failed_call else (status.get("failed_task") or "?")
+        if exception_uri:
+            client = workflow.snapshot.storage_client
+            try:
+                cause = pickle.loads(client.read_bytes(exception_uri))
+            except Exception as load_err:
+                cause = RuntimeError(
+                    f"remote failure (exception not loadable: {load_err}): "
+                    f"{status.get('error')}"
+                )
+            raise RemoteCallError(name, cause) from cause
+        raise RemoteCallError(name, RuntimeError(status.get("error") or "unknown"))

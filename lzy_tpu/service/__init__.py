@@ -1,0 +1,25 @@
+from lzy_tpu.service.allocator import AllocatorService, Vm, VmBackend
+from lzy_tpu.service.backends import GkeTpuBackend, ThreadVmBackend
+from lzy_tpu.service.graph import EntryRef, GraphDesc, GraphValidationError, TaskDesc
+from lzy_tpu.service.graph_executor import GraphExecutor
+from lzy_tpu.service.harness import DEFAULT_POOLS, InProcessCluster
+from lzy_tpu.service.worker import WorkerAgent, current_gang
+from lzy_tpu.service.workflow_service import WorkflowService
+
+__all__ = [
+    "AllocatorService",
+    "Vm",
+    "VmBackend",
+    "GkeTpuBackend",
+    "ThreadVmBackend",
+    "EntryRef",
+    "GraphDesc",
+    "GraphValidationError",
+    "TaskDesc",
+    "GraphExecutor",
+    "DEFAULT_POOLS",
+    "InProcessCluster",
+    "WorkerAgent",
+    "current_gang",
+    "WorkflowService",
+]

@@ -1,0 +1,112 @@
+"""VM backends.
+
+``ThreadVmBackend`` — the reference's ``ThreadVmAllocator``
+(``lzy/allocator/.../alloc/impl/ThreadVmAllocator.java:30``) promoted to a
+first-class local backend: a "VM" is a worker agent running in this process.
+It powers LocalRuntime-grade dev loops, the in-process cluster harness, and all
+tests.
+
+``GkeTpuBackend`` — the production path skeleton: provisions TPU slice node
+pools / pod slices via the Kubernetes API the way ``KuberVmAllocator``
+(``alloc/impl/kuber/KuberVmAllocator.java:47``) creates VM pods. Gated on a
+kubernetes client being importable; the control-plane contract (launch →
+worker registers → heartbeats) is identical to the thread backend, which is
+what the rest of the system is tested against.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from lzy_tpu.channels.manager import ChannelManager
+from lzy_tpu.serialization import SerializerRegistry
+from lzy_tpu.service.allocator import Vm, VmBackend
+from lzy_tpu.service.worker import WorkerAgent
+from lzy_tpu.storage.api import StorageClient
+from lzy_tpu.types import PoolSpec
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+
+class ThreadVmBackend(VmBackend):
+    def __init__(
+        self,
+        channels: ChannelManager,
+        storage_client: StorageClient,
+        serializers: Optional[SerializerRegistry] = None,
+        *,
+        heartbeat_period_s: float = 1.0,
+        launch_delay_s: float = 0.0,      # simulate boot latency in tests
+    ):
+        self._channels = channels
+        self._storage = storage_client
+        self._serializers = serializers
+        self._heartbeat_period_s = heartbeat_period_s
+        self._launch_delay_s = launch_delay_s
+        self._agents: Dict[str, WorkerAgent] = {}
+        self._lock = threading.Lock()
+        self.allocator = None             # wired by the harness after both exist
+
+    def launch(self, vm: Vm, pool: PoolSpec) -> None:
+        # idempotent: a durable-op resume may re-request hosts already booting
+        with self._lock:
+            if vm.id in self._agents:
+                return
+            self._agents[vm.id] = None  # booking marker
+
+        def boot() -> None:
+            if self._launch_delay_s:
+                import time
+
+                time.sleep(self._launch_delay_s)
+            agent = WorkerAgent(
+                vm.id,
+                allocator=self.allocator,
+                channels=self._channels,
+                storage_client=self._storage,
+                serializers=self._serializers,
+                heartbeat_period_s=self._heartbeat_period_s,
+            )
+            with self._lock:
+                self._agents[vm.id] = agent
+            try:
+                agent.start()
+            except KeyError:
+                # allocation was rolled back while booting
+                agent.stop()
+                with self._lock:
+                    self._agents.pop(vm.id, None)
+
+        threading.Thread(target=boot, name=f"boot-{vm.id}", daemon=True).start()
+
+    def destroy(self, vm: Vm) -> None:
+        with self._lock:
+            agent = self._agents.pop(vm.id, None)
+        if agent is not None:
+            agent.stop()
+
+
+class GkeTpuBackend(VmBackend):
+    """Cloud path: one Vm record = one TPU host pod in a slice node pool."""
+
+    def __init__(self, *, namespace: str = "lzy-tpu", image: str = ""):
+        try:
+            import kubernetes  # type: ignore # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "GkeTpuBackend requires the kubernetes python client, which is "
+                "not installed in this environment; use ThreadVmBackend"
+            ) from e
+        self._namespace = namespace
+        self._image = image
+
+    def launch(self, vm: Vm, pool: PoolSpec) -> None:  # pragma: no cover
+        raise NotImplementedError(
+            "GKE pod-slice provisioning is wired in a cloud deployment; "
+            "see SURVEY.md §7 step 3"
+        )
+
+    def destroy(self, vm: Vm) -> None:  # pragma: no cover
+        raise NotImplementedError

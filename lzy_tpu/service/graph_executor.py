@@ -1,0 +1,259 @@
+"""Graph executor: DAG → gang-scheduled task executions.
+
+Counterpart of graph-executor-2, which merged the v1 executor and the scheduler
+(SURVEY.md §2.2): a durable graph operation drives a ready-frontier scheduler
+with per-execution concurrency limits (``TasksSchedulerImpl.java:41``, limits
+``:192-207``), and each task runs as its own durable action with the reference's
+step chain allocateVm → awaitVmAllocation → executeOp → awaitExecution → cleanup
+(``ExecuteTaskAction.java:93``) — generalized so "allocate" means *gang*
+allocation of every host of a TPU slice and "execute" launches the same SPMD
+program on each host.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from lzy_tpu.durable import (
+    DONE,
+    FAILED,
+    OperationRunner,
+    OperationsExecutor,
+    OperationStore,
+    StepResult,
+)
+from lzy_tpu.service.allocator import AllocatorService
+from lzy_tpu.service.graph import GraphDesc, TaskDesc, build_dependencies
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+WAITING = "WAITING"
+RUNNING = "RUNNING"
+COMPLETED = "COMPLETED"
+TASK_FAILED = "FAILED"
+
+
+class GraphExecutor:
+    def __init__(
+        self,
+        store: OperationStore,
+        executor: OperationsExecutor,
+        allocator: AllocatorService,
+        *,
+        max_running_tasks: int = 8,
+        poll_period_s: float = 0.05,
+    ):
+        self._store = store
+        self._executor = executor
+        self._allocator = allocator
+        self.max_running_tasks = max_running_tasks
+        self.poll_period_s = poll_period_s
+        executor.register("exec_graph", self._make_graph_action)
+        executor.register("exec_task", self._make_task_action)
+
+    def execute(self, graph: GraphDesc, session_id: str) -> str:
+        build_dependencies(graph.tasks)  # validate before accepting
+        return self._executor.submit(
+            "exec_graph",
+            {"graph": graph.to_doc(), "session_id": session_id, "tasks": {}},
+            idempotency_key=f"graph-{graph.id}",
+        )
+
+    def status(self, graph_op_id: str) -> Dict[str, Any]:
+        record = self._store.load(graph_op_id)
+        return {
+            "status": record.status,
+            "error": record.error,
+            "tasks": record.state.get("tasks", {}),
+            "failed_task": record.state.get("failed_task"),
+            "exception_uri": record.state.get("exception_uri"),
+        }
+
+    def stop(self, graph_op_id: str) -> None:
+        """Cooperative stop via a dedicated kv flag (NOT the op state: the
+        scheduler's own save_progress would race and overwrite a state-based
+        flag); the scheduler loop checks it each round."""
+        self._store.kv_put("graph_stops", graph_op_id, True)
+
+    def await_graph(self, graph_op_id: str, timeout_s: float = 300.0):
+        return self._executor.await_op(graph_op_id, timeout_s)
+
+    def _make_graph_action(self, record, store, executor):
+        return _ExecGraphAction(record, store, executor, self)
+
+    def _make_task_action(self, record, store, executor):
+        return _ExecTaskAction(record, store, executor, self)
+
+
+class _ExecGraphAction(OperationRunner):
+    """Ready-frontier scheduler as one durable polling step."""
+
+    kind = "exec_graph"
+
+    def __init__(self, record, store, executor, svc: GraphExecutor):
+        super().__init__(record, store, executor)
+        self.svc = svc
+
+    def steps(self):
+        return [
+            ("init_tasks", self._init_tasks),
+            ("schedule", self._schedule),
+        ]
+
+    def _init_tasks(self):
+        graph = GraphDesc.from_doc(self.state["graph"])
+        deps = build_dependencies(graph.tasks)
+        self.state["deps"] = {tid: sorted(d) for tid, d in deps.items()}
+        self.state["tasks"] = {
+            t.id: {"status": WAITING, "op_id": None, "name": t.name}
+            for t in graph.tasks
+        }
+        return StepResult.CONTINUE
+
+    def _schedule(self):
+        self.hook("schedule")
+        graph = GraphDesc.from_doc(self.state["graph"])
+        tasks = self.state["tasks"]
+        by_id = {t.id: t for t in graph.tasks}
+
+        # poll running task actions
+        for tid, info in tasks.items():
+            if info["status"] == RUNNING:
+                record = self.store.load(info["op_id"])
+                if record.status == DONE:
+                    info["status"] = COMPLETED
+                elif record.status == FAILED:
+                    info["status"] = TASK_FAILED
+                    self.state["failed_task"] = tid
+                    self.state["exception_uri"] = record.state.get("exception_uri")
+                    # persist failure details before the runner marks us FAILED;
+                    # the client reads them from the op state to re-raise the
+                    # original exception
+                    self.store.save_progress(self.record.id, self.state,
+                                             self.record.step)
+                    raise RuntimeError(
+                        f"task {info['name']} ({tid}) failed: {record.error}"
+                    )
+
+        if self.store.kv_get("graph_stops", self.record.id):
+            raise RuntimeError("graph stopped by user")
+
+        running = sum(1 for i in tasks.values() if i["status"] == RUNNING)
+        for tid, info in tasks.items():
+            if info["status"] != WAITING or running >= self.svc.max_running_tasks:
+                continue
+            if all(tasks[d]["status"] == COMPLETED for d in self.state["deps"][tid]):
+                info["op_id"] = self.executor.submit(
+                    "exec_task",
+                    {"task": by_id[tid].to_doc(),
+                     "session_id": self.state["session_id"],
+                     "graph_id": graph.id},
+                    idempotency_key=f"task-{graph.id}-{tid}",
+                )
+                info["status"] = RUNNING
+                running += 1
+
+        if all(i["status"] == COMPLETED for i in tasks.values()):
+            return StepResult.finish({"tasks": tasks})
+        return StepResult.restart(self.svc.poll_period_s)
+
+    def on_failed(self, error):
+        # stop-the-world for still-running tasks is cooperative: their actions
+        # complete but the graph is already failed (reference keeps op-level
+        # granularity, SURVEY.md §5.3 "no elasticity")
+        _LOG.warning("graph %s failed: %s", self.record.id, error)
+
+
+class _ExecTaskAction(OperationRunner):
+    kind = "exec_task"
+
+    def __init__(self, record, store, executor, svc: GraphExecutor):
+        super().__init__(record, store, executor)
+        self.svc = svc
+
+    def steps(self):
+        return [
+            ("allocate", self._allocate),
+            ("await_allocation", self._await_allocation),
+            ("execute", self._execute),
+            ("await_execution", self._await_execution),
+            ("cleanup", self._cleanup),
+        ]
+
+    @property
+    def task(self) -> TaskDesc:
+        return TaskDesc.from_doc(self.state["task"])
+
+    def _allocate(self):
+        self.hook("allocate")
+        if self.state.get("alloc_op_id"):
+            return StepResult.ALREADY_DONE
+        self.state["alloc_op_id"] = self.svc._allocator.allocate(
+            self.state["session_id"], self.task.pool_label
+        )
+        return StepResult.CONTINUE
+
+    def _await_allocation(self):
+        record = self.store.load(self.state["alloc_op_id"])
+        if record.status == FAILED:
+            raise RuntimeError(f"gang allocation failed: {record.error}")
+        if record.status != DONE:
+            return StepResult.restart(self.svc.poll_period_s)
+        self.state["vm_ids"] = record.result["vm_ids"]
+        self.state["gang_id"] = record.result["gang_id"]
+        return StepResult.CONTINUE
+
+    def _execute(self):
+        self.hook("execute")
+        if self.state.get("worker_op_ids"):
+            return StepResult.ALREADY_DONE
+        task = self.task
+        vm_ids = self.state["vm_ids"]
+        gang = {"gang_id": self.state["gang_id"], "vm_ids": vm_ids}
+        worker_ops = {}
+        for rank, vm_id in enumerate(vm_ids):
+            agent = self.svc._allocator.agent(vm_id)
+            agent.init(owner=self.state["session_id"])
+            worker_ops[vm_id] = agent.execute(task, rank, gang)
+        self.state["worker_op_ids"] = worker_ops
+        return StepResult.CONTINUE
+
+    def _await_execution(self):
+        task = self.task
+        statuses = []
+        for vm_id, worker_op in self.state["worker_op_ids"].items():
+            try:
+                agent = self.svc._allocator.agent(vm_id)
+                statuses.append(agent.status(worker_op))
+            except KeyError:
+                statuses.append({"status": "FAILED",
+                                 "error": f"vm {vm_id} lost", "exception_uri": None})
+        failed = [s for s in statuses if s["status"] == "FAILED"]
+        if failed:
+            self.state["exception_uri"] = next(
+                (s["exception_uri"] for s in failed if s.get("exception_uri")), None
+            )
+            # persist exception_uri before the runner marks the op FAILED
+            self.store.save_progress(self.record.id, self.state, self.record.step)
+            self._free()
+            raise RuntimeError(f"task {task.name} failed: {failed[0]['error']}")
+        if all(s["status"] == "DONE" for s in statuses):
+            return StepResult.CONTINUE
+        return StepResult.restart(self.svc.poll_period_s)
+
+    def _cleanup(self):
+        self._free()
+        return StepResult.finish({"vm_ids": self.state.get("vm_ids", [])})
+
+    def _free(self):
+        vm_ids = self.state.get("vm_ids")
+        if vm_ids:
+            self.svc._allocator.free(vm_ids)
+
+    def on_failed(self, error):
+        self._free()
+
+    def on_expired(self):
+        self._free()

@@ -1,0 +1,102 @@
+"""In-process cluster harness.
+
+Counterpart of the reference's ``LzyInThread``
+(``test-context/src/main/java/ai/lzy/test/context/LzyInThread.java:14-70``),
+which boots every service in one JVM for multi-node semantics without a
+cluster: one metadata store + durable executor + allocator (thread VMs) +
+channel manager + graph executor + workflow service, and an ``lzy()`` factory
+returning a fully wired SDK facade on the RemoteRuntime. This is also the
+local single-machine deployment mode, not just a test rig.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from lzy_tpu.channels.manager import ChannelManager
+from lzy_tpu.core.lzy import Lzy
+from lzy_tpu.durable import OperationsExecutor, OperationStore
+from lzy_tpu.runtime.remote import RemoteRuntime
+from lzy_tpu.serialization import default_registry
+from lzy_tpu.service.allocator import AllocatorService
+from lzy_tpu.service.backends import ThreadVmBackend
+from lzy_tpu.service.graph_executor import GraphExecutor
+from lzy_tpu.service.workflow_service import WorkflowService
+from lzy_tpu.storage import DefaultStorageRegistry, StorageConfig
+from lzy_tpu.storage.registry import client_for
+from lzy_tpu.types import PoolSpec, TpuPoolSpec, VmSpec
+
+DEFAULT_POOLS: List[PoolSpec] = [
+    # CPU default mirrors the reference's 4 vCPU / 32 GB pool
+    # (docs/tutorials/3-basics.md:42); TPU pools per BASELINE configs
+    VmSpec(label="cpu-small", cpu_count=4, ram_gb=32),
+    VmSpec(label="cpu-large", cpu_count=16, ram_gb=128),
+    TpuPoolSpec(label="tpu-v5e-8", tpu_type="v5e", topology="2x4"),
+    TpuPoolSpec(label="tpu-v5e-16", tpu_type="v5e", topology="4x4"),
+    TpuPoolSpec(label="tpu-v5e-64", tpu_type="v5e", topology="8x8"),
+]
+
+
+class InProcessCluster:
+    def __init__(
+        self,
+        *,
+        storage_uri: str = "mem://cluster",
+        db_path: str = ":memory:",
+        pools: Optional[Sequence[PoolSpec]] = None,
+        workers: int = 4,
+        max_running_tasks: int = 8,
+        poll_period_s: float = 0.02,
+        vm_boot_delay_s: float = 0.0,
+    ):
+        self.storage_uri = storage_uri
+        self.store = OperationStore(db_path)
+        self.executor = OperationsExecutor(self.store, workers=workers)
+        self.channels = ChannelManager()
+        self.serializers = default_registry()
+        self.storage_client = client_for(StorageConfig(uri=storage_uri))
+        self.backend = ThreadVmBackend(
+            self.channels, self.storage_client, self.serializers,
+            launch_delay_s=vm_boot_delay_s,
+        )
+        self.allocator = AllocatorService(
+            self.store, self.executor, self.backend, pools or DEFAULT_POOLS
+        )
+        self.backend.allocator = self.allocator
+        self.graph_executor = GraphExecutor(
+            self.store, self.executor, self.allocator,
+            max_running_tasks=max_running_tasks, poll_period_s=poll_period_s,
+        )
+        self.workflow_service = WorkflowService(
+            self.store, self.executor, self.allocator, self.channels,
+            self.graph_executor, self.storage_client,
+        )
+
+    @property
+    def client(self) -> WorkflowService:
+        """In-process 'stub': same method surface a gRPC client would have."""
+        return self.workflow_service
+
+    def lzy(self, *, user: str = "test-user", stream_logs: bool = False,
+            poll_period_s: float = 0.02) -> Lzy:
+        storage = DefaultStorageRegistry()
+        storage.register_storage(
+            "default", StorageConfig(uri=self.storage_uri), default=True
+        )
+        return Lzy(
+            runtime=RemoteRuntime(
+                self.client, user=user, poll_period_s=poll_period_s,
+                stream_logs=stream_logs,
+            ),
+            storage_registry=storage,
+            serializer_registry=self.serializers,
+        )
+
+    def resume_pending_operations(self) -> int:
+        """Crash-recovery entry: re-enqueue all RUNNING durable ops
+        (``LzyService.restartNotCompletedOps`` parity)."""
+        return self.executor.restore()
+
+    def shutdown(self) -> None:
+        self.executor.shutdown()
+        self.store.close()

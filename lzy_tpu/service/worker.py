@@ -1,0 +1,282 @@
+"""Worker agent: the on-VM execution engine.
+
+Counterpart of the reference worker (``lzy/worker/.../WorkerApiImpl.java:48`` —
+Init/Execute with an in-process LRO service) plus the remote entrypoint
+(``pylzy/lzy/api/v1/startup.py:185-229``): read inputs from channels, run the
+op, write outputs/exception, pump stdout/stderr to the log plane. The
+``AllocatorAgent`` register+heartbeat timer
+(``allocator-api/.../AllocatorAgent.java:26-110``) is folded in.
+
+TPU-first notes:
+- inputs take the device-residency fast path when the value is already in HBM
+  on this slice (ICI), falling back to the durable storage peer;
+- a gang task runs SPMD: every host executes the same program. Under the
+  in-process thread backend only host 0 executes the function body (one
+  process = one JAX runtime; the program would collide with itself), while
+  ranks>0 participate in the gang barrier — control-plane semantics stay
+  identical, and real multi-host SPMD execution is exercised via the GKE-style
+  backend and the multichip dryrun (``__graft_entry__.dryrun_multichip``).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import io
+import pickle
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional
+
+from lzy_tpu.channels.manager import ChannelManager, ChannelFailed, CONSUMER, PRODUCER
+from lzy_tpu.serialization import SerializerRegistry, default_registry
+from lzy_tpu.service.graph import TaskDesc
+from lzy_tpu.storage.api import StorageClient, join_uri
+from lzy_tpu.utils.ids import gen_id
+from lzy_tpu.utils.log import get_logger, logging_context
+
+_LOG = get_logger(__name__)
+
+# gang context visible to user code through lzy_tpu.parallel.gang_info()
+_GANG: contextvars.ContextVar[Optional[Dict[str, Any]]] = contextvars.ContextVar(
+    "lzy_gang", default=None
+)
+
+
+def current_gang() -> Optional[Dict[str, Any]]:
+    return _GANG.get()
+
+
+class _StdRouter(io.TextIOBase):
+    """Thread-safe stdout/stderr tee: lines from a task thread go to that
+    task's log buffer (and the real stream); other threads pass through.
+    Installed once per process — the analog of the worker's Kafka log pump
+    (``WorkerApiImpl.java:161-165``)."""
+
+    _route: contextvars.ContextVar = contextvars.ContextVar("lzy_stdroute", default=None)
+
+    def __init__(self, real):
+        self._real = real
+
+    def write(self, s: str) -> int:
+        buf = self._route.get()
+        if buf is not None:
+            buf.write(s)
+        return self._real.write(s)
+
+    def flush(self) -> None:
+        self._real.flush()
+
+    @classmethod
+    def install(cls) -> None:
+        if not isinstance(sys.stdout, cls):
+            sys.stdout = cls(sys.stdout)
+        if not isinstance(sys.stderr, cls):
+            sys.stderr = cls(sys.stderr)
+
+
+class WorkerAgent:
+    """One per VM/host. ``execute`` returns an operation id immediately
+    (LocalOperationService parity); the graph executor polls ``status``."""
+
+    def __init__(
+        self,
+        vm_id: str,
+        *,
+        allocator,                        # AllocatorService (register/heartbeat)
+        channels: ChannelManager,
+        storage_client: StorageClient,
+        serializers: Optional[SerializerRegistry] = None,
+        heartbeat_period_s: float = 5.0,
+    ):
+        self.vm_id = vm_id
+        self._allocator = allocator
+        self._channels = channels
+        self._storage = storage_client
+        self._serializers = serializers or default_registry()
+        self._ops: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._owner: Optional[str] = None
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, args=(heartbeat_period_s,),
+            name=f"hb-{vm_id}", daemon=True,
+        )
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        self._allocator.register_vm(self.vm_id, self)
+        self._hb_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _heartbeat_loop(self, period_s: float) -> None:
+        while not self._stop.wait(period_s):
+            try:
+                self._allocator.heartbeat(self.vm_id)
+            except Exception:
+                _LOG.warning("heartbeat failed for %s", self.vm_id)
+
+    # -- WorkerApi.Init / Execute parity ---------------------------------------
+
+    def init(self, owner: str) -> None:
+        """Take ownership for an execution (``WorkerApiImpl.init:230``)."""
+        self._owner = owner
+
+    def execute(self, task: TaskDesc, gang_rank: int, gang: Dict[str, Any]) -> str:
+        op_id = gen_id("workerop")
+        with self._lock:
+            self._ops[op_id] = {"status": "RUNNING", "error": None,
+                                "exception_uri": None}
+        thread = threading.Thread(
+            target=self._run, args=(op_id, task, gang_rank, gang),
+            name=f"task-{task.name}-r{gang_rank}", daemon=True,
+        )
+        thread.start()
+        return op_id
+
+    def status(self, op_id: str) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._ops[op_id])
+
+    # -- execution -------------------------------------------------------------
+
+    def _run(self, op_id: str, task: TaskDesc, gang_rank: int,
+             gang: Dict[str, Any]) -> None:
+        _StdRouter.install()
+        log_buf = io.StringIO()
+        token_route = _StdRouter._route.set(log_buf if gang_rank == 0 else None)
+        token_gang = _GANG.set({"rank": gang_rank, "size": task.gang_size, **gang})
+        try:
+            with logging_context(task=task.id, vm=self.vm_id, rank=str(gang_rank)):
+                self._execute_task(task, gang_rank)
+            with self._lock:
+                self._ops[op_id]["status"] = "DONE"
+        except BaseException as e:
+            tb = traceback.format_exc()
+            _LOG.error("task %s failed on %s: %s", task.id, self.vm_id, tb)
+            exception_uri = None
+            if gang_rank == 0 and not isinstance(e, ChannelFailed):
+                exception_uri = self._store_exception(task, e, tb)
+                for out in task.outputs:
+                    try:
+                        self._channels.transfer_failed(out.id, repr(e))
+                    except KeyError:
+                        pass
+            with self._lock:
+                self._ops[op_id].update(
+                    status="FAILED", error=repr(e), exception_uri=exception_uri
+                )
+        finally:
+            _GANG.reset(token_gang)
+            _StdRouter._route.reset(token_route)
+            if gang_rank == 0:
+                self._flush_logs(task, log_buf.getvalue())
+
+    def _execute_task(self, task: TaskDesc, gang_rank: int) -> None:
+        for ref in task.input_entries:
+            self._channels.bind(ref.id, CONSUMER, task.id)
+        for ref in task.outputs:
+            self._channels.bind(ref.id, PRODUCER, task.id)
+
+        if gang_rank != 0:
+            # non-zero ranks of an in-process gang: wait for host 0's outputs
+            # (real multi-host backends run the SPMD program here instead).
+            # No timeout: a healthy training op can run for hours; the graph
+            # deadline is the backstop.
+            for out in task.outputs:
+                self._channels.wait_available(out.id, timeout_s=None)
+            return
+
+        args = [self._read_entry(ref) for ref in task.args]
+        kwargs = {k: self._read_entry(ref) for k, ref in task.kwargs.items()}
+        func = self._load_func(task.func_uri)
+
+        result = func(*args, **kwargs)
+
+        n_out = len(task.outputs)
+        outputs = result if n_out > 1 and isinstance(result, tuple) else (result,)
+        if len(outputs) != n_out:
+            raise ValueError(
+                f"op {task.name}() returned {len(outputs)} values, declared {n_out}"
+            )
+        for ref, value in zip(task.outputs, outputs):
+            self._write_entry(ref, value)
+            self._channels.transfer_completed(ref.id)
+
+    # -- data plane (startup.py read_data/write_data parity) -------------------
+
+    def _read_entry(self, ref) -> Any:
+        self._channels.wait_available(ref.id)
+        device_value = self._channels.device.take(ref.id)
+        if device_value is not None:
+            return device_value  # ICI fast path: value never left the slice
+        meta = self._read_meta(ref.uri)
+        serializer = self._serializers.find_by_format(meta["data_format"])
+        src = self._storage.open_read(ref.uri)
+        try:
+            return serializer.deserialize(src)
+        finally:
+            src.close()
+
+    def _write_entry(self, ref, value: Any) -> None:
+        import json
+
+        self._channels.device.offer(ref.id, value)
+        serializer = self._serializers.find_by_instance(value)
+        buf = io.BytesIO()
+        serializer.serialize(value, buf)
+        data = buf.getvalue()
+        self._storage.write_bytes(ref.uri, data)
+        from lzy_tpu.utils import hashing
+
+        scheme = serializer.data_scheme(value)
+        self._storage.write_bytes(
+            ref.uri + ".meta",
+            json.dumps({
+                "hash": hashing.hash_bytes(data),
+                "data_format": scheme.data_format,
+                "schema_content": scheme.schema_content,
+                "meta": scheme.meta,
+            }).encode("utf-8"),
+        )
+
+    def _read_meta(self, uri: str) -> Dict[str, Any]:
+        import json
+
+        return json.loads(self._storage.read_bytes(uri + ".meta").decode("utf-8"))
+
+    def _load_func(self, func_uri: str):
+        data = self._storage.read_bytes(func_uri)
+        obj = pickle.loads(data)
+        # an LzyOp wrapper (shipped by reference for module-level ops) unwraps
+        # to its function: there is no active workflow on the worker, so the
+        # wrapper would run eagerly anyway — unwrapping skips re-validation
+        func = getattr(obj, "func", None)
+        return func if callable(func) else obj
+
+    def _store_exception(self, task: TaskDesc, e: BaseException, tb: str) -> str:
+        try:
+            e.add_note(f"[remote traceback from {self.vm_id}]\n{tb}")
+        except AttributeError:
+            pass
+        import cloudpickle
+
+        try:
+            payload = cloudpickle.dumps(e)
+        except Exception:
+            payload = cloudpickle.dumps(RuntimeError(f"{e!r} (unpicklable)\n{tb}"))
+        self._storage.write_bytes(task.exception.uri, payload)
+        return task.exception.uri
+
+    def _flush_logs(self, task: TaskDesc, text: str) -> None:
+        if not text or not task.std_logs_uri:
+            return
+        uri = join_uri(task.std_logs_uri, f"{task.id}.log")
+        try:
+            self._storage.write_bytes(uri, text.encode("utf-8"))
+        except Exception:
+            _LOG.warning("failed to flush logs for task %s", task.id)

@@ -1,0 +1,171 @@
+"""Workflow service: the public control-plane API.
+
+Counterpart of the reference lzy-service (``lzy/lzy-service/.../LzyService.java:44``):
+workflow lifecycle (start/finish/abort), graph execution orchestration with the
+ExecuteGraph step chain — checkCache → (zone/pool) → buildDataflowGraph →
+createChannels → buildTasks → executeGraph (``operations/graph/ExecuteGraph.java:52``) —
+graph status/stop, pool listing, and std-log access. In-process callers invoke
+methods directly; a gRPC binding can wrap this object 1:1.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from lzy_tpu.channels.manager import ChannelManager
+from lzy_tpu.durable import OperationsExecutor, OperationStore
+from lzy_tpu.service.allocator import AllocatorService
+from lzy_tpu.service.graph import GraphDesc, build_dependencies
+from lzy_tpu.service.graph_executor import GraphExecutor
+from lzy_tpu.storage.api import StorageClient, join_uri
+from lzy_tpu.utils.ids import gen_id
+from lzy_tpu.utils.log import get_logger
+from lzy_tpu.types import PoolSpec
+
+_LOG = get_logger(__name__)
+
+ACTIVE = "ACTIVE"
+FINISHED = "FINISHED"
+ABORTED = "ABORTED"
+
+
+class WorkflowService:
+    def __init__(
+        self,
+        store: OperationStore,
+        executor: OperationsExecutor,
+        allocator: AllocatorService,
+        channels: ChannelManager,
+        graph_executor: GraphExecutor,
+        storage_client: StorageClient,
+    ):
+        self._store = store
+        self._executor = executor
+        self._allocator = allocator
+        self._channels = channels
+        self._ge = graph_executor
+        self._storage = storage_client
+
+    # -- workflow lifecycle (startWorkflow/finishWorkflow/abortWorkflow) -------
+
+    def start_workflow(self, user: str, workflow_name: str, storage_uri: str,
+                       execution_id: Optional[str] = None) -> str:
+        execution_id = execution_id or gen_id(f"exec-{workflow_name}")
+        session_id = self._allocator.create_session(owner=user)
+        self._store.kv_put("executions", execution_id, {
+            "user": user,
+            "workflow_name": workflow_name,
+            "storage_uri": storage_uri,
+            "session_id": session_id,
+            "status": ACTIVE,
+            "graphs": [],
+            "started_at": time.time(),
+        })
+        _LOG.info("started execution %s (session %s)", execution_id, session_id)
+        return execution_id
+
+    def finish_workflow(self, execution_id: str) -> None:
+        self._teardown(execution_id, FINISHED)
+
+    def abort_workflow(self, execution_id: str) -> None:
+        exec_doc = self._execution(execution_id)
+        for graph_op_id in exec_doc.get("graphs", []):
+            try:
+                self._ge.stop(graph_op_id)
+            except KeyError:
+                pass
+        self._teardown(execution_id, ABORTED)
+
+    def _teardown(self, execution_id: str, final_status: str) -> None:
+        exec_doc = self._execution(execution_id)
+        self._channels.destroy_all(execution_id)
+        self._allocator.delete_session(exec_doc["session_id"])
+        exec_doc["status"] = final_status
+        exec_doc["finished_at"] = time.time()
+        self._store.kv_put("executions", execution_id, exec_doc)
+
+    def _execution(self, execution_id: str) -> Dict[str, Any]:
+        doc = self._store.kv_get("executions", execution_id)
+        if doc is None:
+            raise KeyError(f"unknown execution {execution_id!r}")
+        return doc
+
+    # -- graphs (executeGraph/graphStatus/stopGraph) ---------------------------
+
+    def execute_graph(self, execution_id: str, graph_doc: Dict[str, Any]) -> Optional[str]:
+        """Compile + run a graph. Returns the graph op id, or None when every
+        task was satisfied from cache ("Results of all graph operations are
+        cached", ``remote/runtime.py:170-172``)."""
+        exec_doc = self._execution(execution_id)
+        if exec_doc["status"] != ACTIVE:
+            raise RuntimeError(f"execution {execution_id} is {exec_doc['status']}")
+        graph = GraphDesc.from_doc(graph_doc)
+        build_dependencies(graph.tasks)                      # cycle/dup check
+
+        # CheckCache: drop tasks whose outputs are already durable
+        remaining = [t for t in graph.tasks if not self._cached(t)]
+        dropped = {t.id for t in graph.tasks} - {t.id for t in remaining}
+        if dropped:
+            _LOG.info("cache drops %d/%d tasks", len(dropped), len(graph.tasks))
+
+        # CreateChannels: every entry of the remaining tasks gets a channel;
+        # channels for inputs that already exist in storage open completed
+        produced = {o.id for t in remaining for o in t.outputs}
+        for t in remaining:
+            for ref in t.outputs + t.input_entries:
+                ch = self._channels.get_or_create(execution_id, ref.id, ref.uri)
+                if ref.id not in produced and not ch.completed:
+                    if self._storage.exists(ref.uri):
+                        self._channels.transfer_completed(ref.id)
+
+        if not remaining:
+            return None
+        graph = GraphDesc(id=graph.id, execution_id=execution_id,
+                          storage_uri=graph.storage_uri, tasks=remaining)
+        graph_op_id = self._ge.execute(graph, exec_doc["session_id"])
+        exec_doc["graphs"].append(graph_op_id)
+        self._store.kv_put("executions", execution_id, exec_doc)
+        return graph_op_id
+
+    def _cached(self, task) -> bool:
+        return all(
+            self._storage.exists(o.uri) and self._storage.exists(o.uri + ".meta")
+            for o in task.outputs
+        )
+
+    def graph_status(self, execution_id: str, graph_op_id: str) -> Dict[str, Any]:
+        return self._ge.status(graph_op_id)
+
+    def stop_graph(self, execution_id: str, graph_op_id: str) -> None:
+        self._ge.stop(graph_op_id)
+
+    # -- pools (getAvailablePools / VmPoolService parity) ----------------------
+
+    def get_pool_specs(self) -> List[PoolSpec]:
+        return self._allocator.pools
+
+    # -- std logs (readStdSlots parity, poll-based with resume offsets) --------
+
+    def read_std_logs(self, execution_id: str,
+                      offsets: Optional[Dict[str, int]] = None) -> Dict[str, str]:
+        """Task id → stdout/stderr bytes past the caller's offset. Offset-
+        resumable like the reference's Kafka listener offsets
+        (``KafkaLogsListeners.java:24-139``); only the execution's own log
+        prefix is listed and only fresh suffixes are transferred."""
+        offsets = offsets or {}
+        exec_doc = self._execution(execution_id)
+        prefix = join_uri(
+            exec_doc["storage_uri"], "lzy_runs",
+            exec_doc["workflow_name"], execution_id, "logs",
+        )
+        out: Dict[str, str] = {}
+        for uri in self._storage.list(prefix):
+            if not uri.endswith(".log"):
+                continue
+            task_id = uri.rsplit("/", 1)[1][:-4]
+            seen = offsets.get(task_id, 0)
+            size = self._storage.size(uri)
+            if size > seen:
+                out[task_id] = self._storage.read_range(uri, seen).decode("utf-8")
+        return out

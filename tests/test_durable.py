@@ -1,0 +1,213 @@
+"""Durable-operation kernel tests, modeled on the reference's restart tests
+(``LzyServiceRestartTests``, ``RestartExecuteGraphTest`` — SURVEY.md §4.3):
+kill mid-operation via injected failures, then "reboot" the service and assert
+resume from the persisted step."""
+
+import threading
+import time
+
+import pytest
+
+from lzy_tpu.durable import (
+    DONE,
+    FAILED,
+    RUNNING,
+    InjectedFailures,
+    OperationRunner,
+    OperationsExecutor,
+    OperationStore,
+    StepResult,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clear_failures():
+    yield
+    InjectedFailures.clear()
+
+
+@pytest.fixture()
+def store(tmp_path):
+    s = OperationStore(str(tmp_path / "meta.db"))
+    yield s
+    s.close()
+
+
+def make_executor(store, runners):
+    ex = OperationsExecutor(store, workers=2)
+    for kind, factory in runners.items():
+        ex.register(kind, factory)
+    return ex
+
+
+class ThreeStep(OperationRunner):
+    kind = "three_step"
+    log = []
+
+    def steps(self):
+        return [
+            ("a", self._a),
+            ("b", self._b),
+            ("c", self._c),
+        ]
+
+    def _a(self):
+        self.hook("a")
+        self.log.append("a")
+        self.state["a_done"] = True
+        return StepResult.CONTINUE
+
+    def _b(self):
+        self.hook("b")
+        self.log.append("b")
+        self.state["b_done"] = True
+        return StepResult.CONTINUE
+
+    def _c(self):
+        self.log.append("c")
+        return StepResult.finish({"ok": True, **self.state})
+
+
+def test_steps_run_in_order_and_persist(store):
+    ThreeStep.log = []
+    ex = make_executor(store, {"three_step": ThreeStep})
+    op_id = ex.submit("three_step", {"x": 1})
+    record = ex.await_op(op_id, timeout_s=10)
+    assert record.status == DONE
+    assert record.result == {"ok": True, "x": 1, "a_done": True, "b_done": True}
+    assert ThreeStep.log == ["a", "b", "c"]
+    ex.shutdown()
+
+
+def test_idempotency_key_dedup(store):
+    ThreeStep.log = []
+    ex = make_executor(store, {"three_step": ThreeStep})
+    id1 = ex.submit("three_step", {}, idempotency_key="k1")
+    id2 = ex.submit("three_step", {}, idempotency_key="k1")
+    assert id1 == id2
+    ex.await_op(id1, timeout_s=10)
+    assert ThreeStep.log.count("a") == 1
+    ex.shutdown()
+
+
+def test_crash_and_restart_resumes_from_persisted_step(store):
+    """The restart discipline: crash at step b, reboot, resume at b (a is NOT
+    re-run)."""
+    ThreeStep.log = []
+    InjectedFailures.arm("three_step.b")
+    ex1 = make_executor(store, {"three_step": ThreeStep})
+    op_id = ex1.submit("three_step", {})
+    time.sleep(0.5)
+    record = store.load(op_id)
+    assert record.status == RUNNING  # crashed, not failed
+    assert record.step == 1          # step a persisted
+    assert record.state["a_done"] is True
+    ex1.shutdown()
+
+    # "reboot": fresh executor over the same store
+    ex2 = make_executor(store, {"three_step": ThreeStep})
+    assert ex2.restore() == 1
+    final = ex2.await_op(op_id, timeout_s=10)
+    assert final.status == DONE
+    assert ThreeStep.log == ["a", "b", "c"]  # a exactly once
+    ex2.shutdown()
+
+
+class Polling(OperationRunner):
+    kind = "polling"
+    ready_at = 0.0
+
+    def steps(self):
+        return [("poll", self._poll)]
+
+    def _poll(self):
+        self.state["polls"] = self.state.get("polls", 0) + 1
+        if time.time() < Polling.ready_at:
+            return StepResult.restart(0.05)
+        return StepResult.finish(self.state["polls"])
+
+
+def test_restart_outcome_polls_until_ready(store):
+    Polling.ready_at = time.time() + 0.4
+    ex = make_executor(store, {"polling": Polling})
+    op_id = ex.submit("polling", {})
+    record = ex.await_op(op_id, timeout_s=10)
+    assert record.status == DONE
+    assert record.result >= 2  # several poll rounds happened
+    ex.shutdown()
+
+
+class Failing(OperationRunner):
+    kind = "failing"
+    compensated = []
+
+    def steps(self):
+        return [("die", self._die)]
+
+    def _die(self):
+        raise RuntimeError("boom")
+
+    def on_failed(self, error):
+        Failing.compensated.append(str(error))
+
+
+def test_terminal_failure_marks_failed_and_compensates(store):
+    Failing.compensated = []
+    ex = make_executor(store, {"failing": Failing})
+    op_id = ex.submit("failing", {})
+    record = ex.await_op(op_id, timeout_s=10)
+    assert record.status == FAILED
+    assert "boom" in record.error
+    assert Failing.compensated == ["boom"]
+    ex.shutdown()
+
+
+class Sleepy(OperationRunner):
+    kind = "sleepy"
+    expired = []
+
+    def steps(self):
+        return [("wait", lambda: StepResult.restart(0.05))]
+
+    def on_expired(self):
+        Sleepy.expired.append(self.record.id)
+
+
+def test_deadline_expiry(store):
+    Sleepy.expired = []
+    ex = make_executor(store, {"sleepy": Sleepy})
+    op_id = ex.submit("sleepy", {}, deadline_s=0.3)
+    record = ex.await_op(op_id, timeout_s=10)
+    assert record.status == FAILED
+    assert "deadline" in record.error
+    assert Sleepy.expired == [op_id]
+    ex.shutdown()
+
+
+def test_concurrent_operations(store):
+    done = []
+
+    class Worker(OperationRunner):
+        kind = "worker"
+
+        def steps(self):
+            return [("go", self._go)]
+
+        def _go(self):
+            time.sleep(0.02)
+            done.append(self.record.id)
+            return StepResult.finish(None)
+
+    ex = make_executor(store, {"worker": Worker})
+    ids = [ex.submit("worker", {"i": i}) for i in range(10)]
+    for op_id in ids:
+        ex.await_op(op_id, timeout_s=10)
+    assert sorted(done) == sorted(ids)
+    ex.shutdown()
+
+
+def test_unknown_kind_rejected(store):
+    ex = make_executor(store, {})
+    with pytest.raises(KeyError, match="no runner registered"):
+        ex.submit("ghost", {})
+    ex.shutdown()
