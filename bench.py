@@ -1,0 +1,102 @@
+"""Benchmark: flagship train-step MFU on the available accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline per BASELINE.md north star: 40% MFU for an @op train step
+(the reference publishes no numbers of its own; 0.40 MFU is the target the
+TPU build must reach, so vs_baseline = achieved_mfu / 0.40).
+
+Runs on whatever jax.devices() provides: the driver's single real TPU chip,
+or CPU for local sanity (tiny shapes, placeholder peak).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def pick_config(platform: str):
+    """Model + batch sized for the target: ~350M-param Llama on one v5e chip
+    (fits params + adam moments in 16 GB HBM with room for activations)."""
+    from lzy_tpu.models.llama import LlamaConfig
+
+    if platform in ("tpu", "axon"):
+        cfg = LlamaConfig(
+            vocab_size=32_768, d_model=1024, n_layers=20, n_heads=8,
+            n_kv_heads=8, d_ff=4096, max_seq_len=2048, remat=False,
+            tie_embeddings=True, use_flash_kernel=True,
+        )
+        batch_size, seq_len = 8, 2048
+        steps, warmup = 20, 3
+    else:
+        cfg = LlamaConfig.tiny(vocab_size=2048)
+        batch_size, seq_len = 4, 128
+        steps, warmup = 3, 1
+    return cfg, batch_size, seq_len, steps, warmup
+
+
+def main() -> None:
+    from lzy_tpu.models import count_params, llama, unbox
+    from lzy_tpu.parallel import PEAK_TFLOPS, TrainState, make_train_step, mesh_for, mfu
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    chip = "v5e" if platform in ("tpu", "axon") else "cpu"
+    cfg, batch_size, seq_len, steps, warmup = pick_config(platform)
+
+    mesh = mesh_for(fsdp=-1)
+    boxed, axes = llama.init_params(cfg, jax.random.PRNGKey(0))
+    params = unbox(boxed)
+    n_params = count_params(params)
+
+    tx = optax.adamw(3e-4)
+    step, shard_state, _ = make_train_step(
+        llama.make_loss_fn(cfg), tx, mesh=mesh, param_logical_axes=axes,
+        batch_logical_axes=("batch", "seq"),
+    )
+    state = shard_state(TrainState.create(params, tx))
+    batch = {
+        "tokens": jax.random.randint(
+            jax.random.PRNGKey(1), (batch_size, seq_len), 0, cfg.vocab_size
+        )
+    }
+
+    # hard sync via host transfer: each step consumes the previous state, so
+    # materializing the last loss proves the whole chain executed
+    # (block_until_ready alone does not flush on relayed TPU platforms)
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    final_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_s = batch_size * seq_len * steps / dt
+    achieved_mfu = mfu(tokens_per_s, n_params, len(devices), chip=chip)
+
+    print(json.dumps({
+        "metric": "llama_train_step_mfu",
+        "value": round(achieved_mfu, 4),
+        "unit": "mfu_fraction",
+        "vs_baseline": round(achieved_mfu / 0.40, 4),
+        "detail": {
+            "platform": platform,
+            "chips": len(devices),
+            "params": n_params,
+            "tokens_per_s": round(tokens_per_s, 1),
+            "step_time_ms": round(1000 * dt / steps, 2),
+            "batch": batch_size,
+            "seq_len": seq_len,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

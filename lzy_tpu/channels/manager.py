@@ -119,6 +119,8 @@ class ChannelManager:
     def transfer_failed(self, entry_id: str, error: str) -> None:
         with self._cv:
             ch = self._channels[entry_id]
+            if ch.completed:
+                return  # durable data already landed; late failure is moot
             ch.failed = error
             self._cv.notify_all()
 

@@ -141,6 +141,7 @@ class OperationsExecutor:
         while True:
             record = self._store.load(op_id)
             if record.done:
+                self._waiters.pop(op_id, None)  # don't leak one event per op
                 return record
             remaining = deadline - time.time()
             if remaining <= 0:

@@ -1,0 +1,26 @@
+from lzy_tpu.models import bert, llama, resnet
+from lzy_tpu.models.common import (
+    count_params,
+    cross_entropy_loss,
+    param_logical_axes,
+    unbox,
+)
+from lzy_tpu.models.bert import BertConfig, BertMlm
+from lzy_tpu.models.llama import Llama, LlamaConfig
+from lzy_tpu.models.resnet import ResNet, ResNetConfig
+
+__all__ = [
+    "bert",
+    "llama",
+    "resnet",
+    "count_params",
+    "cross_entropy_loss",
+    "param_logical_axes",
+    "unbox",
+    "BertConfig",
+    "BertMlm",
+    "Llama",
+    "LlamaConfig",
+    "ResNet",
+    "ResNetConfig",
+]

@@ -1,0 +1,161 @@
+"""BERT encoder for masked-LM pretraining — BASELINE config 3 (multi-host
+BERT-base on v5e-16, the north-star MFU metric).
+
+Same TPU-first conventions as the Llama module: bf16 compute / f32 params,
+logical-axis annotations on every parameter, optional remat.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from lzy_tpu.models.common import cross_entropy_loss
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30_522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.0          # pretrain benchmarking default
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "BertConfig":
+        return BertConfig(vocab_size=vocab_size, d_model=64, n_layers=2,
+                          n_heads=4, d_ff=128, max_seq_len=128)
+
+
+def _dense(cfg, features, name, axes):
+    bias_rank = len(features) if isinstance(features, tuple) else 1
+    return nn.DenseGeneral(
+        features=features, use_bias=True, name=name,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), axes
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros, axes[-bias_rank:]
+        ),
+    )
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask):
+        cfg = self.cfg
+        b, t, _ = x.shape
+        h, d = cfg.n_heads, cfg.head_dim
+
+        q = _dense(cfg, (h, d), "q_proj", ("embed", "heads", "head_dim"))(x)
+        k = _dense(cfg, (h, d), "k_proj", ("embed", "heads", "head_dim"))(x)
+        v = _dense(cfg, (h, d), "v_proj", ("embed", "heads", "head_dim"))(x)
+        q, k, v = (jnp.transpose(a, (0, 2, 1, 3)) for a in (q, k, v))
+
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * (d ** -0.5)
+        s = jnp.where(attn_mask[:, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        attn = jnp.transpose(attn, (0, 2, 1, 3)).reshape(b, t, h * d)
+        attn = nn.DenseGeneral(
+            features=cfg.d_model, name="o_proj",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("heads_merged", "embed")
+            ),
+        )(attn)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="attn_norm")(x + attn)
+
+        ff = _dense(cfg, cfg.d_ff, "ff_in", ("embed", "mlp"))(x)
+        ff = nn.gelu(ff)
+        ff = _dense(cfg, cfg.d_model, "ff_out", ("mlp", "embed"))(ff)
+        return nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype,
+                            param_dtype=cfg.param_dtype, name="ff_norm")(x + ff)
+
+
+class BertMlm(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, attn_mask=None):
+        cfg = self.cfg
+        if attn_mask is None:
+            attn_mask = jnp.ones_like(tokens, bool)
+        emb = self.param(
+            "tok_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            (cfg.vocab_size, cfg.d_model), cfg.param_dtype,
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("seq", "embed")
+            ),
+            (cfg.max_seq_len, cfg.d_model), cfg.param_dtype,
+        )
+        t = tokens.shape[1]
+        x = (emb.astype(cfg.dtype)[tokens]
+             + pos.astype(cfg.dtype)[None, :t])
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="embed_norm")(x)
+
+        layer = EncoderLayer
+        if cfg.remat:
+            layer = nn.remat(EncoderLayer)
+        for i in range(cfg.n_layers):
+            x = layer(cfg, name=f"layer_{i}")(x, attn_mask)
+
+        # MLM head with tied embeddings
+        x = _dense(cfg, cfg.d_model, "mlm_transform", ("embed", "embed_out"))(x)
+        x = nn.gelu(x)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="mlm_norm")(x)
+        return jnp.einsum("bte,ve->btv", x.astype(jnp.float32),
+                          emb.astype(jnp.float32))
+
+
+def init_params(cfg: BertConfig, rng: jax.Array, seq_len: int = 8):
+    from lzy_tpu.models.common import param_logical_axes
+
+    model = BertMlm(cfg)
+    boxed = model.init(rng, jnp.zeros((1, seq_len), jnp.int32))["params"]
+    return boxed, param_logical_axes(boxed)
+
+
+def make_loss_fn(cfg: BertConfig):
+    """MLM loss: ``batch = {tokens, labels, mlm_mask}``; positions where
+    ``mlm_mask`` is 1 are masked positions whose original token is in labels."""
+    model = BertMlm(cfg)
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["tokens"],
+                             batch.get("attn_mask"))
+        return cross_entropy_loss(logits, batch["labels"], batch["mlm_mask"])
+
+    return loss_fn

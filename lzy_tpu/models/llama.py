@@ -1,0 +1,262 @@
+"""Llama-family decoder (RMSNorm + RoPE + GQA + SwiGLU), TPU-first.
+
+The flagship model for BASELINE config 4 (Llama-3-8B FSDP on v5e-64). Design
+for the MXU/HBM (SURVEY.md §6 north star):
+
+- bfloat16 activations and matmuls (``dtype``), float32 master params
+  (``param_dtype``) — the MXU's native mixed precision;
+- every parameter carries logical axes via ``nn.with_logical_partitioning``,
+  so one rule table (``lzy_tpu.parallel.sharding.DEFAULT_RULES``) lays the
+  model out for FSDP/TP/SP and XLA inserts the collectives;
+- optional per-layer remat (``jax.checkpoint``) trades FLOPs for HBM at long
+  sequence lengths;
+- attention switches to ring attention over the ``sp`` axis for
+  sequence-parallel long-context training (``lzy_tpu.parallel.ring``), and to
+  the fused Pallas flash kernel on real TPU (``lzy_tpu.ops.flash_attention``).
+
+No reference counterpart exists (the reference is a workflow platform, not a
+tensor framework — SURVEY.md §2.4); architecture follows the public Llama-3
+configuration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from lzy_tpu.models.common import cross_entropy_loss
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14_336
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    tie_embeddings: bool = False         # Llama-3 uses an untied lm_head
+    use_ring_attention: bool = False     # sequence parallelism over 'sp'
+    use_flash_kernel: bool = False       # Pallas kernel (TPU only)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "LlamaConfig":
+        """Test/dryrun shape: same code paths, toy dims."""
+        return LlamaConfig(
+            vocab_size=vocab_size, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=128, max_seq_len=256, remat=False,
+            tie_embeddings=True,
+        )
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding; x: [B, T, H, D]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # [B,T,1,D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+            (x.shape[-1],), self.param_dtype,
+        )
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        y = x.astype(jnp.float32) * jax.lax.rsqrt(var + self.eps)
+        return (y * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, mesh=None):
+        cfg = self.cfg
+        dense = lambda features, name, axes: nn.DenseGeneral(  # noqa: E731
+            features=features, axis=-1, use_bias=False, name=name,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), axes
+            ),
+        )
+        b, t, _ = x.shape
+        h, kv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        q = dense((h, d), "q_proj", ("embed", "heads", "head_dim"))(x)
+        k = dense((kv, d), "k_proj", ("embed", "kv", "head_dim"))(x)
+        v = dense((kv, d), "v_proj", ("embed", "kv", "head_dim"))(x)
+
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+        # GQA: repeat kv groups up to full heads
+        reps = h // kv
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+
+        # [B, H, T, D] layout for attention
+        q, k, v = (jnp.transpose(a, (0, 2, 1, 3)) for a in (q, k, v))
+
+        if cfg.use_ring_attention and mesh is not None:
+            from lzy_tpu.parallel.ring import ring_attention
+
+            out = ring_attention(q, k, v, mesh=mesh, causal=True)
+        elif cfg.use_flash_kernel and t % 128 == 0:
+            # lane-aligned sequences take the Pallas kernel; tiny traces
+            # (init, smoke shapes) fall through to the dense path
+            from lzy_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=True)
+        else:
+            # portable fallback: chunked online-softmax attention — O(T·block)
+            # activations, never the T×T score matrix (lzy_tpu/ops/attention)
+            from lzy_tpu.ops.attention import chunked_attention
+
+            block = next(bs for bs in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1)
+                         if t % bs == 0)
+            out = chunked_attention(q, k, v, causal=True, block_size=block)
+
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * d)
+        return nn.DenseGeneral(
+            features=cfg.d_model, use_bias=False, name="o_proj",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("heads_merged", "embed")
+            ),
+        )(out)
+
+
+class Mlp(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+
+        def dense(features, name, axes):
+            return nn.DenseGeneral(
+                features=features, use_bias=False, name=name,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), axes
+                ),
+            )
+
+        gate = dense(cfg.d_ff, "gate_proj", ("embed", "mlp"))(x)
+        up = dense(cfg.d_ff, "up_proj", ("embed", "mlp"))(x)
+        return dense(cfg.d_model, "down_proj", ("mlp", "embed"))(
+            nn.silu(gate) * up
+        )
+
+
+class DecoderLayer(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, mesh=None):
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attn_norm")(x),
+            positions, mesh,
+        )
+        x = x + Mlp(cfg, name="mlp")(
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="mlp_norm")(x)
+        )
+        return x
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, mesh=None):
+        cfg = self.cfg
+        emb = self.param(
+            "embed_tokens",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            (cfg.vocab_size, cfg.d_model), cfg.param_dtype,
+        )
+        x = emb.astype(cfg.dtype)[tokens]
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1]), tokens.shape
+        )
+        layer = DecoderLayer
+        if cfg.remat:
+            layer = nn.remat(
+                DecoderLayer, static_argnums=(),
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+        for i in range(cfg.n_layers):
+            x = layer(cfg, name=f"layer_{i}")(x, positions, mesh)
+        x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            head = emb
+        else:
+            head = self.param(
+                "lm_head",
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02), ("vocab", "embed")
+                ),
+                (cfg.vocab_size, cfg.d_model), cfg.param_dtype,
+            )
+        # bf16 operands on the MXU, f32 accumulation — an f32×f32 head matmul
+        # would run ~4x slower for no useful precision (loss is f32 anyway)
+        return jnp.einsum(
+            "bte,ve->btv", x.astype(cfg.dtype), head.astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+
+def init_params(cfg: LlamaConfig, rng: jax.Array, seq_len: int = 8):
+    """Returns (boxed_params, logical_axes). Unbox with models.common.unbox."""
+    from lzy_tpu.models.common import param_logical_axes
+
+    model = Llama(cfg)
+    tokens = jnp.zeros((1, seq_len), jnp.int32)
+    boxed = model.init(rng, tokens)["params"]
+    return boxed, param_logical_axes(boxed)
+
+
+def make_loss_fn(cfg: LlamaConfig, mesh=None):
+    """Causal-LM loss: predict tokens[t+1] from tokens[:t]."""
+    model = Llama(cfg)
+
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        logits = model.apply({"params": params}, tokens, mesh)
+        mask = batch.get("mask")
+        return cross_entropy_loss(
+            logits[:, :-1], tokens[:, 1:],
+            mask[:, 1:] if mask is not None else None,
+        )
+
+    return loss_fn

@@ -1,0 +1,131 @@
+"""ResNet-50 for image classification fine-tuning — BASELINE config 2
+(single-host v5e-8 ``@op``).
+
+TPU notes: convolutions map onto the MXU as implicit GEMMs; NHWC layout is
+XLA's native TPU convolution layout. Normalization is GroupNorm rather than
+BatchNorm: it is batch-independent, so the SPMD train step needs no
+cross-device batch-stat sync and no mutable state — the standard choice for
+sharded fine-tuning (params-only TrainState).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from lzy_tpu.models.common import cross_entropy_loss
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)     # ResNet-50
+    width: int = 64
+    groups: int = 32
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @staticmethod
+    def resnet50(num_classes: int = 1000) -> "ResNetConfig":
+        return ResNetConfig(num_classes=num_classes)
+
+    @staticmethod
+    def tiny(num_classes: int = 10) -> "ResNetConfig":
+        return ResNetConfig(num_classes=num_classes, stage_sizes=(1, 1),
+                            width=16, groups=8)
+
+
+def _conv(cfg, features, kernel, strides, name):
+    return nn.Conv(
+        features=features, kernel_size=kernel, strides=strides,
+        use_bias=False, name=name, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.he_normal(),
+            ("conv_spatial", "conv_spatial", "channels_in", "channels_out"),
+        ),
+    )
+
+
+class Bottleneck(nn.Module):
+    cfg: ResNetConfig
+    features: int
+    strides: Tuple[int, int]
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gn = lambda name: nn.GroupNorm(  # noqa: E731
+            num_groups=min(cfg.groups, self.features), dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name=name,
+        )
+        residual = x
+        y = _conv(cfg, self.features, (1, 1), (1, 1), "conv1")(x)
+        y = nn.relu(gn("norm1")(y))
+        y = _conv(cfg, self.features, (3, 3), self.strides, "conv2")(y)
+        y = nn.relu(gn("norm2")(y))
+        y = _conv(cfg, self.features * 4, (1, 1), (1, 1), "conv3")(y)
+        y = nn.GroupNorm(num_groups=min(cfg.groups, self.features * 4),
+                         dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         name="norm3")(y)
+        if residual.shape != y.shape:
+            residual = _conv(cfg, self.features * 4, (1, 1), self.strides,
+                             "proj")(x)
+            residual = nn.GroupNorm(
+                num_groups=min(cfg.groups, self.features * 4),
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                name="proj_norm")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, images):
+        """images: [B, H, W, 3] (NHWC, TPU-native)."""
+        cfg = self.cfg
+        x = _conv(cfg, cfg.width, (7, 7), (2, 2), "stem")(images.astype(cfg.dtype))
+        x = nn.relu(nn.GroupNorm(num_groups=min(cfg.groups, cfg.width),
+                                 dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                                 name="stem_norm")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(cfg.stage_sizes):
+            for block in range(n_blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = Bottleneck(
+                    cfg, cfg.width * (2 ** stage), strides,
+                    name=f"stage{stage}_block{block}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(
+            cfg.num_classes, dtype=jnp.float32, param_dtype=cfg.param_dtype,
+            name="classifier",
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed", "vocab")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("vocab",)
+            ),
+        )(x.astype(jnp.float32))
+
+
+def init_params(cfg: ResNetConfig, rng: jax.Array, image_size: int = 32):
+    from lzy_tpu.models.common import param_logical_axes
+
+    model = ResNet(cfg)
+    boxed = model.init(rng, jnp.zeros((1, image_size, image_size, 3)))["params"]
+    return boxed, param_logical_axes(boxed)
+
+
+def make_loss_fn(cfg: ResNetConfig):
+    model = ResNet(cfg)
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["images"])
+        return cross_entropy_loss(logits, batch["labels"])
+
+    return loss_fn

@@ -1,0 +1,4 @@
+from lzy_tpu.ops.attention import chunked_attention
+from lzy_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["chunked_attention", "flash_attention"]

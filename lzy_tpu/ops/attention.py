@@ -1,0 +1,79 @@
+"""Memory-efficient chunked attention (XLA path).
+
+Online-softmax attention computed blockwise over keys with ``lax.scan``:
+activation memory is O(T·block) instead of O(T²), so long sequences train
+without materializing the score matrix. Fully differentiable through the scan;
+``jax.checkpoint`` on the block body bounds backward memory too. This is the
+portable fallback for the Pallas flash kernel (``lzy_tpu/ops/flash_attention``)
+— same math, same masking semantics, works on CPU/virtual meshes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def chunked_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_size: int = 512,
+) -> jax.Array:
+    """q/k/v: [B, H, T, D] → [B, H, T, D]. Keys/values are processed in
+    blocks of ``block_size`` with the flash merge recurrence."""
+    b, h, t, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    block = min(block_size, t)
+    if t % block:
+        raise ValueError(f"seq len {t} not divisible by block {block}")
+    n_blocks = t // block
+
+    q32 = q.astype(jnp.float32) * scale
+    k_blocks = k.reshape(b, h, n_blocks, block, d)
+    v_blocks = v.reshape(b, h, n_blocks, block, d)
+    q_pos = lax.broadcasted_iota(jnp.int32, (t, block), 0)
+
+    def body(carry, inputs):
+        o, m, l = carry
+        blk_idx, k_blk, v_blk = inputs
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32))
+        if causal:
+            k_pos = blk_idx * block + lax.broadcasted_iota(
+                jnp.int32, (t, block), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # fully-masked rows keep m at -inf; shift by 0 there to avoid NaN
+        m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        if causal:
+            p = jnp.where(q_pos[None, None] >= k_pos[None, None], p, 0.0)
+        alpha = jnp.exp(jnp.where(m <= _NEG_INF / 2, _NEG_INF, m) - m_safe)
+        alpha = jnp.where(m <= _NEG_INF / 2, 0.0, alpha)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        return (o_new, m_new, l_new), None
+
+    o0 = jnp.zeros((b, h, t, d), jnp.float32)
+    m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    idxs = jnp.arange(n_blocks)
+    (o, m, l), _ = lax.scan(
+        jax.checkpoint(body),
+        (o0, m0, l0),
+        (idxs, jnp.moveaxis(k_blocks, 2, 0), jnp.moveaxis(v_blocks, 2, 0)),
+    )
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)

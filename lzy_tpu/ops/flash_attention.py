@@ -1,0 +1,336 @@
+"""Pallas TPU flash attention (forward + backward).
+
+The hot op of every transformer in this framework. FlashAttention-2 structure
+mapped to the TPU memory hierarchy (``/opt/skills/guides/pallas_guide.md``):
+
+- grid over (batch·heads, query blocks); K/V for one (b,h) live in VMEM and
+  are walked blockwise with the online-softmax recurrence — the T×T score
+  matrix never exists, activations are O(T·D);
+- matmuls hit the MXU with float32 accumulation (``preferred_element_type``),
+  inputs stay bfloat16;
+- causal programs stop their KV loop at the diagonal (no wasted FLOPs on
+  masked blocks);
+- backward is two Pallas kernels (dK/dV over KV blocks, dQ over Q blocks)
+  using the saved per-row logsumexp, wrapped in ``jax.custom_vjp``.
+
+TPU tiling note: auxiliary row vectors (logsumexp, delta) cannot use
+``(1, block)`` blocks — the last two block dims must be (8k, 128k) or
+full-dim. Both directions therefore carry lse/delta broadcast across the head
+dim (the same layout jax's reference TPU flash kernel uses for l/m residuals).
+
+Off-TPU (tests, virtual CPU meshes) the same kernels run in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+_LANE = 128
+
+
+def _interpret_default() -> bool:
+    # decide by actual device platform, not backend plugin name — relayed TPU
+    # platforms (e.g. "axon") still expose platform == "tpu"
+    return jax.devices()[0].platform != "tpu"
+
+
+def _pick_block(t: int, requested: int) -> int:
+    """Largest multiple of 128 that divides t and is ≤ max(requested, 128),
+    so any lane-aligned sequence gets a valid block (t=384 → 128)."""
+    b = max(min(requested, t), _LANE)
+    b -= b % _LANE
+    while b > _LANE:
+        if t % b == 0:
+            return b
+        b -= _LANE
+    return _LANE  # t is a multiple of 128 (checked by caller)
+
+
+# -- forward --------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_kv, seq_len):
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale            # [bq, d]
+    q_start = iq * block_q
+    n_kv = seq_len // block_kv
+    hi = jnp.minimum(
+        lax.div(q_start + block_q + block_kv - 1, block_kv), n_kv
+    ) if causal else n_kv
+
+    d = q.shape[-1]
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                # [bq, bkv]
+        if causal:
+            rows = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = j * block_kv + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_safe[:, None])
+        if causal:
+            p = jnp.where(rows >= cols, p, 0.0)
+        alpha = jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(m - m_safe))
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    acc, m, l = lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG_INF)
+    lse_ref[0] = jnp.broadcast_to(lse[:, None], (block_q, d))
+
+
+def _fwd(q, k, v, *, scale, causal, block_q, block_kv, interpret):
+    bh, t, d = q.shape
+    n_q = t // block_q
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_kv=block_kv, seq_len=t,
+    )
+    o, lse_bcast = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse_bcast[:, :, 0]                          # [bh, t]
+
+
+# -- backward -------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   scale, causal, block_q, block_kv, seq_len):
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    q_start = iq * block_q
+    # lse/delta arrive broadcast over the head dim (TPU lane tiling); keep the
+    # per-row column as 2D [block_q, 1] for clean broadcasting
+    lse = lse_ref[0, :, 0:1]
+    delta = delta_ref[0, :, 0:1]
+    n_kv = seq_len // block_kv
+    hi = jnp.minimum(
+        lax.div(q_start + block_q + block_kv - 1, block_kv), n_kv
+    ) if causal else n_kv
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q * scale, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        rows = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = j * block_kv + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        p = jnp.exp(s - lse)
+        if causal:
+            p = jnp.where(rows >= cols, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = lax.fori_loop(0, hi, body, jnp.zeros_like(q))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, block_kv,
+                    seq_len):
+    jkv = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)                  # [bkv, d]
+    v_blk = v_ref[0].astype(jnp.float32)
+    kv_start = jkv * block_kv
+    n_q = seq_len // block_q
+    lo = lax.div(kv_start, block_q) if causal else 0
+
+    d = k_blk.shape[-1]
+
+    def body(i, carry):
+        dk, dv = carry
+        q_start = i * block_q
+        q_blk = q_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(q_start, block_q), 0:1]      # [bq, 1]
+        delta_blk = delta_ref[0, pl.ds(q_start, block_q), 0:1]  # [bq, 1]
+        s = jax.lax.dot_general(
+            q_blk * scale, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                 # [bq, bkv]
+        rows = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = kv_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        p = jnp.exp(s - lse_blk)
+        if causal:
+            p = jnp.where(rows >= cols, p, 0.0)
+        dv_new = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_blk) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((block_kv, d), jnp.float32)
+    dv0 = jnp.zeros((block_kv, d), jnp.float32)
+    dk, dv = lax.fori_loop(lo, n_q, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_kv, interpret):
+    bh, t, d = q.shape
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )                                                     # [bh, t]
+    # broadcast row vectors over the head dim to satisfy TPU lane tiling
+    # (same layout jax's reference TPU flash kernel uses for l/m residuals)
+    lse_t = jnp.broadcast_to(lse[:, :, None], (bh, t, d))
+    delta_t = jnp.broadcast_to(delta[:, :, None], (bh, t, d))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_kv=block_kv, seq_len=t,
+        ),
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # q
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),          # k
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),          # v
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # do
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # lse
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # delta
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse_t, delta_t)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_kv=block_kv, seq_len=t,
+        ),
+        grid=(bh, t // block_kv),
+        in_specs=[
+            pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),          # q
+            pl.BlockSpec((1, block_kv, d), lambda b, j: (b, j, 0)),  # k
+            pl.BlockSpec((1, block_kv, d), lambda b, j: (b, j, 0)),  # v
+            pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),          # do
+            pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),          # lse
+            pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),          # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse_t, delta_t)
+    return dq, dk, dv
+
+
+# -- public op -------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash(q, k, v, scale, causal, block_q, block_kv, interpret):
+    o, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                block_kv=block_kv, interpret=interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret):
+    o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                  block_kv=block_kv, interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_kv, interpret, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, scale=scale, causal=causal,
+                      block_q=block_q, block_kv=block_kv, interpret=interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_kv: int = 256,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """q/k/v: [B, H, T, D] → [B, H, T, D]. T must be a multiple of 128 (TPU
+    lane tiling) and of the block sizes."""
+    b, h, t, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    if t % _LANE:
+        raise ValueError(f"seq len {t} must be divisible by {_LANE}")
+    block_q = _pick_block(t, block_q)
+    block_kv = _pick_block(t, block_kv)
+    interpret = _interpret_default() if interpret is None else interpret
+
+    flat = lambda x: x.reshape(b * h, t, d)  # noqa: E731
+    o = _flash(flat(q), flat(k), flat(v), scale, causal, block_q, block_kv,
+               interpret)
+    return o.reshape(b, h, t, d)

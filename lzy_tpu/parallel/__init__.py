@@ -1,0 +1,39 @@
+from lzy_tpu.parallel.mesh import AXES, MeshSpec, dp_mesh, fsdp_mesh, mesh_for
+from lzy_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    infer_param_logical_axes,
+    named_sharding,
+    shard_tree,
+    spec_for,
+    tree_shardings,
+)
+from lzy_tpu.parallel.train import (
+    PEAK_TFLOPS,
+    TrainState,
+    make_train_step,
+    mfu,
+    transformer_flops_per_token,
+)
+from lzy_tpu.parallel.ring import ring_attention
+from lzy_tpu.parallel.distributed import initialize_gang
+
+__all__ = [
+    "AXES",
+    "MeshSpec",
+    "dp_mesh",
+    "fsdp_mesh",
+    "mesh_for",
+    "DEFAULT_RULES",
+    "infer_param_logical_axes",
+    "named_sharding",
+    "shard_tree",
+    "spec_for",
+    "tree_shardings",
+    "PEAK_TFLOPS",
+    "TrainState",
+    "make_train_step",
+    "mfu",
+    "transformer_flops_per_token",
+    "ring_attention",
+    "initialize_gang",
+]

@@ -1,0 +1,58 @@
+"""Multi-host gang initialization.
+
+The control plane allocates all hosts of a slice atomically (gang scheduling,
+``lzy_tpu/service/allocator.py``); this module is what the op calls on each
+host to join the SPMD program: ``jax.distributed.initialize(coordinator,
+num_processes, process_id)`` with the coordinator = gang host 0. Under the
+in-process thread backend the gang context exists but JAX is already
+single-process, so initialization is a no-op and the op uses the local devices
+(tests and the driver's virtual-CPU dryrun exercise the sharded program
+instead).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+COORDINATOR_PORT = 8476
+
+
+def initialize_gang(coordinator_address: Optional[str] = None) -> dict:
+    """Join this host to its gang's JAX distributed runtime. Reads the gang
+    context planted by the worker (``lzy_tpu.service.worker.current_gang``)
+    or the standard env vars a cloud backend sets on the pod.
+
+    Returns {"rank", "size", "initialized"}.
+    """
+    from lzy_tpu.service.worker import current_gang
+
+    gang = current_gang()
+    if gang is None:
+        rank = int(os.environ.get("LZY_GANG_RANK", "0"))
+        size = int(os.environ.get("LZY_GANG_SIZE", "1"))
+        coordinator_address = coordinator_address or os.environ.get(
+            "LZY_GANG_COORDINATOR"
+        )
+    else:
+        rank, size = gang["rank"], gang["size"]
+        coordinator_address = coordinator_address or gang.get("coordinator")
+
+    if size <= 1 or coordinator_address is None:
+        # single host, or in-process gang sharing one JAX runtime
+        return {"rank": rank, "size": size, "initialized": False}
+
+    jax.distributed.initialize(
+        coordinator_address=f"{coordinator_address}:{COORDINATOR_PORT}",
+        num_processes=size,
+        process_id=rank,
+    )
+    _LOG.info("joined gang: process %d/%d, %d global devices",
+              rank, size, jax.device_count())
+    return {"rank": rank, "size": size, "initialized": True}

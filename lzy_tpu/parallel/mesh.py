@@ -1,0 +1,76 @@
+"""Device meshes for SPMD ops.
+
+The reference has no tensor-level parallelism (SURVEY.md §2.4) — this module is
+the TPU-build addition that makes a single ``@op`` span a whole slice. Axis
+convention follows the standard 4-axis recipe (data / fsdp / tensor / sequence):
+collectives ride ICI when the mesh is laid out with ``dp`` outermost (slowest,
+DCN-friendly) and ``tp`` innermost (fastest, needs full ICI bandwidth).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# canonical axis order: outermost (cross-slice/DCN tolerant) → innermost (ICI)
+AXES = ("dp", "fsdp", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Named mesh shape. Unspecified axes default to 1; ``fsdp=-1`` (or any
+    single axis set to -1) absorbs all remaining devices."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = {a: getattr(self, a) for a in AXES}
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"only one mesh axis may be -1, got {wild}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices but {n_devices} are available"
+            )
+        return MeshSpec(**sizes)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXES)
+
+    def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        devices = list(devices) if devices is not None else jax.devices()
+        spec = self.resolve(len(devices))
+        arr = np.asarray(devices).reshape(spec.shape)
+        return Mesh(arr, AXES)
+
+
+def fsdp_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """All devices on the fsdp axis — the right default for single-slice
+    training of models that fit with sharded states (Llama-8B on v5e-64)."""
+    return MeshSpec(fsdp=-1).build(devices)
+
+
+def dp_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    return MeshSpec(dp=-1).build(devices)
+
+
+def mesh_for(n_devices: Optional[int] = None, **axis_sizes: int) -> Mesh:
+    """``mesh_for(tp=4, fsdp=-1)`` over the first n (default: all) devices."""
+    devices = jax.devices()[: n_devices] if n_devices else jax.devices()
+    return MeshSpec(**axis_sizes).build(devices)

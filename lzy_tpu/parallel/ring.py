@@ -1,0 +1,125 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Absent from the reference (SURVEY.md §5.7 — it scales *sequence of ops*, not
+sequence length); first-class here. The sequence is sharded over ``sp``; each
+device holds its Q block and streams K/V blocks around the ring with
+``lax.ppermute`` (ICI neighbor exchange), accumulating attention with the
+online-softmax (flash) recurrence so the full sequence is never materialized
+on one chip. Communication overlaps compute: while block i is processed, XLA
+schedules the permute of block i+1 (double-buffered carry).
+
+Causal masking across ring steps uses the block-position trick: a block from
+source rank r is fully visible if r < my_rank, fully masked if r > my_rank,
+and diagonally masked if r == my_rank.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, *, scale, mask):
+    """One flash block: returns (unnormalized out, row max, row sumexp).
+
+    q: [B, H, Tq, D], k/v: [B, H, Tk, D], mask: [Tq, Tk] or None.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # [B,H,Tq]
+    # guard fully-masked rows (all -inf): exp(-inf - -inf) would NaN
+    m_safe = jnp.where(m <= _NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - m_safe[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)                                   # [B,H,Tq]
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o, m_safe, l
+
+
+def _merge(o1, m1, l1, o2, m2, l2):
+    """Online-softmax merge of two partial attention results."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    o = o1 * a1[..., None] + o2 * a2[..., None]
+    l = l1 * a1 + l2 * a2
+    return o, m, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    q_spec: P = P(("dp", "fsdp"), None, "sp", None),
+) -> jax.Array:
+    """Attention over a sequence sharded on ``axis``.
+
+    Shapes (per global array): q/k/v ``[batch, heads, seq, head_dim]`` with
+    ``seq`` sharded over ``axis``. Returns the same layout as q.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    n = mesh.shape[axis]
+
+    def local_fn(q_blk, k_blk, v_blk):
+        my_rank = lax.axis_index(axis)
+        tq = q_blk.shape[2]
+        tk = k_blk.shape[2]
+
+        def diag_mask():
+            rows = lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+            cols = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+            return rows >= cols
+
+        def body(carry, step):
+            o, m, l, k_cur, v_cur = carry
+            src_rank = (my_rank - step) % n          # who produced this block
+            if causal:
+                keep_all = src_rank < my_rank
+                keep_none = src_rank > my_rank
+                mask = jnp.where(
+                    keep_all, True,
+                    jnp.where(keep_none, False, diag_mask()),
+                )
+            else:
+                mask = None
+            o_b, m_b, l_b = _block_attn(q_blk, k_cur, v_cur, scale=scale, mask=mask)
+            o, m, l = _merge(o, m, l, o_b, m_b, l_b)
+            # rotate K/V to the next rank; overlaps with the next block's math
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            k_nxt = lax.ppermute(k_cur, axis, perm)
+            v_nxt = lax.ppermute(v_cur, axis, perm)
+            return (o, m, l, k_nxt, v_nxt), None
+
+        b, h, _, d = q_blk.shape
+        o0 = jnp.zeros((b, h, tq, d), jnp.float32)
+        m0 = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, tq), jnp.float32)
+        (o, m, l, _, _), _ = lax.scan(
+            body, (o0, m0, l0, k_blk, v_blk), jnp.arange(n)
+        )
+        out = o / jnp.maximum(l, 1e-30)[..., None]
+        return out.astype(q_blk.dtype)
+
+    return shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(q_spec, q_spec, q_spec),
+        out_specs=q_spec,
+        check_rep=False,
+    )(q, k, v)

@@ -54,6 +54,7 @@ class Vm:
     gang_size: int
     heartbeat_ts: float = 0.0
     idle_since: Optional[float] = None
+    created_ts: float = dataclasses.field(default_factory=time.time)
 
     def to_doc(self) -> dict:
         return dataclasses.asdict(self)
@@ -224,6 +225,11 @@ class AllocatorService:
                     doomed.append(vm)
                 elif vm.status == RUNNING and vm.heartbeat_ts \
                         and now - vm.heartbeat_ts > self.HEARTBEAT_TIMEOUT_S:
+                    doomed.append(vm)
+                elif vm.status == ALLOCATING and \
+                        now - vm.created_ts > 2 * self._allocate_timeout_s:
+                    # orphaned plan: the allocate op crashed before committing
+                    # its step, so no action will ever await these hosts
                     doomed.append(vm)
             for vm in doomed:
                 vm.status = DELETING

@@ -41,6 +41,7 @@ class GraphExecutor:
         store: OperationStore,
         executor: OperationsExecutor,
         allocator: AllocatorService,
+        channels=None,
         *,
         max_running_tasks: int = 8,
         poll_period_s: float = 0.05,
@@ -48,6 +49,7 @@ class GraphExecutor:
         self._store = store
         self._executor = executor
         self._allocator = allocator
+        self._channels = channels
         self.max_running_tasks = max_running_tasks
         self.poll_period_s = poll_period_s
         executor.register("exec_graph", self._make_graph_action)
@@ -237,6 +239,17 @@ class _ExecTaskAction(OperationRunner):
             )
             # persist exception_uri before the runner marks the op FAILED
             self.store.save_progress(self.record.id, self.state, self.record.step)
+            # fail the task's output channels: gang peers blocked on rank 0's
+            # outputs (e.g. after a rank-0 VM loss) must unblock, or their
+            # threads outlive the task on VMs about to be reused
+            if self.svc._channels is not None:
+                for out in task.outputs:
+                    try:
+                        self.svc._channels.transfer_failed(
+                            out.id, f"task {task.name} failed"
+                        )
+                    except KeyError:
+                        pass
             self._free()
             raise RuntimeError(f"task {task.name} failed: {failed[0]['error']}")
         if all(s["status"] == "DONE" for s in statuses):

@@ -64,7 +64,7 @@ class InProcessCluster:
         )
         self.backend.allocator = self.allocator
         self.graph_executor = GraphExecutor(
-            self.store, self.executor, self.allocator,
+            self.store, self.executor, self.allocator, self.channels,
             max_running_tasks=max_running_tasks, poll_period_s=poll_period_s,
         )
         self.workflow_service = WorkflowService(

@@ -127,8 +127,13 @@ class WorkerAgent:
         self._owner = owner
 
     def execute(self, task: TaskDesc, gang_rank: int, gang: Dict[str, Any]) -> str:
-        op_id = gen_id("workerop")
+        # deterministic op id → idempotent: a crashed graph-executor step that
+        # re-requests execution after resume gets the already-running op back
+        # instead of launching the program a second time
+        op_id = f"workerop-{task.id}-r{gang_rank}"
         with self._lock:
+            if op_id in self._ops:
+                return op_id
             self._ops[op_id] = {"status": "RUNNING", "error": None,
                                 "exception_uri": None}
         thread = threading.Thread(
