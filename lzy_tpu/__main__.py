@@ -1,0 +1,137 @@
+"""CLI status surface: ``python -m lzy_tpu <command>``.
+
+The reference ships a web console (``lzy/site`` + React frontend) listing
+tasks/executions; a terminal status surface fits the TPU build's
+single-metadata-store design: commands read the deployment's store
+(``--db``, default ``$LZY_TPU_DB``) and print tables.
+
+Commands: executions, graphs, vms, ops, whiteboards, version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+
+
+def _fmt_ts(ts) -> str:
+    if not ts:
+        return "-"
+    return datetime.datetime.fromtimestamp(ts).strftime("%Y-%m-%d %H:%M:%S")
+
+
+def _table(rows, headers) -> str:
+    rows = [[str(c) for c in r] for r in rows]
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    out = ["  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    out += ["  ".join(c.ljust(w) for c, w in zip(r, widths)) for r in rows]
+    return "\n".join(out)
+
+
+def cmd_executions(store, args) -> None:
+    rows = []
+    for eid, doc in sorted(store.kv_list("executions").items(),
+                           key=lambda kv: kv[1].get("started_at", 0)):
+        rows.append([
+            eid, doc.get("workflow_name"), doc.get("user"),
+            doc.get("status"), _fmt_ts(doc.get("started_at")),
+            len(doc.get("graphs", [])),
+        ])
+    print(_table(rows, ["EXECUTION", "WORKFLOW", "USER", "STATUS",
+                        "STARTED", "GRAPHS"]))
+
+
+def cmd_graphs(store, args) -> None:
+    rows = []
+    for doc in store.kv_list("executions").values():
+        for graph_op_id in doc.get("graphs", []):
+            try:
+                record = store.load(graph_op_id)
+            except KeyError:
+                continue
+            tasks = record.state.get("tasks", {})
+            done = sum(1 for t in tasks.values() if t["status"] == "COMPLETED")
+            rows.append([graph_op_id, doc.get("workflow_name"), record.status,
+                         f"{done}/{len(tasks)}"])
+    print(_table(rows, ["GRAPH-OP", "WORKFLOW", "STATUS", "TASKS"]))
+
+
+def cmd_vms(store, args) -> None:
+    rows = []
+    for vm_id, doc in sorted(store.kv_list("vms").items()):
+        rows.append([vm_id, doc.get("pool_label"), doc.get("status"),
+                     doc.get("gang_id"),
+                     f"{doc.get('host_index')}/{doc.get('gang_size')}"])
+    print(_table(rows, ["VM", "POOL", "STATUS", "GANG", "HOST"]))
+
+
+def cmd_ops(store, args) -> None:
+    rows = []
+    for record in store.running_ops():
+        rows.append([record.id, record.kind, record.status, record.step])
+    print(_table(rows, ["OPERATION", "KIND", "STATUS", "STEP"]))
+
+
+def cmd_whiteboards(store, args) -> None:
+    from lzy_tpu.storage import StorageConfig
+    from lzy_tpu.storage.registry import client_for
+    from lzy_tpu.whiteboards.index import WhiteboardIndex
+
+    if not args.storage:
+        print("pass --storage <uri> to list whiteboards", file=sys.stderr)
+        sys.exit(2)
+    index = WhiteboardIndex(client_for(StorageConfig(uri=args.storage)),
+                            args.storage)
+    rows = [[m.id, m.name, ",".join(m.tags), m.created_at.strftime("%Y-%m-%d %H:%M")]
+            for m in index.query()]
+    print(_table(rows, ["ID", "NAME", "TAGS", "CREATED"]))
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="python -m lzy_tpu", description="lzy-tpu deployment status"
+    )
+    parser.add_argument("--db", default=os.environ.get("LZY_TPU_DB"),
+                        help="metadata store path (or $LZY_TPU_DB)")
+    parser.add_argument("--storage", default=os.environ.get("LZY_TPU_STORAGE"),
+                        help="storage uri (whiteboards command)")
+    sub = parser.add_subparsers(dest="command", required=True)
+    for name in ("executions", "graphs", "vms", "ops", "whiteboards"):
+        sub.add_parser(name)
+    version_parser = sub.add_parser("version")
+    args = parser.parse_args(argv)
+
+    if args.command == "version":
+        from lzy_tpu import __version__
+
+        print(__version__)
+        return
+
+    if args.command == "whiteboards" and args.storage:
+        cmd_whiteboards(None, args)
+        return
+
+    if not args.db:
+        print("pass --db <path> (or set LZY_TPU_DB)", file=sys.stderr)
+        sys.exit(2)
+    from lzy_tpu.durable import OperationStore
+
+    store = OperationStore(args.db)
+    try:
+        {
+            "executions": cmd_executions,
+            "graphs": cmd_graphs,
+            "vms": cmd_vms,
+            "ops": cmd_ops,
+            "whiteboards": cmd_whiteboards,
+        }[args.command](store, args)
+    finally:
+        store.close()
+
+
+if __name__ == "__main__":
+    main()

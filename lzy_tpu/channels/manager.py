@@ -39,6 +39,7 @@ class Channel:
     consumer_tasks: List[str] = dataclasses.field(default_factory=list)
     completed: bool = False      # storage peer has full data
     failed: Optional[str] = None
+    slot_peer: Optional[Any] = None   # producer's live SlotPeer (p2p fast path)
 
 
 class DeviceResidency:
@@ -115,6 +116,13 @@ class ChannelManager:
             ch = self._channels[entry_id]
             ch.completed = True
             self._cv.notify_all()
+
+    def publish_peer(self, entry_id: str, peer: Any) -> None:
+        """Producer announces a live slot peer for direct transfers."""
+        with self._cv:
+            ch = self._channels.get(entry_id)
+            if ch is not None:
+                ch.slot_peer = peer
 
     def transfer_failed(self, entry_id: str, error: str) -> None:
         with self._cv:

@@ -1,0 +1,43 @@
+"""Peer-to-peer slot transfers.
+
+The reference streams producer→consumer directly while the producer is still
+alive, with storage as the durable fallback (SURVEY.md §3.4). Here the
+producer's worker hosts a native slot server (``lzy_tpu/native``) over its
+spill directory; a consumer on another host pulls with offset resume and
+verifies integrity, falling back to the storage peer if the producer is gone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotPeer:
+    host: str
+    port: int
+    name: str                  # served name under the producer's spill root
+    fnv1a: Optional[int] = None
+
+
+def fetch_via_peer(peer: SlotPeer, dest_path: str) -> bool:
+    """Try pulling from the producer peer; True on verified success."""
+    try:
+        from lzy_tpu.native import fnv1a_file, pull_with_resume
+
+        pull_with_resume(peer.host, peer.port, peer.name, dest_path)
+        if peer.fnv1a is not None and fnv1a_file(dest_path) != peer.fnv1a:
+            _LOG.warning("peer transfer of %s failed integrity check", peer.name)
+            os.unlink(dest_path)
+            return False
+        return True
+    except Exception as e:  # noqa: BLE001 — any peer failure → storage fallback
+        _LOG.info("peer transfer of %s unavailable (%s); storage fallback",
+                  peer.name, e)
+        return False

@@ -148,10 +148,15 @@ class OperationsExecutor:
                 raise TimeoutError(f"operation {op_id} still {record.status}")
             event.wait(min(remaining, 0.5))
 
-    def shutdown(self) -> None:
+    def shutdown(self, *, join_timeout_s: float = 5.0) -> None:
         with self._cv:
             self._stopped = True
             self._cv.notify_all()
+        # drain: let in-flight ops finish their current step before the caller
+        # closes the store underneath them; one deadline bounds the WHOLE drain
+        deadline = time.time() + join_timeout_s
+        for t in self._threads:
+            t.join(max(0.0, deadline - time.time()))
 
     # -- internals -------------------------------------------------------------
 
@@ -231,6 +236,9 @@ class OperationsExecutor:
                     self._enqueue(op_id, result.delay_s, requeue=True)
                     return
                 if result.outcome is Outcome.FINISH:
+                    # persist the final state too — status surfaces (CLI,
+                    # graph_status) read it after completion
+                    self._store.save_progress(op_id, runner.state, i)
                     self._store.complete(op_id, result.result)
                     return
             # ran off the end of steps() — implicit FINISH

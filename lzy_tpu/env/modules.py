@@ -1,0 +1,73 @@
+"""Local-module sync: ship the user's code to remote workers.
+
+Counterpart of the reference's ``__load_local_modules``
+(``pylzy/lzy/api/v1/remote/runtime.py:249-281``): local modules captured by the
+python-env explorer are zipped, content-hashed, and uploaded once per content
+(the cache key is the hash, so unchanged code never re-uploads); workers unpack
+archives and prepend them to ``sys.path`` before running the op.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import zipfile
+from pathlib import Path
+from typing import Iterable, List, Sequence, Tuple
+
+from lzy_tpu.storage.api import StorageClient, join_uri
+from lzy_tpu.utils import hashing
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+
+def package_module(path: str | Path) -> Tuple[bytes, str]:
+    """Zip one module file/package dir; returns (zip bytes, content hash).
+    The archive root preserves the module's own name so unpacking a dir makes
+    it importable."""
+    path = Path(path).resolve()
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        if path.is_file():
+            zf.write(path, path.name)
+        else:
+            for p in sorted(path.rglob("*")):
+                if p.is_file() and "__pycache__" not in p.parts:
+                    zf.write(p, Path(path.name) / p.relative_to(path))
+    data = buf.getvalue()
+    content_hash = (hashing.hash_dir(path) if path.is_dir()
+                    else hashing.hash_file(path))
+    return data, content_hash
+
+
+def upload_local_modules(paths: Sequence[str], client: StorageClient,
+                         storage_root: str) -> List[str]:
+    """Upload each module archive content-addressed; returns archive URIs.
+    Unchanged modules are skipped (hash hit)."""
+    uris = []
+    for path in paths:
+        data, content_hash = package_module(path)
+        uri = join_uri(storage_root, "lzy_modules", f"{content_hash}.zip")
+        if not client.exists(uri):
+            client.write_bytes(uri, data)
+            _LOG.info("uploaded module %s (%d bytes)", path, len(data))
+        uris.append(uri)
+    return uris
+
+
+def unpack_modules(uris: Iterable[str], client: StorageClient,
+                   dest_dir: str) -> List[str]:
+    """Worker side: download + unpack archives, prepend to sys.path. Returns
+    the paths added (startup.py LOCAL_MODULES contract parity)."""
+    added = []
+    os.makedirs(dest_dir, exist_ok=True)
+    for uri in uris:
+        data = client.read_bytes(uri)
+        with zipfile.ZipFile(io.BytesIO(data)) as zf:
+            zf.extractall(dest_dir)
+    if dest_dir not in sys.path:
+        sys.path.insert(0, dest_dir)
+        added.append(dest_dir)
+    return added

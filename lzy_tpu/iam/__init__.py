@@ -1,0 +1,29 @@
+from lzy_tpu.iam.service import (
+    INTERNAL,
+    OWNER,
+    READER,
+    USER,
+    WORKER,
+    WORKER_ROLE,
+    WORKFLOW_MANAGE,
+    WORKFLOW_READ,
+    WORKFLOW_RUN,
+    AuthError,
+    IamService,
+    Subject,
+)
+
+__all__ = [
+    "INTERNAL",
+    "OWNER",
+    "READER",
+    "USER",
+    "WORKER",
+    "WORKER_ROLE",
+    "WORKFLOW_MANAGE",
+    "WORKFLOW_READ",
+    "WORKFLOW_RUN",
+    "AuthError",
+    "IamService",
+    "Subject",
+]

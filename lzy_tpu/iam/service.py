@@ -1,0 +1,123 @@
+"""IAM: authentication + authorization.
+
+Counterpart of the reference iam stack (``lzy/iam``, ``iam-api`` — subjects
+USER/WORKER with credentials, roles OWNER/READER/INTERNAL/WORKER, resource
+permissions, JWT auth; SURVEY.md §2.3). Scoped per the build plan (§7 step 1):
+single-tenant-friendly but IAM-shaped — subjects, roles, signed tokens, and an
+``authorize`` check the services call, so a multi-tenant backend can replace
+the token scheme without touching call sites.
+
+Tokens are HMAC-SHA256 over ``subject_id:issued_at`` with a per-deployment
+secret (the stdlib equivalent of the reference's RSA JWTs; the interface —
+issue/authenticate — is the same).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import hmac
+import secrets
+import time
+from typing import Dict, Optional
+
+from lzy_tpu.durable.store import OperationStore
+
+USER = "USER"
+WORKER = "WORKER"
+
+# roles, mirroring iam-api/.../resources/Role.java:8-45
+OWNER = "OWNER"
+READER = "READER"
+INTERNAL = "INTERNAL"
+WORKER_ROLE = "WORKER"
+
+# permissions on workflow resources (AuthPermission.java:3-15 analog)
+WORKFLOW_RUN = "workflow.run"
+WORKFLOW_MANAGE = "workflow.manage"
+WORKFLOW_READ = "workflow.read"
+
+_ROLE_PERMISSIONS = {
+    OWNER: {WORKFLOW_RUN, WORKFLOW_MANAGE, WORKFLOW_READ},
+    INTERNAL: {WORKFLOW_RUN, WORKFLOW_MANAGE, WORKFLOW_READ},
+    READER: {WORKFLOW_READ},
+    WORKER_ROLE: {WORKFLOW_READ},
+}
+
+
+class AuthError(PermissionError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Subject:
+    id: str
+    kind: str                  # USER | WORKER
+    role: str
+
+    def can(self, permission: str) -> bool:
+        return permission in _ROLE_PERMISSIONS.get(self.role, set())
+
+
+class IamService:
+    def __init__(self, store: OperationStore, secret: Optional[str] = None):
+        self._store = store
+        stored = store.kv_get("iam", "__secret__")
+        if stored is None:
+            stored = secret or secrets.token_hex(32)
+            store.kv_put("iam", "__secret__", stored)
+        self._secret = stored.encode()
+
+    # -- subjects --------------------------------------------------------------
+
+    def create_subject(self, subject_id: str, kind: str = USER,
+                       role: str = OWNER) -> str:
+        """Registers the subject and returns its bearer token."""
+        if kind not in (USER, WORKER):
+            raise ValueError(f"bad subject kind {kind!r}")
+        if role not in _ROLE_PERMISSIONS:
+            raise ValueError(f"bad role {role!r}")
+        self._store.kv_put("iam", f"subject:{subject_id}",
+                           {"kind": kind, "role": role})
+        return self._issue(subject_id)
+
+    def remove_subject(self, subject_id: str) -> None:
+        self._store.kv_del("iam", f"subject:{subject_id}")
+
+    # -- tokens ----------------------------------------------------------------
+
+    def _issue(self, subject_id: str) -> str:
+        ts = str(int(time.time()))
+        sig = hmac.new(self._secret, f"{subject_id}:{ts}".encode(),
+                       hashlib.sha256).hexdigest()
+        return f"{subject_id}:{ts}:{sig}"
+
+    def authenticate(self, token: Optional[str]) -> Subject:
+        if not token or token.count(":") != 2:
+            raise AuthError("missing or malformed token")
+        subject_id, ts, sig = token.split(":")
+        expected = hmac.new(self._secret, f"{subject_id}:{ts}".encode(),
+                            hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(sig, expected):
+            raise AuthError("invalid token signature")
+        doc = self._store.kv_get("iam", f"subject:{subject_id}")
+        if doc is None:
+            raise AuthError(f"unknown subject {subject_id!r}")
+        return Subject(id=subject_id, kind=doc["kind"], role=doc["role"])
+
+    # -- authz -----------------------------------------------------------------
+
+    def authorize(self, subject: Subject, permission: str,
+                  resource_owner: Optional[str] = None) -> None:
+        """Raise AuthError unless the subject holds the permission; OWNER-role
+        grants apply only to the subject's own resources (INTERNAL is global,
+        like the reference's internal role)."""
+        if not subject.can(permission):
+            raise AuthError(
+                f"subject {subject.id} ({subject.role}) lacks {permission}"
+            )
+        if (resource_owner is not None and subject.role == OWNER
+                and resource_owner != subject.id):
+            raise AuthError(
+                f"subject {subject.id} does not own this resource"
+            )

@@ -1,0 +1,4 @@
+from lzy_tpu.injections.estimator import remote_fit
+from lzy_tpu.injections.extensions import extend
+
+__all__ = ["remote_fit", "extend"]

@@ -1,0 +1,34 @@
+"""CatBoost injection, reference-parity surface
+(``pylzy/lzy/injections/catboost.py:13-55``): after ``inject_catboost(lzy)``,
+``model.fit(X, y, provisioning=..., tpu=...)`` transparently trains in a
+one-op workflow. Gated: catboost is not a baked-in dependency of this image.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from lzy_tpu.core.lzy import Lzy
+from lzy_tpu.injections.estimator import remote_fit
+
+
+def inject_catboost(lzy: Optional[Lzy] = None) -> None:
+    try:
+        from catboost import CatBoost  # type: ignore
+    except ImportError as e:
+        raise ImportError(
+            "inject_catboost requires the catboost package, which is not "
+            "installed in this environment"
+        ) from e
+
+    original_fit = CatBoost.fit
+
+    def fit(self, X, y=None, *args, tpu=None, env=None, **kwargs):  # noqa: N803
+        if tpu is None and env is None:
+            return original_fit(self, X, y, *args, **kwargs)
+        fitted = remote_fit(self, X, y, lzy=lzy, tpu=tpu, env=env,
+                            workflow_name="catboost-fit")
+        self.__dict__.update(fitted.__dict__)
+        return self
+
+    CatBoost.fit = fit

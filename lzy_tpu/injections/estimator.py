@@ -1,0 +1,36 @@
+"""Remote fit for sklearn-style estimators.
+
+The generic core of the reference's CatBoost integration
+(``pylzy/lzy/injections/catboost.py:13-55``): wrap ``estimator.fit(X, y)`` in a
+one-op workflow so a plain training call transparently runs on provisioned
+compute (TPU slice or CPU pool) and the fitted estimator comes back.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from lzy_tpu.core.lzy import Lzy
+from lzy_tpu.core.op import op
+from lzy_tpu.env.environment import LzyEnvironment
+
+
+def remote_fit(estimator: Any, X: Any, y: Any, *,
+               lzy: Optional[Lzy] = None,
+               tpu: Optional[str] = None,
+               env: Optional[LzyEnvironment] = None,
+               workflow_name: str = "fit",
+               **fit_kwargs: Any) -> Any:
+    """Fit ``estimator`` remotely; returns the fitted estimator."""
+    lzy = lzy or Lzy()
+
+    @op(output_types=(type(estimator),), tpu=tpu, env=env)
+    def fit(est, X, y):  # noqa: N803 — sklearn convention
+        est.fit(X, y, **fit_kwargs)
+        return est
+
+    with lzy.workflow(workflow_name):
+        fitted = fit(estimator, X, y)
+        from lzy_tpu.proxy import materialize
+
+        return materialize(fitted)

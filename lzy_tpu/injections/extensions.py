@@ -1,0 +1,19 @@
+"""``@extend``: attach methods to existing classes.
+
+Counterpart of the reference's extension hook
+(``pylzy/lzy/injections/extensions.py``) used by library integrations.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Type
+
+
+def extend(cls: Type) -> Callable:
+    """``@extend(SomeClass)`` registers the decorated function as a method."""
+
+    def wrap(fn: Callable) -> Callable:
+        setattr(cls, fn.__name__, fn)
+        return fn
+
+    return wrap

@@ -1,0 +1,15 @@
+from lzy_tpu.native.slots import (
+    NativeUnavailable,
+    SlotServer,
+    fnv1a_file,
+    native_available,
+    pull_with_resume,
+)
+
+__all__ = [
+    "NativeUnavailable",
+    "SlotServer",
+    "fnv1a_file",
+    "native_available",
+    "pull_with_resume",
+]

@@ -1,0 +1,158 @@
+"""ctypes bindings for the native slot-streaming engine (``native/``).
+
+The C++ engine is the data-plane hot path: host-to-host streaming of spilled
+values with offset resume (the reference's ``SlotInputTransfer`` chunked gRPC
+stream, rebuilt native). The library builds on demand with the repo's
+Makefile (g++ is a baked-in toolchain dependency) and is cached under
+``native/build/``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import threading
+import time
+from typing import Optional
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+_NATIVE_DIR = _REPO_ROOT / "native"
+_LIB_PATH = _NATIVE_DIR / "build" / "liblzy_slots.so"
+
+_lib = None
+_lib_error: Optional[Exception] = None
+_lib_lock = threading.Lock()
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _load():
+    global _lib, _lib_error
+    if _lib is not None:
+        return _lib
+    if _lib_error is not None:
+        # failed builds are cached too: retrying `make` on every VM boot
+        # would put a compiler timeout on the allocation latency path
+        raise NativeUnavailable(str(_lib_error)) from _lib_error
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _lib_error is not None:
+            raise NativeUnavailable(str(_lib_error)) from _lib_error
+        if not _LIB_PATH.exists():
+            try:
+                subprocess.run(
+                    ["make", "-C", str(_NATIVE_DIR)],
+                    check=True, capture_output=True, text=True, timeout=120,
+                )
+            except (subprocess.CalledProcessError, OSError,
+                    subprocess.TimeoutExpired) as e:
+                detail = getattr(e, "stderr", "") or str(e)
+                _lib_error = NativeUnavailable(
+                    f"could not build native slot engine: {detail}"
+                )
+                raise _lib_error from e
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.lzy_slots_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.lzy_slots_server_start.restype = ctypes.c_int
+        lib.lzy_slots_server_port.argtypes = [ctypes.c_int]
+        lib.lzy_slots_server_port.restype = ctypes.c_int
+        lib.lzy_slots_server_stop.argtypes = [ctypes.c_int]
+        lib.lzy_slots_server_stop.restype = None
+        lib.lzy_slots_pull.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_longlong, ctypes.c_longlong,
+        ]
+        lib.lzy_slots_pull.restype = ctypes.c_longlong
+        lib.lzy_slots_stat.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ]
+        lib.lzy_slots_stat.restype = ctypes.c_longlong
+        lib.lzy_fnv1a_file.argtypes = [ctypes.c_char_p]
+        lib.lzy_fnv1a_file.restype = ctypes.c_uint64
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    try:
+        _load()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+class SlotServer:
+    """Serves files under ``root`` to peers. One per worker host."""
+
+    def __init__(self, root: str, port: int = 0):
+        lib = _load()
+        self._handle = lib.lzy_slots_server_start(
+            str(root).encode(), port
+        )
+        if self._handle < 0:
+            raise OSError(-self._handle, os.strerror(-self._handle))
+        self.root = str(root)
+        self.port = lib.lzy_slots_server_port(self._handle)
+
+    def stop(self) -> None:
+        if self._handle > 0:
+            _load().lzy_slots_server_stop(self._handle)
+            self._handle = -1
+
+    def __enter__(self) -> "SlotServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def pull(host: str, port: int, remote_name: str, dest_path: str,
+         offset: int = 0, max_bytes: int = 0) -> int:
+    """Single pull attempt from ``offset``; returns new local size."""
+    result = _load().lzy_slots_pull(
+        host.encode(), port, remote_name.encode(), str(dest_path).encode(),
+        offset, max_bytes,
+    )
+    if result < 0:
+        raise OSError(-result, os.strerror(-result))
+    return int(result)
+
+
+def remote_size(host: str, port: int, remote_name: str) -> int:
+    result = _load().lzy_slots_stat(host.encode(), port, remote_name.encode())
+    if result < 0:
+        raise OSError(-result, os.strerror(-result))
+    return int(result)
+
+
+def pull_with_resume(host: str, port: int, remote_name: str, dest_path: str,
+                     *, max_retries: int = 5, retry_delay_s: float = 0.2) -> int:
+    """Pull to completion, resuming from the local size after interruptions —
+    the reference's offset-resume + retry contract (SURVEY.md §3.4)."""
+    total = remote_size(host, port, remote_name)
+    attempt = 0
+    while True:
+        local = os.path.getsize(dest_path) if os.path.exists(dest_path) else 0
+        if local >= total:
+            return local
+        try:
+            local = pull(host, port, remote_name, dest_path, offset=local)
+        except OSError:
+            local = -1
+        if local >= total:
+            return local
+        attempt += 1
+        if attempt > max_retries:
+            raise TimeoutError(
+                f"transfer of {remote_name} stalled after {max_retries} retries"
+            )
+        time.sleep(retry_delay_s)
+
+
+def fnv1a_file(path: str) -> int:
+    return int(_load().lzy_fnv1a_file(str(path).encode()))

@@ -37,10 +37,16 @@ _LOG = get_logger(__name__)
 
 class RemoteRuntime(Runtime):
     def __init__(self, client, *, user: str = "local-user",
+                 token: Optional[str] = None,
                  poll_period_s: float = 0.05, stream_logs: bool = True,
                  graph_timeout_s: float = 600.0):
+        import os
+
         self._client = client
         self._user = user
+        # env var contract mirrors the reference (LZY_USER/LZY_KEY_PATH,
+        # `lzy_service_client.py:39-41`); tokens ride LZY_TOKEN here
+        self._token = token or os.environ.get("LZY_TOKEN")
         self._poll_period_s = poll_period_s
         self._stream_logs = stream_logs
         self._graph_timeout_s = graph_timeout_s
@@ -50,26 +56,31 @@ class RemoteRuntime(Runtime):
     # -- Runtime ---------------------------------------------------------------
 
     def start(self, workflow: "LzyWorkflow") -> None:
+        from lzy_tpu import __version__
+
         config = workflow.owner.storage_registry.default_config()
         execution_id = self._client.start_workflow(
             self._user, workflow.name, config.uri,
             execution_id=workflow.execution_id,
+            token=self._token, client_version=__version__,
         )
         self._executions[workflow.execution_id] = execution_id
 
     def finish(self, workflow: "LzyWorkflow") -> None:
-        self._client.finish_workflow(workflow.execution_id)
+        self._client.finish_workflow(workflow.execution_id, token=self._token)
         self._executions.pop(workflow.execution_id, None)
 
     def abort(self, workflow: "LzyWorkflow") -> None:
         try:
-            self._client.abort_workflow(workflow.execution_id)
+            self._client.abort_workflow(workflow.execution_id, token=self._token)
         finally:
             self._executions.pop(workflow.execution_id, None)
 
     def exec(self, workflow: "LzyWorkflow", calls: Sequence["LzyCall"]) -> None:
         graph = self._build_graph(workflow, calls)
-        graph_op_id = self._client.execute_graph(workflow.execution_id, graph.to_doc())
+        graph_op_id = self._client.execute_graph(
+            workflow.execution_id, graph.to_doc(), token=self._token
+        )
         if graph_op_id is None:
             _LOG.info("results of all graph operations are cached")
         else:
@@ -85,6 +96,7 @@ class RemoteRuntime(Runtime):
         snapshot = workflow.snapshot
         config = workflow.owner.storage_registry.default_config()
         pools = self._client.get_pool_specs()
+        module_cache: Dict[int, List[str]] = {}
         tasks: List[TaskDesc] = []
         for call in calls:
             prov = call.env.provisioning or Provisioning()
@@ -93,6 +105,19 @@ class RemoteRuntime(Runtime):
             snapshot.storage_client.write_bytes(
                 func_uri, cloudpickle.dumps(call.signature.remote_payload)
             )
+
+            archives: List[str] = []
+            if call.env.python_env is not None:
+                key = id(call.env.python_env)
+                if key not in module_cache:
+                    from lzy_tpu.env.modules import upload_local_modules
+
+                    spec = call.env.python_env.spec()
+                    module_cache[key] = upload_local_modules(
+                        spec.local_module_paths, snapshot.storage_client,
+                        config.uri,
+                    )
+                archives = module_cache[key]
 
             def ref(eid: str, name: str = "") -> EntryRef:
                 entry = snapshot.get_entry(eid)
@@ -112,6 +137,7 @@ class RemoteRuntime(Runtime):
                 gang_size=pool.hosts,
                 env_vars=dict(call.env.env_vars),
                 std_logs_uri=join_uri(snapshot.storage_prefix, "logs"),
+                module_archives=archives,
             ))
         return GraphDesc(
             id=gen_id("graph"),
@@ -126,7 +152,9 @@ class RemoteRuntime(Runtime):
                          calls: Sequence["LzyCall"]) -> None:
         deadline = time.time() + self._graph_timeout_s
         while True:
-            status = self._client.graph_status(workflow.execution_id, graph_op_id)
+            status = self._client.graph_status(
+                workflow.execution_id, graph_op_id, token=self._token
+            )
             if self._stream_logs:
                 self._pump_logs(workflow)
             if status["status"] == "DONE":
@@ -134,7 +162,9 @@ class RemoteRuntime(Runtime):
             if status["status"] == "FAILED":
                 self._raise_remote(workflow, status, calls)
             if time.time() > deadline:
-                self._client.stop_graph(workflow.execution_id, graph_op_id)
+                self._client.stop_graph(
+                    workflow.execution_id, graph_op_id, token=self._token
+                )
                 raise TimeoutError(
                     f"graph {graph_op_id} still running after {self._graph_timeout_s}s"
                 )
@@ -143,7 +173,8 @@ class RemoteRuntime(Runtime):
     def _pump_logs(self, workflow: "LzyWorkflow") -> None:
         try:
             logs = self._client.read_std_logs(
-                workflow.execution_id, dict(self._printed_logs)
+                workflow.execution_id, dict(self._printed_logs),
+                token=self._token,
             )
         except Exception:
             return

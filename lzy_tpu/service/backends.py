@@ -16,6 +16,7 @@ what the rest of the system is tested against.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable, Dict, Optional
 
@@ -39,12 +40,14 @@ class ThreadVmBackend(VmBackend):
         *,
         heartbeat_period_s: float = 1.0,
         launch_delay_s: float = 0.0,      # simulate boot latency in tests
+        spill_root: Optional[str] = None,  # per-VM dirs; enables native p2p
     ):
         self._channels = channels
         self._storage = storage_client
         self._serializers = serializers
         self._heartbeat_period_s = heartbeat_period_s
         self._launch_delay_s = launch_delay_s
+        self._spill_root = spill_root
         self._agents: Dict[str, WorkerAgent] = {}
         self._lock = threading.Lock()
         self.allocator = None             # wired by the harness after both exist
@@ -61,6 +64,9 @@ class ThreadVmBackend(VmBackend):
                 import time
 
                 time.sleep(self._launch_delay_s)
+            spill = None
+            if self._spill_root is not None:
+                spill = os.path.join(self._spill_root, vm.id)
             agent = WorkerAgent(
                 vm.id,
                 allocator=self.allocator,
@@ -68,6 +74,7 @@ class ThreadVmBackend(VmBackend):
                 storage_client=self._storage,
                 serializers=self._serializers,
                 heartbeat_period_s=self._heartbeat_period_s,
+                spill_root=spill,
             )
             with self._lock:
                 self._agents[vm.id] = agent

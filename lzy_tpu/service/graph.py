@@ -40,6 +40,7 @@ class TaskDesc:
     gang_size: int = 1
     env_vars: Dict[str, str] = dataclasses.field(default_factory=dict)
     std_logs_uri: str = ""              # where the worker writes <task>.log
+    module_archives: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def input_entries(self) -> List[EntryRef]:
@@ -58,6 +59,7 @@ class TaskDesc:
             "gang_size": self.gang_size,
             "env_vars": dict(self.env_vars),
             "std_logs_uri": self.std_logs_uri,
+            "module_archives": list(self.module_archives),
         }
 
     @staticmethod
@@ -74,6 +76,7 @@ class TaskDesc:
             gang_size=doc.get("gang_size", 1),
             env_vars=doc.get("env_vars", {}),
             std_logs_uri=doc.get("std_logs_uri", ""),
+            module_archives=doc.get("module_archives", []),
         )
 
 

@@ -48,6 +48,8 @@ class InProcessCluster:
         max_running_tasks: int = 8,
         poll_period_s: float = 0.02,
         vm_boot_delay_s: float = 0.0,
+        p2p_spill_root: Optional[str] = None,
+        with_iam: bool = False,
     ):
         self.storage_uri = storage_uri
         self.store = OperationStore(db_path)
@@ -57,7 +59,7 @@ class InProcessCluster:
         self.storage_client = client_for(StorageConfig(uri=storage_uri))
         self.backend = ThreadVmBackend(
             self.channels, self.storage_client, self.serializers,
-            launch_delay_s=vm_boot_delay_s,
+            launch_delay_s=vm_boot_delay_s, spill_root=p2p_spill_root,
         )
         self.allocator = AllocatorService(
             self.store, self.executor, self.backend, pools or DEFAULT_POOLS
@@ -67,9 +69,14 @@ class InProcessCluster:
             self.store, self.executor, self.allocator, self.channels,
             max_running_tasks=max_running_tasks, poll_period_s=poll_period_s,
         )
+        self.iam = None
+        if with_iam:
+            from lzy_tpu.iam import IamService
+
+            self.iam = IamService(self.store)
         self.workflow_service = WorkflowService(
             self.store, self.executor, self.allocator, self.channels,
-            self.graph_executor, self.storage_client,
+            self.graph_executor, self.storage_client, iam=self.iam,
         )
 
     @property
@@ -77,16 +84,16 @@ class InProcessCluster:
         """In-process 'stub': same method surface a gRPC client would have."""
         return self.workflow_service
 
-    def lzy(self, *, user: str = "test-user", stream_logs: bool = False,
-            poll_period_s: float = 0.02) -> Lzy:
+    def lzy(self, *, user: str = "test-user", token: Optional[str] = None,
+            stream_logs: bool = False, poll_period_s: float = 0.02) -> Lzy:
         storage = DefaultStorageRegistry()
         storage.register_storage(
             "default", StorageConfig(uri=self.storage_uri), default=True
         )
         return Lzy(
             runtime=RemoteRuntime(
-                self.client, user=user, poll_period_s=poll_period_s,
-                stream_logs=stream_logs,
+                self.client, user=user, token=token,
+                poll_period_s=poll_period_s, stream_logs=stream_logs,
             ),
             storage_registry=storage,
             serializer_registry=self.serializers,

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextvars
 import io
+import os
 import pickle
 import sys
 import threading
@@ -89,6 +90,8 @@ class WorkerAgent:
         storage_client: StorageClient,
         serializers: Optional[SerializerRegistry] = None,
         heartbeat_period_s: float = 5.0,
+        spill_root: Optional[str] = None,  # enables the native p2p slot server
+        advertise_host: str = "127.0.0.1", # routable address for p2p peers
     ):
         self.vm_id = vm_id
         self._allocator = allocator
@@ -99,6 +102,15 @@ class WorkerAgent:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._owner: Optional[str] = None
+        self._slot_server = None
+        self._spill_root = spill_root
+        self._advertise_host = advertise_host
+        if spill_root is not None:
+            from lzy_tpu.native import SlotServer, native_available
+
+            os.makedirs(spill_root, exist_ok=True)
+            if native_available():  # negative result is cached; boot stays fast
+                self._slot_server = SlotServer(spill_root)
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_period_s,),
             name=f"hb-{vm_id}", daemon=True,
@@ -112,6 +124,9 @@ class WorkerAgent:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._slot_server is not None:
+            self._slot_server.stop()
+            self._slot_server = None
 
     def _heartbeat_loop(self, period_s: float) -> None:
         while not self._stop.wait(period_s):
@@ -182,6 +197,18 @@ class WorkerAgent:
                 self._flush_logs(task, log_buf.getvalue())
 
     def _execute_task(self, task: TaskDesc, gang_rank: int) -> None:
+        # isolated workers (own interpreter, real remote backends) sync the
+        # user's local modules first; in-process thread workers share the
+        # client interpreter and skip (startup.py LOCAL_MODULES parity)
+        if task.module_archives and os.environ.get("LZY_WORKER_ISOLATED"):
+            import tempfile
+
+            from lzy_tpu.env.modules import unpack_modules
+
+            unpack_modules(
+                task.module_archives, self._storage,
+                tempfile.mkdtemp(prefix="lzy_modules_"),
+            )
         for ref in task.input_entries:
             self._channels.bind(ref.id, CONSUMER, task.id)
         for ref in task.outputs:
@@ -215,12 +242,21 @@ class WorkerAgent:
     # -- data plane (startup.py read_data/write_data parity) -------------------
 
     def _read_entry(self, ref) -> Any:
-        self._channels.wait_available(ref.id)
+        ch = self._channels.wait_available(ref.id)
         device_value = self._channels.device.take(ref.id)
         if device_value is not None:
             return device_value  # ICI fast path: value never left the slice
         meta = self._read_meta(ref.uri)
         serializer = self._serializers.find_by_format(meta["data_format"])
+        # direct peer transfer (native slot stream) before the storage peer
+        if ch.slot_peer is not None and self._spill_root is not None:
+            from lzy_tpu.channels.p2p import fetch_via_peer
+
+            dest = os.path.join(self._spill_root,
+                                f"in-{ref.id.replace('/', '_')}")
+            if fetch_via_peer(ch.slot_peer, dest):
+                with open(dest, "rb") as f:
+                    return serializer.deserialize(f)
         src = self._storage.open_read(ref.uri)
         try:
             return serializer.deserialize(src)
@@ -235,6 +271,23 @@ class WorkerAgent:
         buf = io.BytesIO()
         serializer.serialize(value, buf)
         data = buf.getvalue()
+        if self._slot_server is not None:
+            # best-effort fast path: any spill failure falls back to the
+            # storage peer below instead of failing the task
+            try:
+                from lzy_tpu.channels.p2p import SlotPeer
+                from lzy_tpu.native import fnv1a_file
+
+                name = ref.id.replace("/", "_")
+                spill = os.path.join(self._spill_root, name)
+                with open(spill, "wb") as f:
+                    f.write(data)
+                self._channels.publish_peer(ref.id, SlotPeer(
+                    host=self._advertise_host, port=self._slot_server.port,
+                    name=name, fnv1a=fnv1a_file(spill),
+                ))
+            except Exception:
+                _LOG.warning("p2p spill of %s failed; storage only", ref.id)
         self._storage.write_bytes(ref.uri, data)
         from lzy_tpu.utils import hashing
 

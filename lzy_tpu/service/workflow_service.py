@@ -30,7 +30,18 @@ FINISHED = "FINISHED"
 ABORTED = "ABORTED"
 
 
+def _parse_version(v: str):
+    try:
+        return tuple(int(x) for x in v.split("."))
+    except ValueError:
+        return None
+
+
 class WorkflowService:
+    # oldest SDK allowed to talk to this service (ClientVersionInterceptor +
+    # ClientVersions table parity, `lzy-service/.../util/ClientVersionInterceptor.java`)
+    MIN_CLIENT_VERSION = "0.1.0"
+
     def __init__(
         self,
         store: OperationStore,
@@ -39,6 +50,7 @@ class WorkflowService:
         channels: ChannelManager,
         graph_executor: GraphExecutor,
         storage_client: StorageClient,
+        iam=None,                        # Optional[IamService]; None = open access
     ):
         self._store = store
         self._executor = executor
@@ -46,12 +58,53 @@ class WorkflowService:
         self._channels = channels
         self._ge = graph_executor
         self._storage = storage_client
+        self._iam = iam
+
+    # -- auth / version gates --------------------------------------------------
+
+    def _check_version(self, client_version) -> None:
+        # absent version == pre-gate SDK == too old (the clients the gate
+        # exists for are exactly the ones that don't send a version)
+        got = _parse_version(client_version) if client_version else None
+        need = _parse_version(self.MIN_CLIENT_VERSION)
+        if got is None or got < need:
+            raise RuntimeError(
+                f"unsupported client version {client_version!r}; "
+                f"minimum is {self.MIN_CLIENT_VERSION} — please upgrade"
+            )
+
+    def _authn(self, token):
+        if self._iam is None:
+            return None
+        return self._iam.authenticate(token)
+
+    def _authz(self, token, permission, execution_id=None) -> None:
+        if self._iam is None:
+            return
+        subject = self._iam.authenticate(token)
+        owner = None
+        if execution_id is not None:
+            owner = self._execution(execution_id).get("user")
+        self._iam.authorize(subject, permission, resource_owner=owner)
 
     # -- workflow lifecycle (startWorkflow/finishWorkflow/abortWorkflow) -------
 
     def start_workflow(self, user: str, workflow_name: str, storage_uri: str,
-                       execution_id: Optional[str] = None) -> str:
+                       execution_id: Optional[str] = None, *,
+                       token: Optional[str] = None,
+                       client_version: Optional[str] = None) -> str:
+        from lzy_tpu.iam import WORKFLOW_RUN
+
+        self._check_version(client_version)
+        subject = self._authn(token)
+        if subject is not None:
+            self._iam.authorize(subject, WORKFLOW_RUN)
+            user = subject.id
         execution_id = execution_id or gen_id(f"exec-{workflow_name}")
+        if self._store.kv_get("executions", execution_id) is not None:
+            # a client-chosen id must not overwrite (or hijack) an existing
+            # execution — sessions/graphs would leak and ownership transfer
+            raise RuntimeError(f"execution {execution_id!r} already exists")
         session_id = self._allocator.create_session(owner=user)
         self._store.kv_put("executions", execution_id, {
             "user": user,
@@ -65,10 +118,21 @@ class WorkflowService:
         _LOG.info("started execution %s (session %s)", execution_id, session_id)
         return execution_id
 
-    def finish_workflow(self, execution_id: str) -> None:
+    def finish_workflow(self, execution_id: str, *,
+                        token: Optional[str] = None) -> None:
+        from lzy_tpu.iam import WORKFLOW_MANAGE
+
+        self._authz(token, WORKFLOW_MANAGE, execution_id)
         self._teardown(execution_id, FINISHED)
 
-    def abort_workflow(self, execution_id: str) -> None:
+    def abort_workflow(self, execution_id: str, *,
+                       token: Optional[str] = None) -> None:
+        from lzy_tpu.iam import WORKFLOW_MANAGE
+
+        self._authz(token, WORKFLOW_MANAGE, execution_id)
+        self._abort(execution_id)
+
+    def _abort(self, execution_id: str) -> None:
         exec_doc = self._execution(execution_id)
         for graph_op_id in exec_doc.get("graphs", []):
             try:
@@ -93,10 +157,14 @@ class WorkflowService:
 
     # -- graphs (executeGraph/graphStatus/stopGraph) ---------------------------
 
-    def execute_graph(self, execution_id: str, graph_doc: Dict[str, Any]) -> Optional[str]:
+    def execute_graph(self, execution_id: str, graph_doc: Dict[str, Any], *,
+                      token: Optional[str] = None) -> Optional[str]:
         """Compile + run a graph. Returns the graph op id, or None when every
         task was satisfied from cache ("Results of all graph operations are
         cached", ``remote/runtime.py:170-172``)."""
+        from lzy_tpu.iam import WORKFLOW_RUN
+
+        self._authz(token, WORKFLOW_RUN, execution_id)
         exec_doc = self._execution(execution_id)
         if exec_doc["status"] != ACTIVE:
             raise RuntimeError(f"execution {execution_id} is {exec_doc['status']}")
@@ -134,11 +202,37 @@ class WorkflowService:
             for o in task.outputs
         )
 
-    def graph_status(self, execution_id: str, graph_op_id: str) -> Dict[str, Any]:
+    def graph_status(self, execution_id: str, graph_op_id: str, *,
+                     token: Optional[str] = None) -> Dict[str, Any]:
+        from lzy_tpu.iam import WORKFLOW_READ
+
+        self._authz(token, WORKFLOW_READ, execution_id)
         return self._ge.status(graph_op_id)
 
-    def stop_graph(self, execution_id: str, graph_op_id: str) -> None:
+    def stop_graph(self, execution_id: str, graph_op_id: str, *,
+                   token: Optional[str] = None) -> None:
+        from lzy_tpu.iam import WORKFLOW_MANAGE
+
+        self._authz(token, WORKFLOW_MANAGE, execution_id)
         self._ge.stop(graph_op_id)
+
+    # -- GC (lzy-service GarbageCollector parity: reap abandoned executions) ---
+
+    def gc_tick(self, *, ttl_s: float = 86_400.0,
+                now: Optional[float] = None) -> List[str]:
+        """Abort ACTIVE executions older than ``ttl_s`` (clients that died
+        without finish/abort). Returns reaped execution ids."""
+        now = now if now is not None else time.time()
+        reaped = []
+        for execution_id, doc in self._store.kv_list("executions").items():
+            if doc.get("status") == ACTIVE and now - doc.get("started_at", now) > ttl_s:
+                _LOG.warning("gc aborting stale execution %s", execution_id)
+                try:
+                    self._abort(execution_id)
+                    reaped.append(execution_id)
+                except Exception:
+                    _LOG.exception("gc failed to abort %s", execution_id)
+        return reaped
 
     # -- pools (getAvailablePools / VmPoolService parity) ----------------------
 
@@ -148,11 +242,15 @@ class WorkflowService:
     # -- std logs (readStdSlots parity, poll-based with resume offsets) --------
 
     def read_std_logs(self, execution_id: str,
-                      offsets: Optional[Dict[str, int]] = None) -> Dict[str, str]:
+                      offsets: Optional[Dict[str, int]] = None, *,
+                      token: Optional[str] = None) -> Dict[str, str]:
         """Task id → stdout/stderr bytes past the caller's offset. Offset-
         resumable like the reference's Kafka listener offsets
         (``KafkaLogsListeners.java:24-139``); only the execution's own log
         prefix is listed and only fresh suffixes are transferred."""
+        from lzy_tpu.iam import WORKFLOW_READ
+
+        self._authz(token, WORKFLOW_READ, execution_id)
         offsets = offsets or {}
         exec_doc = self._execution(execution_id)
         prefix = join_uri(

@@ -1,0 +1,315 @@
+// lzy-tpu native data plane: slot streaming with offset resume.
+//
+// The reference's hot data loop is chunked point-to-point streaming with
+// offset-resumable reads (lzy/slots/.../transfers/SlotInputTransfer.java:21-60
+// and the util-s3 transmitter loops). This is its TPU-build native equivalent:
+// a small C++ engine that serves local files over TCP and pulls remote ones,
+// resuming from any byte offset, with FNV-1a end-to-end checksums. Exposed to
+// Python via a C ABI (ctypes) — see lzy_tpu/native/.
+//
+// Protocol (little-endian):
+//   request:  'L''Z''Y''S' u32 name_len  bytes name  u64 offset
+//   response: u8 status(0 ok, 1 not found)  u64 total_size  bytes[total-offset]
+//
+// Build: make -C native  (produces build/liblzy_slots.so)
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <fcntl.h>
+#include <map>
+#include <mutex>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x53595A4C;  // "LZYS"
+constexpr size_t kChunk = 1 << 20;       // 1 MiB streaming chunks
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::string root;
+  std::thread accept_thread;
+  bool stopping = false;
+};
+
+std::mutex g_mu;
+std::map<int, Server*> g_servers;
+int g_next_handle = 1;
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// reject path escapes: served names must stay under the root
+bool safe_name(const std::string& name) {
+  return name.find("..") == std::string::npos && !name.empty() &&
+         name[0] != '/';
+}
+
+void serve_conn(Server* srv, int conn) {
+  uint32_t magic = 0, name_len = 0;
+  uint64_t offset = 0;
+  if (!read_exact(conn, &magic, 4) || magic != kMagic ||
+      !read_exact(conn, &name_len, 4) || name_len > 4096) {
+    ::close(conn);
+    return;
+  }
+  std::string name(name_len, '\0');
+  if (!read_exact(conn, name.data(), name_len) ||
+      !read_exact(conn, &offset, 8) || !safe_name(name)) {
+    ::close(conn);
+    return;
+  }
+  std::string path = srv->root + "/" + name;
+  int fd = ::open(path.c_str(), O_RDONLY);
+  uint8_t status = fd < 0 ? 1 : 0;
+  uint64_t total = 0;
+  if (fd >= 0) {
+    struct stat st;
+    ::fstat(fd, &st);
+    total = static_cast<uint64_t>(st.st_size);
+  }
+  if (!write_exact(conn, &status, 1) || !write_exact(conn, &total, 8) ||
+      fd < 0) {
+    if (fd >= 0) ::close(fd);
+    ::close(conn);
+    return;
+  }
+  if (offset < total) {
+    ::lseek(fd, static_cast<off_t>(offset), SEEK_SET);
+    std::vector<char> buf(kChunk);
+    uint64_t remaining = total - offset;
+    while (remaining > 0) {
+      size_t want = remaining < kChunk ? remaining : kChunk;
+      ssize_t r = ::read(fd, buf.data(), want);
+      if (r <= 0) break;
+      if (!write_exact(conn, buf.data(), static_cast<size_t>(r))) break;
+      remaining -= static_cast<uint64_t>(r);
+    }
+  }
+  ::close(fd);
+  ::close(conn);
+}
+
+void accept_loop(Server* srv) {
+  while (true) {
+    int conn = ::accept(srv->listen_fd, nullptr, nullptr);
+    if (conn < 0) {
+      if (srv->stopping) return;
+      if (errno == EINTR) continue;
+      return;
+    }
+    int one = 1;
+    ::setsockopt(conn, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(serve_conn, srv, conn).detach();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Starts a server rooted at |root_dir| on |port| (0 = ephemeral).
+// Returns handle > 0, or -errno.
+int lzy_slots_server_start(const char* root_dir, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -errno;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 64) < 0) {
+    int err = errno;
+    ::close(fd);
+    return -err;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+
+  auto* srv = new Server();
+  srv->listen_fd = fd;
+  srv->port = ntohs(addr.sin_port);
+  srv->root = root_dir;
+  srv->accept_thread = std::thread(accept_loop, srv);
+
+  std::lock_guard<std::mutex> lock(g_mu);
+  int handle = g_next_handle++;
+  g_servers[handle] = srv;
+  return handle;
+}
+
+int lzy_slots_server_port(int handle) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  auto it = g_servers.find(handle);
+  return it == g_servers.end() ? -1 : it->second->port;
+}
+
+void lzy_slots_server_stop(int handle) {
+  Server* srv = nullptr;
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    auto it = g_servers.find(handle);
+    if (it == g_servers.end()) return;
+    srv = it->second;
+    g_servers.erase(it);
+  }
+  srv->stopping = true;
+  ::shutdown(srv->listen_fd, SHUT_RDWR);
+  ::close(srv->listen_fd);
+  srv->accept_thread.join();
+  delete srv;
+}
+
+// Pulls |remote_name| from host:port into |dest_path|, resuming from
+// |offset| (appends; caller passes current local size to resume).
+// |max_bytes| > 0 caps this call (for testing interrupted transfers).
+// Returns new local size >= 0, or -errno / -EPROTO on protocol error,
+// -ENOENT if remote missing.
+long long lzy_slots_pull(const char* host, int port, const char* remote_name,
+                         const char* dest_path, long long offset,
+                         long long max_bytes) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -errno;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -EINVAL;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    int err = errno;
+    ::close(fd);
+    return -err;
+  }
+  uint32_t magic = kMagic;
+  uint32_t name_len = static_cast<uint32_t>(strlen(remote_name));
+  uint64_t off = static_cast<uint64_t>(offset);
+  if (!write_exact(fd, &magic, 4) || !write_exact(fd, &name_len, 4) ||
+      !write_exact(fd, remote_name, name_len) || !write_exact(fd, &off, 8)) {
+    ::close(fd);
+    return -EPROTO;
+  }
+  uint8_t status = 0;
+  uint64_t total = 0;
+  if (!read_exact(fd, &status, 1) || !read_exact(fd, &total, 8)) {
+    ::close(fd);
+    return -EPROTO;
+  }
+  if (status != 0) {
+    ::close(fd);
+    return -ENOENT;
+  }
+  int out = ::open(dest_path, O_WRONLY | O_CREAT, 0644);
+  if (out < 0) {
+    int err = errno;
+    ::close(fd);
+    return -err;
+  }
+  ::lseek(out, static_cast<off_t>(offset), SEEK_SET);
+  ::ftruncate(out, static_cast<off_t>(offset));
+
+  std::vector<char> buf(kChunk);
+  uint64_t received = off;
+  uint64_t budget =
+      max_bytes > 0 ? static_cast<uint64_t>(max_bytes) : UINT64_MAX;
+  while (received < total && budget > 0) {
+    uint64_t left = total - received;
+    size_t want = left < kChunk ? left : kChunk;
+    if (want > budget) want = budget;
+    ssize_t r = ::read(fd, buf.data(), want);
+    if (r < 0 && errno == EINTR) continue;
+    if (r <= 0) break;
+    if (!write_exact(out, buf.data(), static_cast<size_t>(r))) break;
+    received += static_cast<uint64_t>(r);
+    budget -= static_cast<uint64_t>(r);
+  }
+  ::close(out);
+  ::close(fd);
+  return static_cast<long long>(received);
+}
+
+// Remote object size, or -errno. Used to validate completed transfers.
+long long lzy_slots_stat(const char* host, int port, const char* remote_name) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -errno;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  ::inet_pton(AF_INET, host, &addr.sin_addr);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    int err = errno;
+    ::close(fd);
+    return -err;
+  }
+  uint32_t magic = kMagic;
+  uint32_t name_len = static_cast<uint32_t>(strlen(remote_name));
+  uint64_t off = UINT64_MAX;  // offset past any file: headers only
+  uint8_t status = 0;
+  uint64_t total = 0;
+  bool ok = write_exact(fd, &magic, 4) && write_exact(fd, &name_len, 4) &&
+            write_exact(fd, remote_name, name_len) && write_exact(fd, &off, 8) &&
+            read_exact(fd, &status, 1) && read_exact(fd, &total, 8);
+  ::close(fd);
+  if (!ok) return -EPROTO;
+  if (status != 0) return -ENOENT;
+  return static_cast<long long>(total);
+}
+
+// FNV-1a 64-bit over a file; end-to-end transfer integrity checks.
+unsigned long long lzy_fnv1a_file(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return 0;
+  uint64_t h = 1469598103934665603ULL;
+  std::vector<char> buf(kChunk);
+  ssize_t r;
+  while ((r = ::read(fd, buf.data(), buf.size())) > 0) {
+    for (ssize_t i = 0; i < r; i++) {
+      h ^= static_cast<uint8_t>(buf[i]);
+      h *= 1099511628211ULL;
+    }
+  }
+  ::close(fd);
+  return h;
+}
+
+}  // extern "C"

@@ -1,0 +1,40 @@
+"""Diamond dataflow graph: two parallel branches joined (reference scenario
+pylzy/tests/scenarios/complex_graph)."""
+from tests.scenarios._base import make_lzy
+from lzy_tpu import op
+
+
+@op
+def source() -> int:
+    return 10
+
+
+@op
+def left(x: int) -> int:
+    return x * 2
+
+
+@op
+def right(x: int) -> int:
+    return x + 5
+
+
+@op
+def join(a: int, b: int) -> int:
+    return a + b
+
+
+def main():
+    cluster, lzy = make_lzy()
+    try:
+        with lzy.workflow("complex"):
+            s = source()
+            result = join(left(s), right(s))
+            print(f"left branch: {int(left(s))}")
+            print(f"join result: {int(result)}")
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Remote op failure surfaces as the original exception (reference scenario
+exec_fail + exception_serialize)."""
+from tests.scenarios._base import make_lzy
+from lzy_tpu import op
+from lzy_tpu.core.workflow import RemoteCallError
+
+
+@op
+def broken(x: int) -> int:
+    raise KeyError(f"missing-{x}")
+
+
+def main():
+    cluster, lzy = make_lzy()
+    try:
+        with lzy.workflow("failing"):
+            r = broken(7)
+            print(r + 1)
+    except RemoteCallError as e:
+        cause = e.__cause__
+        print(f"caught: {type(cause).__name__} {cause}")
+        has_tb = any("remote traceback" in n for n in getattr(cause, "__notes__", []))
+        print(f"remote traceback attached: {has_tb}")
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

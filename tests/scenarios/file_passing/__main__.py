@@ -1,0 +1,35 @@
+"""File-typed values across ops (reference file_test scenario)."""
+import os
+
+from tests.scenarios._base import make_lzy
+from lzy_tpu import File, op
+
+
+@op
+def write_file(text: str) -> File:
+    import tempfile
+
+    fd = tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False)
+    fd.write(text)
+    fd.close()
+    return File(fd.name)
+
+
+@op
+def read_file(f: File) -> str:
+    return f.read_text()
+
+
+def main():
+    cluster, lzy = make_lzy()
+    try:
+        with lzy.workflow("files"):
+            f = write_file("file content here")
+            text = read_file(f)
+            print(f"roundtrip: {str(text)}")
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

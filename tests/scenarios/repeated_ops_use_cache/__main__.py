@@ -1,0 +1,27 @@
+"""Cached op executes once across workflow runs (reference scenarios
+repeated_{execs,ops}_use_cache / fully_cached_graph)."""
+from tests.scenarios._base import make_lzy
+from lzy_tpu import op
+
+RUNS = []
+
+
+@op(cache=True, version="1.0")
+def expensive(x: int) -> int:
+    RUNS.append(x)
+    return x * x
+
+
+def main():
+    cluster, lzy = make_lzy()
+    try:
+        for i in range(3):
+            with lzy.workflow("cached"):
+                print(f"run {i}: {int(expensive(6))}")
+        print(f"executions: {len(RUNS)}")
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

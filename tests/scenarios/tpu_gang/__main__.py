@@ -1,0 +1,25 @@
+"""Gang-scheduled TPU op sees its slice context (TPU-build addition)."""
+from tests.scenarios._base import make_lzy
+from lzy_tpu import op
+from lzy_tpu.service.worker import current_gang
+
+
+@op(tpu="v5e-16")
+def slice_info() -> dict:
+    g = current_gang()
+    return {"rank": g["rank"], "hosts": g["size"]}
+
+
+def main():
+    cluster, lzy = make_lzy()
+    try:
+        with lzy.workflow("gang"):
+            info = slice_info()
+            print(f"rank: {info['rank']}")
+            print(f"hosts: {info['hosts']}")
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

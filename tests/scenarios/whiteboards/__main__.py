@@ -1,0 +1,37 @@
+"""Whiteboard write/finalize/query (reference whiteboards scenario)."""
+import dataclasses
+
+from tests.scenarios._base import make_lzy
+from lzy_tpu import op, whiteboard
+
+
+@whiteboard("scenario_model")
+@dataclasses.dataclass
+class Model:
+    accuracy: float
+    weights: dict
+
+
+@op
+def train() -> dict:
+    return {"w0": 0.5, "w1": -0.25}
+
+
+def main():
+    cluster, lzy = make_lzy()
+    try:
+        with lzy.workflow("wb") as wf:
+            wb = wf.create_whiteboard(Model, tags=["best", "v1"])
+            wb.weights = train()
+            wb.accuracy = 0.93
+
+        found = lzy.whiteboards(name="scenario_model", tags=["best"])
+        print(f"found: {len(found)}")
+        print(f"accuracy: {found[0].accuracy}")
+        print(f"weights: {sorted(found[0].weights.items())}")
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,196 @@
+"""IAM, version-gate, execution-GC, and CLI tests."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from lzy_tpu import Lzy, op
+from lzy_tpu.iam import (
+    READER,
+    WORKFLOW_MANAGE,
+    WORKFLOW_RUN,
+    AuthError,
+    IamService,
+)
+from lzy_tpu.durable import OperationStore
+from lzy_tpu.service import InProcessCluster
+
+
+@op
+def plus_one(x: int) -> int:
+    return x + 1
+
+
+@pytest.fixture()
+def auth_cluster(tmp_path):
+    c = InProcessCluster(db_path=str(tmp_path / "meta.db"), with_iam=True)
+    yield c
+    c.shutdown()
+
+
+class TestIam:
+    def test_token_roundtrip(self, tmp_path):
+        store = OperationStore(str(tmp_path / "iam.db"))
+        iam = IamService(store)
+        token = iam.create_subject("alice")
+        subject = iam.authenticate(token)
+        assert subject.id == "alice" and subject.role == "OWNER"
+        store.close()
+
+    def test_bad_tokens_rejected(self, tmp_path):
+        store = OperationStore(str(tmp_path / "iam.db"))
+        iam = IamService(store)
+        token = iam.create_subject("alice")
+        with pytest.raises(AuthError, match="malformed"):
+            iam.authenticate("garbage")
+        with pytest.raises(AuthError, match="signature"):
+            iam.authenticate(token[:-4] + "0000")
+        iam.remove_subject("alice")
+        with pytest.raises(AuthError, match="unknown subject"):
+            iam.authenticate(token)
+        store.close()
+
+    def test_secret_survives_restart(self, tmp_path):
+        store = OperationStore(str(tmp_path / "iam.db"))
+        token = IamService(store).create_subject("alice")
+        # "rebooted" service over the same store validates old tokens
+        assert IamService(store).authenticate(token).id == "alice"
+        store.close()
+
+    def test_reader_cannot_run_workflows(self, tmp_path):
+        store = OperationStore(str(tmp_path / "iam.db"))
+        iam = IamService(store)
+        token = iam.create_subject("bob", role=READER)
+        subject = iam.authenticate(token)
+        with pytest.raises(AuthError, match="lacks"):
+            iam.authorize(subject, WORKFLOW_RUN)
+        iam.authorize(subject, "workflow.read")
+        store.close()
+
+    def test_workflow_requires_token(self, auth_cluster):
+        lzy = auth_cluster.lzy()  # no token
+        with pytest.raises(AuthError):
+            with lzy.workflow("wf"):
+                pass
+
+    def test_workflow_with_token_runs(self, auth_cluster):
+        token = auth_cluster.iam.create_subject("alice")
+        lzy = auth_cluster.lzy(token=token)
+        with lzy.workflow("wf"):
+            assert plus_one(1) == 2
+
+    def test_execution_id_cannot_be_hijacked(self, auth_cluster):
+        """Re-starting an existing execution id must be rejected, or another
+        subject could overwrite ownership and orphan the session."""
+        from lzy_tpu import __version__
+
+        alice = auth_cluster.iam.create_subject("alice")
+        mallory = auth_cluster.iam.create_subject("mallory")
+        execution_id = auth_cluster.client.start_workflow(
+            "alice", "wf", "mem://x", token=alice, client_version=__version__
+        )
+        with pytest.raises(RuntimeError, match="already exists"):
+            auth_cluster.client.start_workflow(
+                "mallory", "wf", "mem://x", execution_id=execution_id,
+                token=mallory, client_version=__version__,
+            )
+        auth_cluster.client.finish_workflow(execution_id, token=alice)
+
+    def test_other_user_cannot_touch_execution(self, auth_cluster):
+        alice = auth_cluster.iam.create_subject("alice")
+        mallory = auth_cluster.iam.create_subject("mallory")
+        lzy = auth_cluster.lzy(token=alice)
+        with lzy.workflow("wf") as wf:
+            plus_one(1)
+            with pytest.raises(AuthError, match="does not own"):
+                auth_cluster.client.abort_workflow(
+                    wf.execution_id, token=mallory
+                )
+
+
+class TestVersionGate:
+    def test_old_client_rejected(self, auth_cluster):
+        token = auth_cluster.iam.create_subject("alice")
+        with pytest.raises(RuntimeError, match="unsupported client version"):
+            auth_cluster.client.start_workflow(
+                "alice", "wf", "mem://x", token=token, client_version="0.0.1"
+            )
+
+    def test_versionless_client_rejected(self, auth_cluster):
+        """Pre-gate SDKs send no version at all — exactly who the gate is for."""
+        token = auth_cluster.iam.create_subject("alice")
+        with pytest.raises(RuntimeError, match="unsupported client version"):
+            auth_cluster.client.start_workflow(
+                "alice", "wf", "mem://x", token=token
+            )
+
+    def test_current_client_accepted(self, auth_cluster):
+        from lzy_tpu import __version__
+
+        token = auth_cluster.iam.create_subject("alice")
+        execution_id = auth_cluster.client.start_workflow(
+            "alice", "wf", "mem://x", token=token, client_version=__version__
+        )
+        auth_cluster.client.finish_workflow(execution_id, token=token)
+
+
+class TestExecutionGc:
+    def test_stale_active_execution_reaped(self, tmp_path):
+        cluster = InProcessCluster(db_path=str(tmp_path / "meta.db"))
+        try:
+            from lzy_tpu import __version__
+
+            execution_id = cluster.client.start_workflow(
+                "u", "wf", "mem://x", client_version=__version__
+            )
+            doc = cluster.store.kv_get("executions", execution_id)
+            doc["started_at"] = time.time() - 100_000
+            cluster.store.kv_put("executions", execution_id, doc)
+            reaped = cluster.workflow_service.gc_tick(ttl_s=3600)
+            assert reaped == [execution_id]
+            assert cluster.store.kv_get(
+                "executions", execution_id)["status"] == "ABORTED"
+            assert cluster.workflow_service.gc_tick(ttl_s=3600) == []
+        finally:
+            cluster.shutdown()
+
+
+class TestCli:
+    def run_cli(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "lzy_tpu", *args],
+            capture_output=True, text=True, cwd="/root/repo", timeout=120,
+        )
+
+    def test_version(self):
+        from lzy_tpu import __version__
+
+        result = self.run_cli("version")
+        assert result.returncode == 0
+        assert __version__ in result.stdout
+
+    def test_executions_and_vms(self, tmp_path):
+        db = str(tmp_path / "meta.db")
+        cluster = InProcessCluster(
+            db_path=db, storage_uri=f"file://{tmp_path}/storage"
+        )
+        try:
+            lzy = cluster.lzy()
+            with lzy.workflow("cli-wf"):
+                assert plus_one(1) == 2
+        finally:
+            cluster.shutdown()
+        result = self.run_cli("--db", db, "executions")
+        assert result.returncode == 0, result.stderr
+        assert "cli-wf" in result.stdout
+        assert "FINISHED" in result.stdout
+        result = self.run_cli("--db", db, "graphs")
+        assert result.returncode == 0
+        assert "1/1" in result.stdout
+
+    def test_missing_db_errors(self):
+        result = self.run_cli("executions")
+        assert result.returncode == 2
+        assert "--db" in result.stderr

@@ -1,0 +1,42 @@
+"""Scenario-diff harness: run each end-to-end scenario in its own process and
+compare stdout against its ``expected_stdout``, the reference's tier-4 pattern
+(``PythonContextTests`` + ``pylzy/tests/scenarios/<name>/expected_stdout``,
+SURVEY.md §4.4)."""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+SCENARIOS_DIR = pathlib.Path(__file__).parent / "scenarios"
+REPO_ROOT = SCENARIOS_DIR.parent.parent
+
+SCENARIOS = sorted(
+    p.name for p in SCENARIOS_DIR.iterdir()
+    if p.is_dir() and (p / "expected_stdout").exists()
+)
+
+
+@pytest.mark.parametrize("name", SCENARIOS)
+def test_scenario(name):
+    expected = (SCENARIOS_DIR / name / "expected_stdout").read_text()
+    result = subprocess.run(
+        [sys.executable, "-m", f"tests.scenarios.{name}"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert result.returncode == 0, (
+        f"scenario {name} exited {result.returncode}\n"
+        f"stdout:\n{result.stdout}\nstderr:\n{result.stderr[-3000:]}"
+    )
+    assert result.stdout == expected, (
+        f"scenario {name} output mismatch\n"
+        f"expected:\n{expected}\ngot:\n{result.stdout}"
+    )
+
+
+def test_all_scenarios_discovered():
+    assert len(SCENARIOS) >= 6
