@@ -1,0 +1,3 @@
+from lzy_tpu.data.pipeline import DataPipeline, synthetic_lm_batches
+
+__all__ = ["DataPipeline", "synthetic_lm_batches"]

@@ -37,3 +37,7 @@ __all__ = [
     "ring_attention",
     "initialize_gang",
 ]
+
+from lzy_tpu.parallel.checkpoint import CheckpointManager  # noqa: E402
+
+__all__.append("CheckpointManager")

@@ -1,0 +1,135 @@
+"""Model checkpointing: durable TrainState snapshots in workflow storage.
+
+The reference checkpoints at op granularity only (result caching + durable-op
+resume, SURVEY.md §5.4); real model checkpoints are a TPU-build addition built
+on the same storage conventions: ``<root>/lzy_checkpoints/<name>/step_<n>/``
+holds the state as the stable array-pytree format plus a manifest, and
+``latest`` is an atomic pointer. Saves can run asynchronously on a background
+thread so the TPU never waits on storage (device→host transfer happens
+synchronously, upload doesn't).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from lzy_tpu.serialization.jax_ser import ArrayPytreeSerializer
+from lzy_tpu.storage.api import StorageClient, join_uri
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+
+class CheckpointManager:
+    def __init__(self, client: StorageClient, root_uri: str, name: str,
+                 *, keep: int = 3):
+        self._client = client
+        self._base = join_uri(root_uri, "lzy_checkpoints", name)
+        self._keep = keep
+        self._pending: Optional[threading.Thread] = None
+        self._pending_error: list = []
+        self._ser = ArrayPytreeSerializer()
+
+    # -- save ------------------------------------------------------------------
+
+    def save(self, state: Any, step: int, *, metrics: Optional[Dict] = None,
+             blocking: bool = True) -> str:
+        """Snapshot ``state`` (any array pytree, e.g. TrainState) at ``step``.
+        With ``blocking=False`` the device→host transfer happens now but the
+        upload runs on a background thread (one in flight at a time)."""
+        host_state = jax.device_get(state)
+        uri = join_uri(self._base, f"step_{step:010d}")
+
+        def upload() -> None:
+            buf = io.BytesIO()
+            self._ser.serialize(host_state, buf)
+            self._client.write_bytes(join_uri(uri, "state"), buf.getvalue())
+            manifest = {"step": step, "metrics": metrics or {}}
+            self._client.write_bytes(
+                join_uri(uri, "manifest.json"),
+                json.dumps(manifest).encode("utf-8"),
+            )
+            # atomic latest pointer write comes last: a crash mid-upload never
+            # leaves `latest` pointing at a partial checkpoint
+            self._client.write_bytes(
+                join_uri(self._base, "latest"), str(step).encode("utf-8")
+            )
+            self._gc()
+            _LOG.info("checkpoint step %d saved", step)
+
+        self.wait()
+        if blocking:
+            upload()
+        else:
+            def guarded() -> None:
+                try:
+                    upload()
+                except BaseException as e:  # surfaced by the next wait()/save()
+                    self._pending_error.append(e)
+
+            self._pending = threading.Thread(
+                target=guarded, name=f"ckpt-{step}", daemon=True
+            )
+            self._pending.start()
+        return uri
+
+    def wait(self) -> None:
+        """Block until any in-flight async save lands; re-raises its failure —
+        a silently failing checkpoint loop would lose days of training."""
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+        if self._pending_error:
+            error = self._pending_error.pop()
+            raise RuntimeError("async checkpoint save failed") from error
+
+    # -- restore ---------------------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        uri = join_uri(self._base, "latest")
+        if not self._client.exists(uri):
+            return None
+        return int(self._client.read_bytes(uri).decode("utf-8"))
+
+    def steps(self) -> List[int]:
+        out = []
+        for uri in self._client.list(self._base):
+            if uri.endswith("/manifest.json"):
+                out.append(int(uri.rsplit("step_", 1)[1].split("/")[0]))
+        return sorted(out)
+
+    def restore(self, step: Optional[int] = None,
+                *, shardings: Any = None) -> Any:
+        """Load a checkpoint (default: latest). ``shardings`` (a pytree prefix
+        of NamedShardings) places arrays directly on the mesh."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self._base}")
+        uri = join_uri(self._base, f"step_{step:010d}", "state")
+        src = self._client.open_read(uri)
+        try:
+            state = self._ser.deserialize(src)
+        finally:
+            src.close()
+        if shardings is not None:
+            state = jax.device_put(state, shardings)
+        return state
+
+    def manifest(self, step: int) -> Dict:
+        uri = join_uri(self._base, f"step_{step:010d}", "manifest.json")
+        return json.loads(self._client.read_bytes(uri).decode("utf-8"))
+
+    # -- retention -------------------------------------------------------------
+
+    def _gc(self) -> None:
+        steps = self.steps()
+        for old in steps[: max(0, len(steps) - self._keep)]:
+            prefix = join_uri(self._base, f"step_{old:010d}")
+            for uri in list(self._client.list(prefix)):
+                self._client.delete(uri)
+            _LOG.info("checkpoint step %d reaped (keep=%d)", old, self._keep)

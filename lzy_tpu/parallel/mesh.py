@@ -18,7 +18,9 @@ import numpy as np
 from jax.sharding import Mesh
 
 # canonical axis order: outermost (cross-slice/DCN tolerant) → innermost (ICI)
-AXES = ("dp", "fsdp", "tp", "sp")
+# pp (pipeline stages) tolerates the least bandwidth → outermost; ep (experts)
+# needs all-to-alls → near dp/fsdp; tp needs full ICI → innermost
+AXES = ("pp", "dp", "fsdp", "ep", "tp", "sp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -26,8 +28,10 @@ class MeshSpec:
     """Named mesh shape. Unspecified axes default to 1; ``fsdp=-1`` (or any
     single axis set to -1) absorbs all remaining devices."""
 
+    pp: int = 1
     dp: int = 1
     fsdp: int = 1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
 

@@ -28,9 +28,10 @@ DEFAULT_RULES: Rules = {
     "kv": None,
     "head_dim": None,
     "vocab": "tp",
-    "expert": None,
+    "expert": "ep",
     "norm": None,
     "embed_out": None,
+    "stage": "pp",
     # conv models
     "conv_spatial": None,
     "channels_in": None,

@@ -34,8 +34,26 @@ from lzy_tpu.durable import (
 from lzy_tpu.types import PoolSpec, TpuPoolSpec, VmSpec
 from lzy_tpu.utils.ids import gen_id
 from lzy_tpu.utils.log import get_logger
+from lzy_tpu.utils.metrics import REGISTRY
 
 _LOG = get_logger(__name__)
+
+# AllocatorMetrics parity (`allocator/.../alloc/AllocatorMetrics.java:21-63`)
+_M_ALLOCS = REGISTRY.counter(
+    "lzy_allocations_total", "gang allocations by pool and source"
+)
+_M_ALLOC_SECONDS = REGISTRY.histogram(
+    "lzy_allocation_seconds", "allocation latency (request to gang RUNNING)"
+)
+_M_VMS = REGISTRY.gauge("lzy_vms", "VM count by status")
+
+
+def _update_vm_gauge(vms) -> None:
+    counts: dict = {}
+    for vm in vms:
+        counts[vm.status] = counts.get(vm.status, 0) + 1
+    for status in (ALLOCATING, RUNNING, IDLE, DELETING):
+        _M_VMS.set(counts.get(status, 0), status=status)
 
 ALLOCATING = "ALLOCATING"
 RUNNING = "RUNNING"
@@ -242,6 +260,7 @@ class AllocatorService:
 
     def _persist(self, vm: Vm) -> None:
         self._store.kv_put("vms", vm.id, vm.to_doc())
+        _update_vm_gauge(self.vms())  # every status transition passes here
 
     def _destroy(self, vm: Vm) -> None:
         try:
@@ -251,6 +270,7 @@ class AllocatorService:
                 self._vms.pop(vm.id, None)
                 self._agents.pop(vm.id, None)
             self._store.kv_del("vms", vm.id)
+            _update_vm_gauge(self.vms())
 
     def _find_cached_gang(self, session_id: str, pool_label: str,
                           gang_size: int) -> Optional[List[Vm]]:
@@ -307,13 +327,16 @@ class _AllocateGangAction(OperationRunner):
         pool_label = self.state["pool_label"]
         gang_size = self.state["gang_size"]
 
+        self.state.setdefault("requested_at", time.time())
         cached = self.svc._find_cached_gang(session_id, pool_label, gang_size)
         if cached is not None:
             _LOG.info("gang cache hit: %s", [v.id for v in cached])
+            _M_ALLOCS.inc(pool=pool_label, source="cache")
             self.state["vm_ids"] = [v.id for v in cached]
             self.state["gang_id"] = cached[0].gang_id
             self.state["cached"] = True
             return StepResult.CONTINUE
+        _M_ALLOCS.inc(pool=pool_label, source="launch")
 
         gang_id = gen_id("gang")
         vms = [
@@ -373,6 +396,9 @@ class _AllocateGangAction(OperationRunner):
             self._rollback()
             raise RuntimeError(f"gang member lost during allocation: {statuses}")
         if all(s == RUNNING for s in statuses):
+            requested_at = self.state.get("requested_at")
+            if requested_at:
+                _M_ALLOC_SECONDS.observe(time.time() - requested_at)
             return StepResult.finish(self._result())
         return StepResult.restart(0.1)
 

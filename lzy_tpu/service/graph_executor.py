@@ -26,8 +26,12 @@ from lzy_tpu.durable import (
 from lzy_tpu.service.allocator import AllocatorService
 from lzy_tpu.service.graph import GraphDesc, TaskDesc, build_dependencies
 from lzy_tpu.utils.log import get_logger
+from lzy_tpu.utils.metrics import REGISTRY
 
 _LOG = get_logger(__name__)
+
+_M_TASKS = REGISTRY.counter("lzy_tasks_total", "task completions by outcome")
+_M_GRAPHS = REGISTRY.counter("lzy_graphs_total", "graph completions by outcome")
 
 WAITING = "WAITING"
 RUNNING = "RUNNING"
@@ -126,7 +130,9 @@ class _ExecGraphAction(OperationRunner):
                 record = self.store.load(info["op_id"])
                 if record.status == DONE:
                     info["status"] = COMPLETED
+                    _M_TASKS.inc(outcome="completed")
                 elif record.status == FAILED:
+                    _M_TASKS.inc(outcome="failed")
                     info["status"] = TASK_FAILED
                     self.state["failed_task"] = tid
                     self.state["exception_uri"] = record.state.get("exception_uri")
@@ -158,6 +164,7 @@ class _ExecGraphAction(OperationRunner):
                 running += 1
 
         if all(i["status"] == COMPLETED for i in tasks.values()):
+            _M_GRAPHS.inc(outcome="completed")
             return StepResult.finish({"tasks": tasks})
         return StepResult.restart(self.svc.poll_period_s)
 
@@ -165,6 +172,7 @@ class _ExecGraphAction(OperationRunner):
         # stop-the-world for still-running tasks is cooperative: their actions
         # complete but the graph is already failed (reference keeps op-level
         # granularity, SURVEY.md §5.3 "no elasticity")
+        _M_GRAPHS.inc(outcome="failed")
         _LOG.warning("graph %s failed: %s", self.record.id, error)
 
 

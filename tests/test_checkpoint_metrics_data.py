@@ -1,0 +1,219 @@
+"""Checkpointing, metrics, and data-pipeline tests."""
+
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from lzy_tpu.data import DataPipeline, synthetic_lm_batches
+from lzy_tpu.parallel import (
+    CheckpointManager,
+    TrainState,
+    fsdp_mesh,
+    make_train_step,
+    named_sharding,
+)
+from lzy_tpu.storage import MemStorageClient
+from lzy_tpu.utils.metrics import MetricsRegistry
+
+
+class TestCheckpoint:
+    def _manager(self, **kwargs):
+        return CheckpointManager(
+            MemStorageClient(), "mem://ckpt", "model", **kwargs
+        )
+
+    def _state(self, seed=0):
+        params = {
+            "w": jnp.full((8, 8), float(seed), jnp.bfloat16),
+            "b": jnp.zeros((8,)),
+        }
+        tx = optax.adam(1e-3)
+        return TrainState.create(params, tx)
+
+    def test_save_restore_roundtrip(self):
+        mgr = self._manager()
+        state = self._state(seed=3)
+        mgr.save(state, step=10, metrics={"loss": 1.5})
+        assert mgr.latest_step() == 10
+        restored = mgr.restore()
+        assert restored.params["w"].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["w"], np.float32),
+            np.full((8, 8), 3.0),
+        )
+        assert int(restored.step) == 0
+        assert mgr.manifest(10)["metrics"]["loss"] == 1.5
+
+    def test_restore_with_shardings(self):
+        mesh = fsdp_mesh()
+        mgr = self._manager()
+        mgr.save(self._state(), step=1)
+        sh = named_sharding(mesh, None, None)
+        restored = mgr.restore(
+            shardings=TrainState(
+                step=NamedSharding(mesh, P()),
+                params={"w": named_sharding(mesh, "embed", None),
+                        "b": NamedSharding(mesh, P())},
+                opt_state=jax.tree_util.tree_map(
+                    lambda _: NamedSharding(mesh, P()),
+                    self._state().opt_state,
+                ),
+            )
+        )
+        assert restored.params["w"].sharding.spec == P("fsdp", None)
+
+    def test_retention_keeps_last_n(self):
+        mgr = self._manager(keep=2)
+        for step in (1, 2, 3, 4):
+            mgr.save(self._state(), step=step)
+        assert mgr.steps() == [3, 4]
+        assert mgr.latest_step() == 4
+        with pytest.raises(FileNotFoundError):
+            mgr.restore(step=1)
+
+    def test_async_save(self):
+        mgr = self._manager()
+        mgr.save(self._state(), step=5, blocking=False)
+        mgr.wait()
+        assert mgr.latest_step() == 5
+
+    def test_async_save_failure_surfaces_on_wait(self):
+        class BrokenClient(MemStorageClient):
+            def write(self, uri, src):
+                raise OSError("bucket gone")
+
+        mgr = CheckpointManager(BrokenClient(), "mem://b", "m")
+        mgr.save(self._state(), step=1, blocking=False)
+        with pytest.raises(RuntimeError, match="async checkpoint save failed"):
+            mgr.wait()
+
+    def test_restore_missing_raises(self):
+        with pytest.raises(FileNotFoundError, match="no checkpoints"):
+            self._manager().restore()
+
+    def test_train_resume_continuity(self):
+        """Save mid-training, restore, and continue: the restored run must
+        produce the same loss as the uninterrupted one."""
+        mesh = fsdp_mesh()
+
+        def loss_fn(p, batch):
+            return jnp.mean((batch["x"] @ p["w"]) ** 2)
+
+        tx = optax.sgd(0.1)
+        step, shard_state, _ = make_train_step(
+            loss_fn, tx, mesh=mesh,
+            param_logical_axes={"w": (None, None)},
+            batch_logical_axes=("batch",),
+        )
+        batch = {"x": jnp.ones((8, 4))}
+        state = shard_state(TrainState.create({"w": jnp.ones((4, 2))}, tx))
+        mgr = self._manager()
+
+        state, _ = step(state, batch)
+        mgr.save(state, step=1)
+        state, m_direct = step(state, batch)
+
+        restored = shard_state(mgr.restore(step=1))
+        _, m_resumed = step(restored, batch)
+        np.testing.assert_allclose(
+            float(m_direct["loss"]), float(m_resumed["loss"]), rtol=1e-6
+        )
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter("lzy_tasks_total", "tasks").inc(pool="cpu-small")
+        reg.counter("lzy_tasks_total").inc(2, pool="tpu-v5e-16")
+        reg.gauge("lzy_vms", "live vms").set(3, status="RUNNING")
+        reg.histogram("lzy_alloc_seconds", "alloc latency").observe(0.3)
+        text = reg.exposition()
+        assert 'lzy_tasks_total{pool="cpu-small"} 1.0' in text
+        assert 'lzy_tasks_total{pool="tpu-v5e-16"} 2.0' in text
+        assert 'lzy_vms{status="RUNNING"} 3' in text
+        assert 'lzy_alloc_seconds_bucket{le="0.5"} 1' in text
+        assert "lzy_alloc_seconds_count 1" in text
+
+    def test_type_conflict_rejected(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError, match="already registered"):
+            reg.gauge("x")
+
+    def test_timer_context(self):
+        reg = MetricsRegistry()
+        hist = reg.histogram("t", buckets=(0.05, 1.0))
+        with hist.time(op="sleep"):
+            time.sleep(0.01)
+        assert 't_bucket{op="sleep",le="1.0"} 1' in reg.exposition()
+
+    def test_http_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter("served_total").inc()
+        server = reg.serve()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=5
+            ).read().decode()
+            assert "served_total 1.0" in body
+        finally:
+            server.stop()
+
+
+class TestDataPipeline:
+    def test_batches_sharded_and_ordered(self):
+        mesh = fsdp_mesh()
+        sharding = named_sharding(mesh, "batch", None)
+        source = ({"tokens": np.full((8, 4), i, np.int32)} for i in range(5))
+        seen = []
+        for batch in DataPipeline(source, sharding, prefetch=2):
+            assert batch["tokens"].sharding.spec == P(("dp", "fsdp"), None)
+            seen.append(int(batch["tokens"][0, 0]))
+        assert seen == [0, 1, 2, 3, 4]
+
+    def test_source_error_propagates(self):
+        def bad():
+            yield {"x": np.zeros((8,))}
+            raise ValueError("source died")
+
+        mesh = fsdp_mesh()
+        pipe = DataPipeline(bad(), named_sharding(mesh, "batch"))
+        it = iter(pipe)
+        next(it)
+        with pytest.raises(ValueError, match="source died"):
+            next(it)
+
+    def test_early_break_stops_feeder(self):
+        """Breaking out of iteration must unblock and stop the feeder thread
+        (no leaked threads holding device batches)."""
+        mesh = fsdp_mesh()
+        before = {t.name for t in threading.enumerate()}
+        source = ({"x": np.zeros((8, 4))} for _ in range(1000))
+        for i, _ in enumerate(DataPipeline(source, named_sharding(mesh, "batch", None))):
+            if i == 1:
+                break
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            leaked = [t for t in threading.enumerate()
+                      if t.name == "data-pipeline" and t.name not in before]
+            if not leaked:
+                break
+            time.sleep(0.05)
+        assert not [t for t in threading.enumerate()
+                    if t.name == "data-pipeline"], "feeder thread leaked"
+
+    def test_synthetic_lm_batches_deterministic(self):
+        a = list(synthetic_lm_batches(batch_size=2, seq_len=4, vocab_size=10,
+                                      n_batches=3, seed=7))
+        b = list(synthetic_lm_batches(batch_size=2, seq_len=4, vocab_size=10,
+                                      n_batches=3, seed=7))
+        assert len(a) == 3
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x["tokens"], y["tokens"])

@@ -30,7 +30,8 @@ def test_eight_devices_available():
 class TestMesh:
     def test_fsdp_mesh_shape(self):
         mesh = fsdp_mesh()
-        assert mesh.shape == {"dp": 1, "fsdp": 8, "tp": 1, "sp": 1}
+        assert mesh.shape == {"pp": 1, "dp": 1, "fsdp": 8, "ep": 1,
+                              "tp": 1, "sp": 1}
 
     def test_mixed_mesh(self):
         mesh = mesh_for(tp=2, fsdp=-1)
