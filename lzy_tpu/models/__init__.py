@@ -24,3 +24,8 @@ __all__ = [
     "ResNet",
     "ResNetConfig",
 ]
+
+from lzy_tpu.models.generate import generate  # noqa: E402
+from lzy_tpu.models.moe import MoeConfig, MoeMlp  # noqa: E402
+
+__all__ += ["generate", "MoeConfig", "MoeMlp"]

@@ -48,6 +48,11 @@ class LlamaConfig:
     tie_embeddings: bool = False         # Llama-3 uses an untied lm_head
     use_ring_attention: bool = False     # sequence parallelism over 'sp'
     use_flash_kernel: bool = False       # Pallas kernel (TPU only)
+    # Mixtral-style sparse MLP: >0 replaces dense MLPs with MoE (ep-shardable)
+    n_experts: int = 0
+    moe_top_k: int = 2
+    # autoregressive decoding with a KV cache (see generate())
+    decode: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -113,6 +118,9 @@ class Attention(nn.Module):
         k = dense((kv, d), "k_proj", ("embed", "kv", "head_dim"))(x)
         v = dense((kv, d), "v_proj", ("embed", "kv", "head_dim"))(x)
 
+        if cfg.decode:
+            return self._decode_step(q, k, v, b)
+
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
 
@@ -144,6 +152,10 @@ class Attention(nn.Module):
             out = chunked_attention(q, k, v, causal=True, block_size=block)
 
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * d)
+        return self._o_proj(out)
+
+    def _o_proj(self, out):
+        cfg = self.cfg
         return nn.DenseGeneral(
             features=cfg.d_model, use_bias=False, name="o_proj",
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -151,6 +163,49 @@ class Attention(nn.Module):
                 nn.initializers.lecun_normal(), ("heads_merged", "embed")
             ),
         )(out)
+
+    def _decode_step(self, q, k, v, b):
+        """Single-token autoregressive step against the KV cache (flax cache
+        collection); q/k/v: [B, 1, heads|kv, D] pre-RoPE."""
+        cfg = self.cfg
+        h, kv_heads, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        L = cfg.max_seq_len
+        cache_k = self.variable(
+            "cache", "k", jnp.zeros, (b, L, kv_heads, d), cfg.dtype
+        )
+        cache_v = self.variable(
+            "cache", "v", jnp.zeros, (b, L, kv_heads, d), cfg.dtype
+        )
+        index = self.variable(
+            "cache", "index", lambda: jnp.zeros((), jnp.int32)
+        )
+        i = index.value
+        pos = jnp.full((b, 1), i, jnp.int32)
+        q = _rope(q, pos, cfg.rope_theta)
+        k = _rope(k, pos, cfg.rope_theta)
+        if not self.is_initializing():
+            # init() RUNS the module; writing during init would pre-populate
+            # the cache with the dummy token and shift every real position
+            cache_k.value = jax.lax.dynamic_update_slice(
+                cache_k.value, k.astype(cfg.dtype), (0, i, 0, 0)
+            )
+            cache_v.value = jax.lax.dynamic_update_slice(
+                cache_v.value, v.astype(cfg.dtype), (0, i, 0, 0)
+            )
+            index.value = i + 1
+
+        reps = h // kv_heads
+        keys = jnp.repeat(cache_k.value, reps, axis=2)    # [B, L, H, D]
+        vals = jnp.repeat(cache_v.value, reps, axis=2)
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, keys,
+            preferred_element_type=jnp.float32,
+        ) * (d ** -0.5)                                   # [B, H, 1, L]
+        visible = jnp.arange(L)[None, None, None, :] <= i
+        s = jnp.where(visible, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, vals)      # [B, 1, H, D]
+        return self._o_proj(out.reshape(b, 1, h * d))
 
 
 class Mlp(nn.Module):
@@ -186,10 +241,18 @@ class DecoderLayer(nn.Module):
             RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attn_norm")(x),
             positions, mesh,
         )
-        x = x + Mlp(cfg, name="mlp")(
-            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="mlp_norm")(x)
-        )
-        return x
+        h = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="mlp_norm")(x)
+        if cfg.n_experts > 0:
+            from lzy_tpu.models.moe import MoeConfig, MoeMlp
+
+            moe_out, aux = MoeMlp(MoeConfig(
+                d_model=cfg.d_model, d_ff=cfg.d_ff, n_experts=cfg.n_experts,
+                top_k=cfg.moe_top_k, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+            ), name="moe")(h)
+            self.sow("losses", "moe_aux", aux)
+            return x + moe_out
+        return x + Mlp(cfg, name="mlp")(h)
 
 
 class Llama(nn.Module):
@@ -247,16 +310,27 @@ def init_params(cfg: LlamaConfig, rng: jax.Array, seq_len: int = 8):
 
 
 def make_loss_fn(cfg: LlamaConfig, mesh=None):
-    """Causal-LM loss: predict tokens[t+1] from tokens[:t]."""
+    """Causal-LM loss: predict tokens[t+1] from tokens[:t]. MoE configs add
+    the routers' load-balancing aux losses."""
     model = Llama(cfg)
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]
-        logits = model.apply({"params": params}, tokens, mesh)
+        if cfg.n_experts > 0:
+            logits, sown = model.apply(
+                {"params": params}, tokens, mesh, mutable=["losses"]
+            )
+            aux = sum(
+                jax.tree_util.tree_leaves(sown.get("losses", {})),
+                jnp.zeros((), jnp.float32),
+            )
+        else:
+            logits = model.apply({"params": params}, tokens, mesh)
+            aux = 0.0
         mask = batch.get("mask")
         return cross_entropy_loss(
             logits[:, :-1], tokens[:, 1:],
             mask[:, 1:] if mask is not None else None,
-        )
+        ) + aux
 
     return loss_fn

@@ -43,9 +43,7 @@ def pipeline_apply(
     n_stages = mesh.shape[axis]
     n_micro = x.shape[0]
 
-    param_specs = jax.tree_util.tree_map(
-        lambda _: P(axis, *([None] * 0)), stage_params
-    )
+    param_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
 
     def local(params_local, x_all):
         # params_local leaves: [1, ...] — this device's stage

@@ -12,6 +12,7 @@ program on each host.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -48,6 +49,7 @@ class GraphExecutor:
         channels=None,
         *,
         max_running_tasks: int = 8,
+        max_running_tasks_per_user: int = 16,
         poll_period_s: float = 0.05,
     ):
         self._store = store
@@ -55,17 +57,37 @@ class GraphExecutor:
         self._allocator = allocator
         self._channels = channels
         self.max_running_tasks = max_running_tasks
+        self.max_running_tasks_per_user = max_running_tasks_per_user
         self.poll_period_s = poll_period_s
+        # cross-graph fairness accounting (TasksSchedulerImpl limits
+        # `:192-207` parity); in-memory — a restart re-admits from zero
+        self._user_running: Dict[str, int] = {}
+        self._user_lock = threading.Lock()
         executor.register("exec_graph", self._make_graph_action)
         executor.register("exec_task", self._make_task_action)
 
-    def execute(self, graph: GraphDesc, session_id: str) -> str:
+    def execute(self, graph: GraphDesc, session_id: str,
+                user: str = "") -> str:
         build_dependencies(graph.tasks)  # validate before accepting
         return self._executor.submit(
             "exec_graph",
-            {"graph": graph.to_doc(), "session_id": session_id, "tasks": {}},
+            {"graph": graph.to_doc(), "session_id": session_id,
+             "user": user, "tasks": {}},
             idempotency_key=f"graph-{graph.id}",
         )
+
+    # -- per-user admission ----------------------------------------------------
+
+    def _try_admit(self, user: str) -> bool:
+        with self._user_lock:
+            if self._user_running.get(user, 0) >= self.max_running_tasks_per_user:
+                return False
+            self._user_running[user] = self._user_running.get(user, 0) + 1
+            return True
+
+    def _release(self, user: str) -> None:
+        with self._user_lock:
+            self._user_running[user] = max(0, self._user_running.get(user, 0) - 1)
 
     def status(self, graph_op_id: str) -> Dict[str, Any]:
         record = self._store.load(graph_op_id)
@@ -125,13 +147,16 @@ class _ExecGraphAction(OperationRunner):
         by_id = {t.id: t for t in graph.tasks}
 
         # poll running task actions
+        user = self.state.get("user", "")
         for tid, info in tasks.items():
             if info["status"] == RUNNING:
                 record = self.store.load(info["op_id"])
                 if record.status == DONE:
                     info["status"] = COMPLETED
+                    self.svc._release(user)
                     _M_TASKS.inc(outcome="completed")
                 elif record.status == FAILED:
+                    self.svc._release(user)
                     _M_TASKS.inc(outcome="failed")
                     info["status"] = TASK_FAILED
                     self.state["failed_task"] = tid
@@ -153,6 +178,8 @@ class _ExecGraphAction(OperationRunner):
             if info["status"] != WAITING or running >= self.svc.max_running_tasks:
                 continue
             if all(tasks[d]["status"] == COMPLETED for d in self.state["deps"][tid]):
+                if not self.svc._try_admit(user):
+                    break  # user at their cross-graph limit; retry next round
                 info["op_id"] = self.executor.submit(
                     "exec_task",
                     {"task": by_id[tid].to_doc(),
@@ -171,7 +198,14 @@ class _ExecGraphAction(OperationRunner):
     def on_failed(self, error):
         # stop-the-world for still-running tasks is cooperative: their actions
         # complete but the graph is already failed (reference keeps op-level
-        # granularity, SURVEY.md §5.3 "no elasticity")
+        # granularity, SURVEY.md §5.3 "no elasticity").
+        # Release every still-admitted per-user slot — this action will never
+        # be driven again, so unreleased slots would pin the user at their
+        # limit forever.
+        user = self.state.get("user", "")
+        for info in self.state.get("tasks", {}).values():
+            if info.get("status") == RUNNING:
+                self.svc._release(user)
         _M_GRAPHS.inc(outcome="failed")
         _LOG.warning("graph %s failed: %s", self.record.id, error)
 

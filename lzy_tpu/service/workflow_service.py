@@ -191,7 +191,9 @@ class WorkflowService:
             return None
         graph = GraphDesc(id=graph.id, execution_id=execution_id,
                           storage_uri=graph.storage_uri, tasks=remaining)
-        graph_op_id = self._ge.execute(graph, exec_doc["session_id"])
+        graph_op_id = self._ge.execute(
+            graph, exec_doc["session_id"], user=exec_doc.get("user", "")
+        )
         exec_doc["graphs"].append(graph_op_id)
         self._store.kv_put("executions", execution_id, exec_doc)
         return graph_op_id

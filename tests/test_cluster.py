@@ -44,6 +44,19 @@ def add(a: int, b: int) -> int:
 # objects. Closure-captured state would be copied instead and invisible here.
 PROBE_STARTS = []
 HEAVY_RUNS = []
+CONCURRENCY = {"now": 0, "peak": 0}
+CONCURRENCY_LOCK = threading.Lock()
+
+
+@op
+def tracked_sleep(i: int) -> int:
+    with CONCURRENCY_LOCK:
+        CONCURRENCY["now"] += 1
+        CONCURRENCY["peak"] = max(CONCURRENCY["peak"], CONCURRENCY["now"])
+    time.sleep(0.15)
+    with CONCURRENCY_LOCK:
+        CONCURRENCY["now"] -= 1
+    return i
 
 
 @op
@@ -262,6 +275,50 @@ def test_stop_graph_flag_survives_scheduler_writes(cluster):
             _ = r + 1
     # graph must terminate promptly (stopped), not run the full 5s op
     assert time.time() - t0 < 4.0
+
+
+def test_per_user_task_limit(tmp_path):
+    """Cross-graph per-user cap (reference TasksSchedulerImpl limits): a user
+    with limit 2 never has more than 2 tasks executing at once."""
+    c = InProcessCluster(db_path=str(tmp_path / "meta.db"))
+    c.graph_executor.max_running_tasks_per_user = 2
+    CONCURRENCY["now"] = 0
+    CONCURRENCY["peak"] = 0
+    try:
+        lzy = c.lzy()
+        with lzy.workflow("limited"):
+            results = [tracked_sleep(i) for i in range(6)]
+            total = sum(int(r) for r in results)
+        assert total == 15
+        assert CONCURRENCY["peak"] <= 2, CONCURRENCY
+    finally:
+        c.shutdown()
+
+
+def test_failed_graph_releases_user_slots(tmp_path):
+    """A failed graph must release its admitted per-user slots, or the user
+    is pinned at their limit forever."""
+    c = InProcessCluster(db_path=str(tmp_path / "meta.db"))
+    c.graph_executor.max_running_tasks_per_user = 2
+    try:
+        lzy = c.lzy()
+
+        @op
+        def die() -> int:
+            raise RuntimeError("boom")
+
+        from lzy_tpu.core.workflow import RemoteCallError
+
+        with pytest.raises(RemoteCallError):
+            with lzy.workflow("fails"):
+                r = die()
+                _ = r + 1
+        # user must be back under the limit: a fresh graph still runs
+        with lzy.workflow("after"):
+            assert inc(1) == 2
+        assert c.graph_executor._user_running.get("test-user", 0) == 0
+    finally:
+        c.shutdown()
 
 
 def test_cpu_provisioning_picks_cpu_pool(cluster):
