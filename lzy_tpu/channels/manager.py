@@ -70,22 +70,61 @@ class DeviceResidency:
 
 
 class ChannelManager:
-    def __init__(self) -> None:
+    """Channel state is mirrored into the metadata store (when one is given)
+    so a restarted service resumes mid-graph data flow — the reference keeps
+    channels in the channel-manager's Postgres for the same reason. Device
+    residency and live slot peers stay process-local by nature."""
+
+    def __init__(self, store=None) -> None:
         self._channels: Dict[str, Channel] = {}
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        self._store = store
+        self._io_lock = threading.Lock()
+        self._seq: Dict[str, int] = {}           # per-channel mutation seq
+        self._written_seq: Dict[str, int] = {}
         self.device = DeviceResidency()
+        if store is not None:
+            for doc in store.kv_list("channels").values():
+                ch = Channel(**doc)
+                self._channels[ch.id] = ch
+
+    def _snapshot(self, ch: Channel):
+        """Call under the main lock: returns (seq, doc) for _write_outside.
+        The doc tracks the dataclass (future fields persist automatically);
+        slot_peer is the one deliberately process-local exclusion."""
+        if self._store is None:
+            return None
+        self._seq[ch.id] = self._seq.get(ch.id, 0) + 1
+        doc = dataclasses.asdict(ch)
+        doc.pop("slot_peer", None)
+        return self._seq[ch.id], doc
+
+    def _write_outside(self, ch_id: str, snap) -> None:
+        """Call WITHOUT the main lock: sqlite commits must not serialize the
+        data plane. Per-channel seq ordering drops stale racing writes."""
+        if snap is None:
+            return
+        seq, doc = snap
+        with self._io_lock:
+            if self._written_seq.get(ch_id, -1) >= seq:
+                return
+            self._written_seq[ch_id] = seq
+            self._store.kv_put("channels", ch_id, doc)
 
     # -- private API (per-execution lifecycle, ChannelService parity) ----------
 
     def get_or_create(self, execution_id: str, entry_id: str, storage_uri: str) -> Channel:
+        snap = None
         with self._lock:
             ch = self._channels.get(entry_id)
             if ch is None:
                 ch = Channel(id=entry_id, execution_id=execution_id,
                              storage_uri=storage_uri)
                 self._channels[entry_id] = ch
-            return ch
+                snap = self._snapshot(ch)
+        self._write_outside(entry_id, snap)
+        return ch
 
     def destroy_all(self, execution_id: str) -> None:
         with self._lock:
@@ -93,6 +132,12 @@ class ChannelManager:
                     if ch.execution_id == execution_id]
             for cid in dead:
                 del self._channels[cid]
+                self._seq.pop(cid, None)
+        if self._store is not None:
+            with self._io_lock:
+                for cid in dead:
+                    self._written_seq.pop(cid, None)
+                    self._store.kv_del("channels", cid)
         self.device.evict_execution(dead)
 
     def get(self, entry_id: str) -> Channel:
@@ -106,16 +151,21 @@ class ChannelManager:
             ch = self._channels[entry_id]
             if role == PRODUCER:
                 ch.producer_task = task_id
-            else:
+            elif task_id not in ch.consumer_tasks:
+                # idempotent: a task re-executed after crash-resume re-binds
                 ch.consumer_tasks.append(task_id)
-            return ch
+            snap = self._snapshot(ch)
+        self._write_outside(entry_id, snap)
+        return ch
 
     def transfer_completed(self, entry_id: str) -> None:
         """Producer finished writing the storage peer; wake waiting consumers."""
         with self._cv:
             ch = self._channels[entry_id]
             ch.completed = True
+            snap = self._snapshot(ch)
             self._cv.notify_all()
+        self._write_outside(entry_id, snap)
 
     def publish_peer(self, entry_id: str, peer: Any) -> None:
         """Producer announces a live slot peer for direct transfers."""
@@ -130,7 +180,9 @@ class ChannelManager:
             if ch.completed:
                 return  # durable data already landed; late failure is moot
             ch.failed = error
+            snap = self._snapshot(ch)
             self._cv.notify_all()
+        self._write_outside(entry_id, snap)
 
     def wait_available(self, entry_id: str,
                        timeout_s: Optional[float] = 300.0) -> Channel:

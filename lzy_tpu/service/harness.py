@@ -54,7 +54,7 @@ class InProcessCluster:
         self.storage_uri = storage_uri
         self.store = OperationStore(db_path)
         self.executor = OperationsExecutor(self.store, workers=workers)
-        self.channels = ChannelManager()
+        self.channels = ChannelManager(store=self.store)
         self.serializers = default_registry()
         self.storage_client = client_for(StorageConfig(uri=storage_uri))
         self.backend = ThreadVmBackend(
