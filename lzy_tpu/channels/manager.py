@@ -136,7 +136,9 @@ class ChannelManager:
         if self._store is not None:
             with self._io_lock:
                 for cid in dead:
-                    self._written_seq.pop(cid, None)
+                    # +inf tombstone: an in-flight _write_outside that took its
+                    # snapshot before destruction must not resurrect the row
+                    self._written_seq[cid] = float("inf")
                     self._store.kv_del("channels", cid)
         self.device.evict_execution(dead)
 
@@ -183,6 +185,21 @@ class ChannelManager:
             snap = self._snapshot(ch)
             self._cv.notify_all()
         self._write_outside(entry_id, snap)
+
+    def wait_status(self, entry_id: str, timeout_s: float = 2.0) -> Channel:
+        """Bounded cv-wait until the channel completes/fails (or timeout);
+        returns the channel either way. The RPC long-poll handler's primitive —
+        no busy-polling, the waiter parks on the condition variable."""
+        deadline = time.time() + timeout_s
+        with self._cv:
+            while True:
+                ch = self._channels[entry_id]
+                if ch.completed or ch.failed:
+                    return ch
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return ch
+                self._cv.wait(remaining)
 
     def wait_available(self, entry_id: str,
                        timeout_s: Optional[float] = 300.0) -> Channel:

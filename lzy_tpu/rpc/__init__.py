@@ -1,0 +1,18 @@
+from lzy_tpu.rpc.control import (
+    ControlPlaneServer,
+    RpcAllocatorClient,
+    RpcChannelsClient,
+    RpcWorkerClient,
+    RpcWorkflowClient,
+)
+from lzy_tpu.rpc.core import JsonRpcClient, JsonRpcServer
+
+__all__ = [
+    "ControlPlaneServer",
+    "RpcAllocatorClient",
+    "RpcChannelsClient",
+    "RpcWorkerClient",
+    "RpcWorkflowClient",
+    "JsonRpcClient",
+    "JsonRpcServer",
+]

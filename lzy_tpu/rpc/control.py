@@ -1,0 +1,274 @@
+"""Control-plane gRPC surface + typed clients.
+
+Serves three reference API groups from one endpoint (they can split per
+service later, exactly like the reference's per-service gRPC servers):
+
+- **LzyWorkflowService** parity: workflow lifecycle, graphs, pools, logs;
+- **LzyChannelManager/LzySlotsApi** parity: bind, wait, complete/fail, peers;
+- **AllocatorPrivate** parity: worker registration + heartbeats — a process
+  worker registers its own gRPC endpoint, and the control plane dials back
+  with ``RpcWorkerClient`` for Init/Execute/Status (reference WorkerApi).
+
+Clients mirror the in-process method surfaces, so ``RemoteRuntime`` and
+``WorkerAgent`` run unchanged against a remote control plane.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+from lzy_tpu.channels.manager import Channel
+from lzy_tpu.channels.p2p import SlotPeer
+from lzy_tpu.rpc.core import JsonRpcClient, JsonRpcServer
+from lzy_tpu.service.graph import TaskDesc
+from lzy_tpu.types import TpuPoolSpec, VmSpec
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+
+# -- server ---------------------------------------------------------------------
+
+
+class ControlPlaneServer:
+    def __init__(self, cluster, port: int = 0):
+        """``cluster``: an InProcessCluster (or any object with
+        workflow_service/channels/allocator attributes)."""
+        svc = cluster.workflow_service
+        channels = cluster.channels
+        allocator = cluster.allocator
+
+        def h_start(p):
+            return {"execution_id": svc.start_workflow(
+                p.get("user", ""), p["workflow_name"], p["storage_uri"],
+                execution_id=p.get("execution_id"),
+                token=p.get("token"), client_version=p.get("client_version"),
+            )}
+
+        def h_wait_channel(p):
+            # cv-parked bounded wait; completion/failure are the only wake
+            # conditions (an early slot peer alone must not wake clients that
+            # only act on completion — that would be a zero-delay RPC spin)
+            ch = channels.wait_status(
+                p["entry_id"], timeout_s=float(p.get("timeout_s", 2.0))
+            )
+            peer = None
+            if ch.slot_peer is not None:
+                peer = dataclasses.asdict(ch.slot_peer)
+            return {"completed": ch.completed, "failed": ch.failed,
+                    "slot_peer": peer, "storage_uri": ch.storage_uri}
+
+        def h_register_vm(p):
+            allocator.register_vm(
+                p["vm_id"], RpcWorkerClient(p["endpoint"])
+            )
+            return {}
+
+        handlers = {
+            # workflow service
+            "StartWorkflow": h_start,
+            "FinishWorkflow": lambda p: svc.finish_workflow(
+                p["execution_id"], token=p.get("token")),
+            "AbortWorkflow": lambda p: svc.abort_workflow(
+                p["execution_id"], token=p.get("token")),
+            "ExecuteGraph": lambda p: {"graph_op_id": svc.execute_graph(
+                p["execution_id"], p["graph"], token=p.get("token"))},
+            "GraphStatus": lambda p: svc.graph_status(
+                p["execution_id"], p["graph_op_id"], token=p.get("token")),
+            "StopGraph": lambda p: svc.stop_graph(
+                p["execution_id"], p["graph_op_id"], token=p.get("token")),
+            "GetPoolSpecs": lambda p: {"pools": [
+                {"kind": "tpu", **dataclasses.asdict(s)}
+                if isinstance(s, TpuPoolSpec)
+                else {"kind": "vm", **dataclasses.asdict(s)}
+                for s in svc.get_pool_specs()
+            ]},
+            "ReadStdLogs": lambda p: {"logs": svc.read_std_logs(
+                p["execution_id"], p.get("offsets") or {},
+                token=p.get("token"))},
+            # channel plane
+            "ChannelBind": lambda p: (
+                channels.bind(p["entry_id"], p["role"], p["task_id"]) and {}),
+            "ChannelCompleted": lambda p: channels.transfer_completed(
+                p["entry_id"]),
+            "ChannelFailed": lambda p: channels.transfer_failed(
+                p["entry_id"], p.get("error", "")),
+            "ChannelPublishPeer": lambda p: channels.publish_peer(
+                p["entry_id"], SlotPeer(**p["peer"])),
+            "WaitChannel": h_wait_channel,
+            # allocator private
+            "RegisterVm": h_register_vm,
+            "Heartbeat": lambda p: allocator.heartbeat(p["vm_id"]),
+        }
+        self._server = JsonRpcServer(handlers, port=port)
+        self.address = self._server.address
+        self.port = self._server.port
+
+    def stop(self) -> None:
+        self._server.stop()
+
+
+# -- control-plane → worker (WorkerApi parity) ----------------------------------
+
+
+class RpcWorkerClient:
+    """What the graph executor holds for a process worker; dials the worker's
+    own gRPC server for Init/Execute/Status."""
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+        self._client = JsonRpcClient(endpoint)
+
+    def init(self, owner: str) -> None:
+        self._client.call("Init", {"owner": owner})
+
+    def execute(self, task: TaskDesc, gang_rank: int, gang: Dict[str, Any]) -> str:
+        return self._client.call("Execute", {
+            "task": task.to_doc(), "gang_rank": gang_rank, "gang": gang,
+        })["op_id"]
+
+    def status(self, op_id: str) -> Dict[str, Any]:
+        return self._client.call("Status", {"op_id": op_id})
+
+    def stop(self) -> None:
+        try:
+            self._client.call("Shutdown", {}, timeout_s=2.0)
+        except Exception:
+            pass
+        self._client.close()
+
+
+# -- worker-side clients --------------------------------------------------------
+
+
+class RpcAllocatorClient:
+    """The worker agent's view of AllocatorPrivate."""
+
+    def __init__(self, client: JsonRpcClient, endpoint: str):
+        self._client = client
+        self._endpoint = endpoint
+
+    def register_vm(self, vm_id: str, agent: Any) -> None:
+        # the live agent object cannot travel; its gRPC endpoint does
+        self._client.call("RegisterVm", {"vm_id": vm_id,
+                                         "endpoint": self._endpoint})
+
+    def heartbeat(self, vm_id: str) -> None:
+        self._client.call("Heartbeat", {"vm_id": vm_id})
+
+
+@dataclasses.dataclass
+class _ChannelView:
+    completed: bool
+    failed: Optional[str]
+    slot_peer: Optional[SlotPeer]
+    storage_uri: str
+
+
+class RpcChannelsClient:
+    """The worker agent's view of the channel plane; method-compatible with
+    the subset of ChannelManager the worker uses. Device residency stays
+    process-local (that is its meaning)."""
+
+    def __init__(self, client: JsonRpcClient):
+        from lzy_tpu.channels.manager import DeviceResidency
+
+        self._client = client
+        self.device = DeviceResidency()
+
+    def bind(self, entry_id: str, role: str, task_id: str) -> None:
+        self._client.call("ChannelBind", {
+            "entry_id": entry_id, "role": role, "task_id": task_id,
+        })
+
+    def transfer_completed(self, entry_id: str) -> None:
+        self._client.call("ChannelCompleted", {"entry_id": entry_id})
+
+    def transfer_failed(self, entry_id: str, error: str) -> None:
+        self._client.call("ChannelFailed", {"entry_id": entry_id,
+                                            "error": error})
+
+    def publish_peer(self, entry_id: str, peer: SlotPeer) -> None:
+        self._client.call("ChannelPublishPeer", {
+            "entry_id": entry_id, "peer": dataclasses.asdict(peer),
+        })
+
+    def wait_available(self, entry_id: str,
+                       timeout_s: Optional[float] = 300.0) -> _ChannelView:
+        from lzy_tpu.channels.manager import ChannelFailed
+
+        deadline = None if timeout_s is None else time.time() + timeout_s
+        while True:
+            doc = self._client.call("WaitChannel", {
+                "entry_id": entry_id, "timeout_s": 2.0,
+            })
+            if doc["failed"]:
+                raise ChannelFailed(entry_id, doc["failed"])
+            if doc["completed"] or entry_id in self.device:
+                peer = SlotPeer(**doc["slot_peer"]) if doc["slot_peer"] else None
+                return _ChannelView(doc["completed"], doc["failed"], peer,
+                                    doc["storage_uri"])
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(
+                    f"channel {entry_id} not available after {timeout_s}s"
+                )
+
+
+class RpcWorkflowClient:
+    """SDK-side client with the WorkflowService method surface; plug into
+    ``RemoteRuntime(client=...)`` for a fully remote deployment."""
+
+    def __init__(self, address: str):
+        self._client = JsonRpcClient(address)
+
+    def start_workflow(self, user, workflow_name, storage_uri,
+                       execution_id=None, *, token=None, client_version=None):
+        return self._client.call("StartWorkflow", {
+            "user": user, "workflow_name": workflow_name,
+            "storage_uri": storage_uri, "execution_id": execution_id,
+            "token": token, "client_version": client_version,
+        })["execution_id"]
+
+    def finish_workflow(self, execution_id, *, token=None):
+        self._client.call("FinishWorkflow", {"execution_id": execution_id,
+                                             "token": token})
+
+    def abort_workflow(self, execution_id, *, token=None):
+        self._client.call("AbortWorkflow", {"execution_id": execution_id,
+                                            "token": token})
+
+    def execute_graph(self, execution_id, graph_doc, *, token=None):
+        return self._client.call("ExecuteGraph", {
+            "execution_id": execution_id, "graph": graph_doc, "token": token,
+        })["graph_op_id"]
+
+    def graph_status(self, execution_id, graph_op_id, *, token=None):
+        return self._client.call("GraphStatus", {
+            "execution_id": execution_id, "graph_op_id": graph_op_id,
+            "token": token,
+        })
+
+    def stop_graph(self, execution_id, graph_op_id, *, token=None):
+        self._client.call("StopGraph", {
+            "execution_id": execution_id, "graph_op_id": graph_op_id,
+            "token": token,
+        })
+
+    def get_pool_specs(self):
+        pools = []
+        for doc in self._client.call("GetPoolSpecs")["pools"]:
+            kind = doc.pop("kind")
+            doc["zones"] = tuple(doc.get("zones", ()))
+            pools.append(TpuPoolSpec(**doc) if kind == "tpu" else VmSpec(**doc))
+        return pools
+
+    def read_std_logs(self, execution_id, offsets=None, *, token=None):
+        return self._client.call("ReadStdLogs", {
+            "execution_id": execution_id, "offsets": offsets or {},
+            "token": token,
+        })["logs"]
+
+    def close(self) -> None:
+        self._client.close()

@@ -1,0 +1,125 @@
+"""JSON-over-gRPC RPC core.
+
+The reference's services are all gRPC (SURVEY.md §1); this is the transport
+for lzy-tpu's distributed deployment mode. Method registration uses gRPC's
+generic handlers with JSON payloads — the graph/task/channel documents are
+already JSON dicts end to end, so no codegen step is needed, while keeping
+gRPC's HTTP/2 transport, deadlines, and status codes. A protobuf schema can
+replace the JSON codec behind the same handler map later.
+
+Errors: handlers raising ``AuthError`` map to PERMISSION_DENIED, ``KeyError``
+to NOT_FOUND, everything else to INTERNAL with the message preserved; clients
+re-raise the matching Python exception.
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+from typing import Any, Callable, Dict, Optional
+
+import grpc
+
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+_SERVICE = "lzy.Rpc"
+
+
+def _codes(e: BaseException) -> grpc.StatusCode:
+    from lzy_tpu.iam import AuthError
+
+    if isinstance(e, AuthError):
+        return grpc.StatusCode.PERMISSION_DENIED
+    if isinstance(e, KeyError):
+        return grpc.StatusCode.NOT_FOUND
+    if isinstance(e, TimeoutError):
+        return grpc.StatusCode.DEADLINE_EXCEEDED
+    if isinstance(e, ValueError):
+        return grpc.StatusCode.INVALID_ARGUMENT
+    return grpc.StatusCode.INTERNAL
+
+
+class JsonRpcServer:
+    """``handlers``: method name → fn(dict) -> dict|None."""
+
+    def __init__(self, handlers: Dict[str, Callable[[dict], Any]],
+                 port: int = 0, max_workers: int = 16):
+        self._handlers = dict(handlers)
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+
+        def make_behavior(fn):
+            def behavior(request: bytes, context) -> bytes:
+                try:
+                    payload = json.loads(request.decode("utf-8")) if request else {}
+                    result = fn(payload)
+                    return json.dumps(result if result is not None else {}).encode()
+                except BaseException as e:  # noqa: BLE001 — mapped to status
+                    _LOG.info("rpc handler error: %r", e)
+                    context.abort(_codes(e), f"{type(e).__name__}: {e}")
+
+            return behavior
+
+        method_handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                make_behavior(fn),
+                request_deserializer=None,
+                response_serializer=None,
+            )
+            for name, fn in self._handlers.items()
+        }
+        generic = grpc.method_handlers_generic_handler(_SERVICE, method_handlers)
+        server.add_generic_rpc_handlers((generic,))
+        self.port = server.add_insecure_port(f"127.0.0.1:{port}")
+        server.start()
+        self._server = server
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace)
+
+
+class JsonRpcClient:
+    def __init__(self, address: str, *, timeout_s: float = 60.0):
+        self._channel = grpc.insecure_channel(address)
+        self._timeout_s = timeout_s
+        self._address = address
+
+    def call(self, method: str, payload: Optional[dict] = None,
+             timeout_s: Optional[float] = None) -> dict:
+        fn = self._channel.unary_unary(
+            f"/{_SERVICE}/{method}",
+            request_serializer=None,
+            response_deserializer=None,
+        )
+        try:
+            raw = fn(
+                json.dumps(payload or {}).encode("utf-8"),
+                timeout=timeout_s or self._timeout_s,
+            )
+        except grpc.RpcError as e:
+            raise _to_exception(e) from None
+        return json.loads(raw.decode("utf-8")) if raw else {}
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+def _to_exception(e: grpc.RpcError) -> BaseException:
+    detail = e.details() or str(e)
+    code = e.code()
+    if code == grpc.StatusCode.PERMISSION_DENIED:
+        from lzy_tpu.iam import AuthError
+
+        return AuthError(detail)
+    if code == grpc.StatusCode.NOT_FOUND:
+        return KeyError(detail)
+    if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+        return TimeoutError(detail)
+    if code == grpc.StatusCode.INVALID_ARGUMENT:
+        return ValueError(detail)
+    return RuntimeError(detail)

@@ -1,0 +1,92 @@
+"""Process-worker entrypoint: ``python -m lzy_tpu.rpc.worker_main``.
+
+The process analog of the reference worker binary (``lzy/worker/.../Worker.java:
+32-242``): boots, starts its own gRPC server (WorkerApi parity: Init/Execute/
+Status), registers its endpoint with the control plane (AllocatorPrivate
+parity), heartbeats, and executes tasks with full OS-process isolation —
+its own interpreter, its own JAX runtime, channels and registration via RPC,
+data via shared storage (file:// or s3://; mem:// cannot cross processes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import threading
+
+from lzy_tpu.rpc.control import RpcAllocatorClient, RpcChannelsClient
+from lzy_tpu.rpc.core import JsonRpcClient, JsonRpcServer
+from lzy_tpu.service.graph import TaskDesc
+from lzy_tpu.service.worker import WorkerAgent
+from lzy_tpu.storage import StorageConfig
+from lzy_tpu.storage.registry import client_for
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--control", required=True, help="control-plane address")
+    parser.add_argument("--vm-id", required=True)
+    parser.add_argument("--storage-uri", required=True)
+    parser.add_argument("--spill-root", default=None)
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    os.environ.setdefault("LZY_WORKER_ISOLATED", "1")  # sync user modules
+
+    control = JsonRpcClient(args.control)
+    storage = client_for(StorageConfig(uri=args.storage_uri))
+    channels = RpcChannelsClient(control)
+
+    stop_event = threading.Event()
+    agent_box = {}
+
+    def h_init(p):
+        agent_box["agent"].init(p.get("owner", ""))
+        return {}
+
+    def h_execute(p):
+        op_id = agent_box["agent"].execute(
+            TaskDesc.from_doc(p["task"]), p["gang_rank"], p.get("gang", {})
+        )
+        return {"op_id": op_id}
+
+    def h_status(p):
+        return agent_box["agent"].status(p["op_id"])
+
+    def h_shutdown(p):
+        stop_event.set()
+        return {}
+
+    server = JsonRpcServer({
+        "Init": h_init,
+        "Execute": h_execute,
+        "Status": h_status,
+        "Shutdown": h_shutdown,
+    }, port=args.port)
+
+    allocator = RpcAllocatorClient(control, endpoint=server.address)
+    agent = WorkerAgent(
+        args.vm_id,
+        allocator=allocator,
+        channels=channels,
+        storage_client=storage,
+        spill_root=args.spill_root,
+        heartbeat_period_s=2.0,
+        # a dead control plane must not leak this process forever
+        max_heartbeat_failures=5,
+        on_disconnected=stop_event.set,
+    )
+    agent_box["agent"] = agent
+    agent.start()          # registers endpoint + starts heartbeats
+    _LOG.warning("worker %s serving on %s", args.vm_id, server.address)
+
+    stop_event.wait()
+    agent.stop()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

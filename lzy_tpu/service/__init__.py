@@ -1,5 +1,5 @@
 from lzy_tpu.service.allocator import AllocatorService, Vm, VmBackend
-from lzy_tpu.service.backends import GkeTpuBackend, ThreadVmBackend
+from lzy_tpu.service.backends import GkeTpuBackend, ProcessVmBackend, ThreadVmBackend
 from lzy_tpu.service.graph import EntryRef, GraphDesc, GraphValidationError, TaskDesc
 from lzy_tpu.service.graph_executor import GraphExecutor
 from lzy_tpu.service.harness import DEFAULT_POOLS, InProcessCluster
@@ -11,6 +11,7 @@ __all__ = [
     "Vm",
     "VmBackend",
     "GkeTpuBackend",
+    "ProcessVmBackend",
     "ThreadVmBackend",
     "EntryRef",
     "GraphDesc",

@@ -263,12 +263,20 @@ class AllocatorService:
         _update_vm_gauge(self.vms())  # every status transition passes here
 
     def _destroy(self, vm: Vm) -> None:
+        with self._lock:
+            agent = self._agents.pop(vm.id, None)
+        # graceful stop first (closes RPC channels / sends Shutdown for
+        # process workers); the backend then reaps whatever remains
+        if agent is not None:
+            try:
+                agent.stop()
+            except Exception:
+                pass
         try:
             self._backend.destroy(vm)
         finally:
             with self._lock:
                 self._vms.pop(vm.id, None)
-                self._agents.pop(vm.id, None)
             self._store.kv_del("vms", vm.id)
             _update_vm_gauge(self.vms())
 

@@ -95,6 +95,64 @@ class ThreadVmBackend(VmBackend):
             agent.stop()
 
 
+class ProcessVmBackend(VmBackend):
+    """Each VM is a real OS process running ``lzy_tpu.rpc.worker_main`` — its
+    own interpreter and JAX runtime, talking to the control plane over gRPC
+    (the local analog of the reference's one-worker-binary-per-VM model, and
+    the template a cloud backend follows with pods instead of processes)."""
+
+    def __init__(self, *, control_address_factory: Callable[[], str],
+                 storage_uri: str, spill_root: Optional[str] = None,
+                 extra_pythonpath: Optional[str] = None):
+        self._control_address_factory = control_address_factory
+        self._storage_uri = storage_uri
+        self._spill_root = spill_root
+        self._extra_pythonpath = extra_pythonpath
+        self._procs: Dict[str, "object"] = {}
+        self._lock = threading.Lock()
+        self.allocator = None
+
+    def launch(self, vm: Vm, pool: PoolSpec) -> None:
+        import pathlib
+        import subprocess
+        import sys
+
+        with self._lock:
+            if vm.id in self._procs:
+                return  # idempotent across durable-op resume
+            self._procs[vm.id] = None
+        repo_root = str(pathlib.Path(__file__).resolve().parents[2])
+        env = dict(os.environ)
+        pypath = [repo_root]
+        if self._extra_pythonpath:
+            pypath.append(self._extra_pythonpath)
+        if env.get("PYTHONPATH"):
+            pypath.append(env["PYTHONPATH"])
+        env["PYTHONPATH"] = os.pathsep.join(pypath)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        args = [
+            sys.executable, "-m", "lzy_tpu.rpc.worker_main",
+            "--control", self._control_address_factory(),
+            "--vm-id", vm.id,
+            "--storage-uri", self._storage_uri,
+        ]
+        if self._spill_root:
+            args += ["--spill-root", os.path.join(self._spill_root, vm.id)]
+        proc = subprocess.Popen(args, env=env, cwd=repo_root)
+        with self._lock:
+            self._procs[vm.id] = proc
+
+    def destroy(self, vm: Vm) -> None:
+        with self._lock:
+            proc = self._procs.pop(vm.id, None)
+        if proc is not None and getattr(proc, "poll", lambda: 1)() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                proc.kill()
+
+
 class GkeTpuBackend(VmBackend):
     """Cloud path: one Vm record = one TPU host pod in a slice node pool."""
 

@@ -16,7 +16,6 @@ from typing import List, Optional, Sequence
 from lzy_tpu.channels.manager import ChannelManager
 from lzy_tpu.core.lzy import Lzy
 from lzy_tpu.durable import OperationsExecutor, OperationStore
-from lzy_tpu.runtime.remote import RemoteRuntime
 from lzy_tpu.serialization import default_registry
 from lzy_tpu.service.allocator import AllocatorService
 from lzy_tpu.service.backends import ThreadVmBackend
@@ -50,6 +49,8 @@ class InProcessCluster:
         vm_boot_delay_s: float = 0.0,
         p2p_spill_root: Optional[str] = None,
         with_iam: bool = False,
+        worker_mode: str = "thread",      # "thread" | "process"
+        worker_pythonpath: Optional[str] = None,
     ):
         self.storage_uri = storage_uri
         self.store = OperationStore(db_path)
@@ -57,10 +58,26 @@ class InProcessCluster:
         self.channels = ChannelManager(store=self.store)
         self.serializers = default_registry()
         self.storage_client = client_for(StorageConfig(uri=storage_uri))
-        self.backend = ThreadVmBackend(
-            self.channels, self.storage_client, self.serializers,
-            launch_delay_s=vm_boot_delay_s, spill_root=p2p_spill_root,
-        )
+        self.rpc_server = None
+        if worker_mode == "process":
+            from lzy_tpu.service.backends import ProcessVmBackend
+
+            if storage_uri.startswith("mem://"):
+                raise ValueError(
+                    "process workers need cross-process storage (file:// or "
+                    "s3://), not mem://"
+                )
+            self.backend = ProcessVmBackend(
+                control_address_factory=lambda: self.rpc_server.address,
+                storage_uri=storage_uri,
+                spill_root=p2p_spill_root,
+                extra_pythonpath=worker_pythonpath,
+            )
+        else:
+            self.backend = ThreadVmBackend(
+                self.channels, self.storage_client, self.serializers,
+                launch_delay_s=vm_boot_delay_s, spill_root=p2p_spill_root,
+            )
         self.allocator = AllocatorService(
             self.store, self.executor, self.backend, pools or DEFAULT_POOLS
         )
@@ -78,6 +95,25 @@ class InProcessCluster:
             self.store, self.executor, self.allocator, self.channels,
             self.graph_executor, self.storage_client, iam=self.iam,
         )
+        if worker_mode == "process":
+            from lzy_tpu.rpc import ControlPlaneServer
+
+            self.rpc_server = ControlPlaneServer(self)
+
+    def serve(self, port: int = 0):
+        """Expose the control plane over gRPC (for remote SDK clients); with
+        worker_mode="process" a server is already running."""
+        if self.rpc_server is not None:
+            if port not in (0, self.rpc_server.port):
+                raise RuntimeError(
+                    f"control plane already serving on port "
+                    f"{self.rpc_server.port}; cannot rebind to {port}"
+                )
+            return self.rpc_server
+        from lzy_tpu.rpc import ControlPlaneServer
+
+        self.rpc_server = ControlPlaneServer(self, port=port)
+        return self.rpc_server
 
     @property
     def client(self) -> WorkflowService:
@@ -86,6 +122,7 @@ class InProcessCluster:
 
     def lzy(self, *, user: str = "test-user", token: Optional[str] = None,
             stream_logs: bool = False, poll_period_s: float = 0.02) -> Lzy:
+        from lzy_tpu.runtime.remote import RemoteRuntime  # avoid import cycle
         storage = DefaultStorageRegistry()
         storage.register_storage(
             "default", StorageConfig(uri=self.storage_uri), default=True
@@ -105,5 +142,12 @@ class InProcessCluster:
         return self.executor.restore()
 
     def shutdown(self) -> None:
+        for vm in list(self.allocator.vms()):
+            try:
+                self.backend.destroy(vm)
+            except Exception:
+                pass
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
         self.executor.shutdown()
         self.store.close()

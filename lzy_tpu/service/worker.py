@@ -92,6 +92,8 @@ class WorkerAgent:
         heartbeat_period_s: float = 5.0,
         spill_root: Optional[str] = None,  # enables the native p2p slot server
         advertise_host: str = "127.0.0.1", # routable address for p2p peers
+        max_heartbeat_failures: Optional[int] = None,
+        on_disconnected=None,              # called when the limit is reached
     ):
         self.vm_id = vm_id
         self._allocator = allocator
@@ -111,6 +113,8 @@ class WorkerAgent:
             os.makedirs(spill_root, exist_ok=True)
             if native_available():  # negative result is cached; boot stays fast
                 self._slot_server = SlotServer(spill_root)
+        self._max_heartbeat_failures = max_heartbeat_failures
+        self._on_disconnected = on_disconnected
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_period_s,),
             name=f"hb-{vm_id}", daemon=True,
@@ -129,11 +133,24 @@ class WorkerAgent:
             self._slot_server = None
 
     def _heartbeat_loop(self, period_s: float) -> None:
+        failures = 0
         while not self._stop.wait(period_s):
             try:
                 self._allocator.heartbeat(self.vm_id)
+                failures = 0
             except Exception:
-                _LOG.warning("heartbeat failed for %s", self.vm_id)
+                failures += 1
+                _LOG.warning("heartbeat failed for %s (%d consecutive)",
+                             self.vm_id, failures)
+                if (self._max_heartbeat_failures is not None
+                        and failures >= self._max_heartbeat_failures):
+                    # control plane is gone: a process worker must exit or it
+                    # leaks forever (the allocator's GC reaps our record)
+                    _LOG.error("control plane unreachable; disconnecting %s",
+                               self.vm_id)
+                    if self._on_disconnected is not None:
+                        self._on_disconnected()
+                    return
 
     # -- WorkerApi.Init / Execute parity ---------------------------------------
 
