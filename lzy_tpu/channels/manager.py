@@ -83,6 +83,7 @@ class ChannelManager:
         self._io_lock = threading.Lock()
         self._seq: Dict[str, int] = {}           # per-channel mutation seq
         self._written_seq: Dict[str, int] = {}
+        self._tombstones: Dict[str, float] = {}  # destroyed id → expiry ts
         self.device = DeviceResidency()
         if store is not None:
             for doc in store.kv_list("channels").values():
@@ -107,6 +108,8 @@ class ChannelManager:
             return
         seq, doc = snap
         with self._io_lock:
+            if ch_id in self._tombstones:
+                return  # destroyed while this write was in flight
             if self._written_seq.get(ch_id, -1) >= seq:
                 return
             self._written_seq[ch_id] = seq
@@ -134,12 +137,18 @@ class ChannelManager:
                 del self._channels[cid]
                 self._seq.pop(cid, None)
         if self._store is not None:
+            now = time.time()
             with self._io_lock:
                 for cid in dead:
-                    # +inf tombstone: an in-flight _write_outside that took its
-                    # snapshot before destruction must not resurrect the row
-                    self._written_seq[cid] = float("inf")
+                    # tombstone: an in-flight _write_outside that snapshotted
+                    # before destruction must not resurrect the row. Expire
+                    # after a grace period so the dict doesn't grow forever.
+                    self._written_seq.pop(cid, None)
+                    self._tombstones[cid] = now + 60.0
                     self._store.kv_del("channels", cid)
+                for cid in [c for c, exp in self._tombstones.items()
+                            if exp < now]:
+                    del self._tombstones[cid]
         self.device.evict_execution(dead)
 
     def get(self, entry_id: str) -> Channel:

@@ -63,6 +63,19 @@ class LlamaConfig:
         return LlamaConfig()
 
     @staticmethod
+    def llama3_70b() -> "LlamaConfig":
+        return LlamaConfig(
+            d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+            d_ff=28_672,
+        )
+
+    @staticmethod
+    def moe_8x(base: "LlamaConfig" = None) -> "LlamaConfig":
+        """Mixtral-style sparse variant: 8 experts, top-2 routing."""
+        base = base or LlamaConfig()
+        return dataclasses.replace(base, n_experts=8, moe_top_k=2)
+
+    @staticmethod
     def tiny(vocab_size: int = 512) -> "LlamaConfig":
         """Test/dryrun shape: same code paths, toy dims."""
         return LlamaConfig(

@@ -41,3 +41,7 @@ __all__ = [
 from lzy_tpu.parallel.checkpoint import CheckpointManager  # noqa: E402
 
 __all__.append("CheckpointManager")
+
+from lzy_tpu.parallel.ulysses import ulysses_attention  # noqa: E402
+
+__all__.append("ulysses_attention")

@@ -1,0 +1,76 @@
+"""Ulysses-style sequence parallelism: all-to-all attention.
+
+The complement to ring attention (``lzy_tpu/parallel/ring.py``) for long
+sequences: instead of streaming K/V blocks around a ring, two all-to-alls
+re-shard the problem — heads gather the FULL sequence while the head dimension
+splits across ``sp``:
+
+    [B, H, T/n, D] --all-to-all--> [B, H/n, T, D]   (exact local attention)
+                   --all-to-all--> [B, H, T/n, D]
+
+Each device then runs an exact (flash/chunked) attention over the whole
+sequence for its head shard. Ring wins when T is huge and H is small;
+Ulysses wins when H ≥ n and the two all-to-alls are cheaper than n ppermute
+rounds. Requires ``n_heads % sp == 0``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    q_spec: P = P(("dp", "fsdp"), None, "sp", None),
+) -> jax.Array:
+    """q/k/v: global ``[B, H, T, D]`` with T sharded over ``axis``; returns the
+    same layout. Exact attention (computed via the chunked online-softmax
+    kernel on each device's full-sequence head shard)."""
+    n = mesh.shape[axis]
+    h = q.shape[1]
+    if h % n:
+        raise ValueError(f"n_heads={h} must be divisible by {axis}={n}")
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+
+    def local_fn(q_blk, k_blk, v_blk):
+        # local: [B, H, T/n, D] → heads scatter, sequence gathers
+        def seq_to_head(x):
+            # split_axis=1 (heads), concat_axis=2 (sequence)
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        def head_to_seq(x):
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        qg, kg, vg = (seq_to_head(x) for x in (q_blk, k_blk, v_blk))
+        # [B, H/n, T, D]: exact attention over the full sequence
+        from lzy_tpu.ops.attention import chunked_attention
+
+        t = qg.shape[2]
+        block = next(bs for bs in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1)
+                     if t % bs == 0)
+        out = chunked_attention(qg, kg, vg, causal=causal, scale=scale,
+                                block_size=block)
+        return head_to_seq(out)
+
+    return shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(q_spec, q_spec, q_spec),
+        out_specs=q_spec,
+        check_rep=False,
+    )(q, k, v)

@@ -156,7 +156,14 @@ class RpcAllocatorClient:
                                          "endpoint": self._endpoint})
 
     def heartbeat(self, vm_id: str) -> None:
-        self._client.call("Heartbeat", {"vm_id": vm_id})
+        try:
+            self._client.call("Heartbeat", {"vm_id": vm_id})
+        except KeyError:
+            # a rebooted control plane restored our VM record but lost the
+            # endpoint: re-register to reconnect. If the record itself is gone
+            # this raises too, and the agent's failure counting takes over.
+            self._client.call("RegisterVm", {"vm_id": vm_id,
+                                             "endpoint": self._endpoint})
 
 
 @dataclasses.dataclass

@@ -210,10 +210,16 @@ class AllocatorService:
                 self._persist(vm)
 
     def heartbeat(self, vm_id: str) -> None:
+        """Raises KeyError for unknown VMs and for VMs with no registered
+        agent — the worker must then re-register (e.g. after a control-plane
+        restart rebuilt the VM registry without live endpoints) or exit."""
         with self._lock:
             vm = self._vms.get(vm_id)
-            if vm is not None:
-                vm.heartbeat_ts = time.time()
+            if vm is None:
+                raise KeyError(f"vm {vm_id!r} is not known to the allocator")
+            if vm_id not in self._agents:
+                raise KeyError(f"vm {vm_id!r} has no registered agent")
+            vm.heartbeat_ts = time.time()
 
     def agent(self, vm_id: str) -> Any:
         with self._lock:

@@ -138,7 +138,12 @@ class ProcessVmBackend(VmBackend):
         ]
         if self._spill_root:
             args += ["--spill-root", os.path.join(self._spill_root, vm.id)]
-        proc = subprocess.Popen(args, env=env, cwd=repo_root)
+        try:
+            proc = subprocess.Popen(args, env=env, cwd=repo_root)
+        except BaseException:
+            with self._lock:
+                self._procs.pop(vm.id, None)  # clear the booking marker
+            raise
         with self._lock:
             self._procs[vm.id] = proc
 
@@ -151,6 +156,7 @@ class ProcessVmBackend(VmBackend):
                 proc.wait(timeout=5)
             except Exception:
                 proc.kill()
+                proc.wait()  # reap; an unreaped child is a zombie
 
 
 class GkeTpuBackend(VmBackend):

@@ -51,7 +51,9 @@ class InProcessCluster:
         with_iam: bool = False,
         worker_mode: str = "thread",      # "thread" | "process"
         worker_pythonpath: Optional[str] = None,
+        rpc_port: int = 0,                # fixed port lets workers reconnect
     ):
+        self._rpc_port = rpc_port
         self.storage_uri = storage_uri
         self.store = OperationStore(db_path)
         self.executor = OperationsExecutor(self.store, workers=workers)
@@ -98,7 +100,7 @@ class InProcessCluster:
         if worker_mode == "process":
             from lzy_tpu.rpc import ControlPlaneServer
 
-            self.rpc_server = ControlPlaneServer(self)
+            self.rpc_server = ControlPlaneServer(self, port=rpc_port)
 
     def serve(self, port: int = 0):
         """Expose the control plane over gRPC (for remote SDK clients); with

@@ -173,3 +173,45 @@ def test_mfu_math():
     val = mfu(1000.0, 1_000_000_000, 16, chip="v5e")
     assert 0 < val < 1
     np.testing.assert_allclose(val, 6e12 / (197e12 * 16), rtol=1e-6)
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        from lzy_tpu.parallel import ulysses_attention
+
+        mesh = mesh_for(sp=8)
+        b, h, s, d = 2, 8, 64, 16
+        ks = jax.random.split(jax.random.PRNGKey(4), 3)
+        q, k, v = (jax.random.normal(x, (b, h, s, d), jnp.float32) for x in ks)
+
+        out = ulysses_attention(q, k, v, mesh=mesh, causal=causal)
+
+        scale = d ** -0.5
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        if causal:
+            mask = np.tril(np.ones((s, s), bool))
+            logits = jnp.where(mask, logits, -1e30)
+        ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(logits, -1), v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_matches_ring(self):
+        from lzy_tpu.parallel import ulysses_attention
+
+        mesh = mesh_for(sp=8)
+        b, h, s, d = 1, 8, 128, 8
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        q, k, v = (jax.random.normal(x, (b, h, s, d), jnp.float32) for x in ks)
+        a = ulysses_attention(q, k, v, mesh=mesh, causal=True)
+        b_out = ring_attention(q, k, v, mesh=mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_out),
+                                   atol=3e-5, rtol=3e-5)
+
+    def test_head_divisibility_enforced(self):
+        from lzy_tpu.parallel import ulysses_attention
+
+        mesh = mesh_for(sp=8)
+        q = jnp.ones((1, 6, 64, 8))  # 6 heads not divisible by sp=8
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention(q, q, q, mesh=mesh)
