@@ -160,9 +160,7 @@ class Attention(nn.Module):
             # activations, never the T×T score matrix (lzy_tpu/ops/attention)
             from lzy_tpu.ops.attention import chunked_attention
 
-            block = next(bs for bs in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1)
-                         if t % bs == 0)
-            out = chunked_attention(q, k, v, causal=True, block_size=block)
+            out = chunked_attention(q, k, v, causal=True)
 
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * d)
         return self._o_proj(out)

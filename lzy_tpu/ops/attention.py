@@ -20,6 +20,15 @@ from jax import lax
 _NEG_INF = -1e30
 
 
+def auto_block(t: int, requested: int = 512) -> int:
+    """Largest divisor of ``t`` that is ≤ requested — any sequence length gets
+    a valid block without callers hand-rolling divisor hunts."""
+    b = min(requested, t)
+    while t % b:
+        b -= 1
+    return b
+
+
 def chunked_attention(
     q: jax.Array,
     k: jax.Array,
@@ -27,15 +36,14 @@ def chunked_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_size: int = 512,
+    block_size: Optional[int] = 512,
 ) -> jax.Array:
     """q/k/v: [B, H, T, D] → [B, H, T, D]. Keys/values are processed in
-    blocks of ``block_size`` with the flash merge recurrence."""
+    blocks with the flash merge recurrence; ``block_size`` is clamped to the
+    largest divisor of T (``None`` means fully automatic)."""
     b, h, t, d = q.shape
     scale = scale if scale is not None else d ** -0.5
-    block = min(block_size, t)
-    if t % block:
-        raise ValueError(f"seq len {t} not divisible by block {block}")
+    block = auto_block(t, block_size or 512)
     n_blocks = t // block
 
     q32 = q.astype(jnp.float32) * scale

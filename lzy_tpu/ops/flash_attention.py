@@ -316,8 +316,8 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 256,
-    block_kv: int = 256,
+    block_q: int = 512,
+    block_kv: int = 512,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """q/k/v: [B, H, T, D] → [B, H, T, D]. T must be a multiple of 128 (TPU

@@ -60,11 +60,7 @@ def ulysses_attention(
         # [B, H/n, T, D]: exact attention over the full sequence
         from lzy_tpu.ops.attention import chunked_attention
 
-        t = qg.shape[2]
-        block = next(bs for bs in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1)
-                     if t % bs == 0)
-        out = chunked_attention(qg, kg, vg, causal=causal, scale=scale,
-                                block_size=block)
+        out = chunked_attention(qg, kg, vg, causal=causal, scale=scale)
         return head_to_seq(out)
 
     return shard_map(

@@ -104,7 +104,9 @@ class InProcessCluster:
 
     def serve(self, port: int = 0):
         """Expose the control plane over gRPC (for remote SDK clients); with
-        worker_mode="process" a server is already running."""
+        worker_mode="process" a server is already running. ``port`` defaults
+        to the constructor's ``rpc_port``."""
+        port = port or self._rpc_port
         if self.rpc_server is not None:
             if port not in (0, self.rpc_server.port):
                 raise RuntimeError(

@@ -157,9 +157,17 @@ def test_worker_reregisters_with_rebooted_control_plane(tmp_path):
                 time.sleep(0.2)
         else:
             pytest.fail("worker never re-registered with the new control plane")
-        # the re-registered endpoint is live: dial it directly
-        assert agent.status_probe() if hasattr(agent, "status_probe") else True
+        # the re-registered endpoint is live: dial it — an unknown op id must
+        # come back as a clean KeyError FROM THE WORKER, proving the round trip
+        with pytest.raises(KeyError):
+            agent.status("no-such-op")
     finally:
+        # c2's backend never launched the worker process, so it can't reap it;
+        # terminate c1's orphan explicitly
+        for proc in list(c1.backend._procs.values()):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                proc.wait(timeout=10)
         c2.shutdown()
         # the workflow context can't exit cleanly (its control plane died);
         # clear the active slot so later tests can open workflows
