@@ -36,14 +36,14 @@ def chunked_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_size: Optional[int] = 512,
+    block_size: int = 512,
 ) -> jax.Array:
     """q/k/v: [B, H, T, D] → [B, H, T, D]. Keys/values are processed in
     blocks with the flash merge recurrence; ``block_size`` is clamped to the
-    largest divisor of T (``None`` means fully automatic)."""
+    largest divisor of T."""
     b, h, t, d = q.shape
     scale = scale if scale is not None else d ** -0.5
-    block = auto_block(t, block_size or 512)
+    block = auto_block(t, block_size)
     n_blocks = t // block
 
     q32 = q.astype(jnp.float32) * scale

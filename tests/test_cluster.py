@@ -295,6 +295,26 @@ def test_per_user_task_limit(tmp_path):
         c.shutdown()
 
 
+@op
+def read_env_var() -> str:
+    import os
+
+    return os.environ.get("LZY_TEST_FLAVOR", "unset")
+
+
+def test_env_vars_applied_to_op(cluster):
+    """Call-level env_vars reach the op's environment and are restored after
+    (reference: worker sets the op process env)."""
+    import os
+
+    from lzy_tpu import env_vars
+
+    lzy = cluster.lzy()
+    with lzy.workflow("env-wf", env=env_vars(LZY_TEST_FLAVOR="vanilla")):
+        assert str(read_env_var()) == "vanilla"
+    assert os.environ.get("LZY_TEST_FLAVOR") is None  # restored
+
+
 def test_failed_graph_releases_user_slots(tmp_path):
     """A failed graph must release its admitted per-user slots, or the user
     is pinned at their limit forever."""

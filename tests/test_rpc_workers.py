@@ -163,11 +163,18 @@ def test_worker_reregisters_with_rebooted_control_plane(tmp_path):
             agent.status("no-such-op")
     finally:
         # c2's backend never launched the worker process, so it can't reap it;
-        # terminate c1's orphan explicitly
+        # terminate c1's orphan explicitly (kill fallback — this cleanup must
+        # never mask the test result or skip the steps below)
+        import subprocess
+
         for proc in list(c1.backend._procs.values()):
             if proc is not None and proc.poll() is None:
                 proc.terminate()
-                proc.wait(timeout=10)
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
         c2.shutdown()
         # the workflow context can't exit cleanly (its control plane died);
         # clear the active slot so later tests can open workflows
