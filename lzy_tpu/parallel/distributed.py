@@ -23,36 +23,57 @@ _LOG = get_logger(__name__)
 
 COORDINATOR_PORT = 8476
 
+# jax.distributed.initialize is once-per-process; a reused gang worker must
+# not re-initialize (and cannot re-target a different coordinator)
+_INITIALIZED_WITH: Optional[str] = None
+
 
 def initialize_gang(coordinator_address: Optional[str] = None) -> dict:
     """Join this host to its gang's JAX distributed runtime. Reads the gang
     context planted by the worker (``lzy_tpu.service.worker.current_gang``)
-    or the standard env vars a cloud backend sets on the pod.
+    or the standard env vars a cloud backend sets on the pod. Idempotent:
+    a reused worker that already joined returns without re-initializing.
 
     Returns {"rank", "size", "initialized"}.
     """
+    global _INITIALIZED_WITH
+
     from lzy_tpu.service.worker import current_gang
 
     gang = current_gang()
+    port = COORDINATOR_PORT
     if gang is None:
         rank = int(os.environ.get("LZY_GANG_RANK", "0"))
         size = int(os.environ.get("LZY_GANG_SIZE", "1"))
         coordinator_address = coordinator_address or os.environ.get(
             "LZY_GANG_COORDINATOR"
         )
+        port = int(os.environ.get("LZY_GANG_COORDINATOR_PORT", port))
     else:
         rank, size = gang["rank"], gang["size"]
         coordinator_address = coordinator_address or gang.get("coordinator")
+        port = int(gang.get("coordinator_port") or port)
 
     if size <= 1 or coordinator_address is None:
         # single host, or in-process gang sharing one JAX runtime
         return {"rank": rank, "size": size, "initialized": False}
 
+    target = f"{coordinator_address}:{port}"
+    if _INITIALIZED_WITH is not None:
+        if _INITIALIZED_WITH != target:
+            _LOG.warning(
+                "gang wants coordinator %s but this process already joined "
+                "%s; jax.distributed can only initialize once — reusing the "
+                "existing runtime", target, _INITIALIZED_WITH,
+            )
+        return {"rank": rank, "size": size, "initialized": True}
+
     jax.distributed.initialize(
-        coordinator_address=f"{coordinator_address}:{COORDINATOR_PORT}",
+        coordinator_address=target,
         num_processes=size,
         process_id=rank,
     )
+    _INITIALIZED_WITH = target
     _LOG.info("joined gang: process %d/%d, %d global devices",
               rank, size, jax.device_count())
     return {"rank": rank, "size": size, "initialized": True}

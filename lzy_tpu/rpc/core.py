@@ -45,8 +45,11 @@ class JsonRpcServer:
     """``handlers``: method name → fn(dict) -> dict|None."""
 
     def __init__(self, handlers: Dict[str, Callable[[dict], Any]],
-                 port: int = 0, max_workers: int = 16):
+                 port: int = 0, max_workers: int = 16,
+                 bind_host: str = "0.0.0.0",
+                 advertise_host: str = "127.0.0.1"):
         self._handlers = dict(handlers)
+        self._advertise_host = advertise_host
         server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
 
         def make_behavior(fn):
@@ -71,13 +74,15 @@ class JsonRpcServer:
         }
         generic = grpc.method_handlers_generic_handler(_SERVICE, method_handlers)
         server.add_generic_rpc_handlers((generic,))
-        self.port = server.add_insecure_port(f"127.0.0.1:{port}")
+        self.port = server.add_insecure_port(f"{bind_host}:{port}")
         server.start()
         self._server = server
 
     @property
     def address(self) -> str:
-        return f"127.0.0.1:{self.port}"
+        """The address peers should dial — the ADVERTISE host (a pod IP on a
+        real multi-host deployment), not the bind host."""
+        return f"{self._advertise_host}:{self.port}"
 
     def stop(self, grace: float = 1.0) -> None:
         self._server.stop(grace)

@@ -32,6 +32,10 @@ def main(argv=None) -> None:
     parser.add_argument("--storage-uri", required=True)
     parser.add_argument("--spill-root", default=None)
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--advertise-host", default=os.environ.get(
+        "LZY_WORKER_ADVERTISE_HOST", "127.0.0.1"),
+        help="routable address peers use to reach this worker (pod IP on "
+             "multi-host deployments)")
     args = parser.parse_args(argv)
 
     os.environ.setdefault("LZY_WORKER_ISOLATED", "1")  # sync user modules
@@ -65,7 +69,7 @@ def main(argv=None) -> None:
         "Execute": h_execute,
         "Status": h_status,
         "Shutdown": h_shutdown,
-    }, port=args.port)
+    }, port=args.port, advertise_host=args.advertise_host)
 
     allocator = RpcAllocatorClient(control, endpoint=server.address)
     agent = WorkerAgent(
@@ -74,6 +78,7 @@ def main(argv=None) -> None:
         channels=channels,
         storage_client=storage,
         spill_root=args.spill_root,
+        advertise_host=args.advertise_host,
         heartbeat_period_s=2.0,
         # a dead control plane must not leak this process forever
         max_heartbeat_failures=5,

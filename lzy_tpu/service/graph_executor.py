@@ -26,6 +26,7 @@ from lzy_tpu.durable import (
 )
 from lzy_tpu.service.allocator import AllocatorService
 from lzy_tpu.service.graph import GraphDesc, TaskDesc, build_dependencies
+from lzy_tpu.utils import hashing
 from lzy_tpu.utils.log import get_logger
 from lzy_tpu.utils.metrics import REGISTRY
 
@@ -255,7 +256,20 @@ class _ExecTaskAction(OperationRunner):
             return StepResult.ALREADY_DONE
         task = self.task
         vm_ids = self.state["vm_ids"]
-        gang = {"gang_id": self.state["gang_id"], "vm_ids": vm_ids}
+        # rank 0's host is the jax.distributed coordinator for multi-host
+        # SPMD (lzy_tpu.parallel.initialize_gang); endpoint-less in-process
+        # agents share one runtime and need none. The port is derived from
+        # the gang id so CONCURRENT gangs on shared hosts don't collide on
+        # one fixed coordinator port.
+        agent0 = self.svc._allocator.agent(vm_ids[0])
+        endpoint = getattr(agent0, "endpoint", None)
+        coordinator = endpoint.rsplit(":", 1)[0] if endpoint else None
+        coordinator_port = 40000 + (
+            int(hashing.hash_str(self.state["gang_id"]), 16) % 20000
+        )
+        gang = {"gang_id": self.state["gang_id"], "vm_ids": vm_ids,
+                "coordinator": coordinator,
+                "coordinator_port": coordinator_port}
         worker_ops = {}
         for rank, vm_id in enumerate(vm_ids):
             agent = self.svc._allocator.agent(vm_id)
