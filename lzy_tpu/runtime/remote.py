@@ -78,6 +78,7 @@ class RemoteRuntime(Runtime):
 
     def exec(self, workflow: "LzyWorkflow", calls: Sequence["LzyCall"]) -> None:
         graph = self._build_graph(workflow, calls)
+        self._confirm_pools(workflow, graph)
         graph_op_id = self._client.execute_graph(
             workflow.execution_id, graph.to_doc(), token=self._token
         )
@@ -145,6 +146,25 @@ class RemoteRuntime(Runtime):
             storage_uri=config.uri,
             tasks=tasks,
         )
+
+    def _confirm_pools(self, workflow: "LzyWorkflow", graph: GraphDesc) -> None:
+        """Interactive pool-mapping confirmation before spending money on
+        slices (reference prompt, ``remote/runtime.py:426-434``). Only fires
+        on a TTY with an interactive workflow; CI/tests never see it."""
+        if not workflow.is_interactive or not sys.stdin.isatty():
+            return
+        lines = [f"  {t.name}: pool={t.pool_label} hosts={t.gang_size}"
+                 for t in graph.tasks]
+        print("About to run on:", file=sys.stderr)
+        print("\n".join(lines), file=sys.stderr)
+        # prompt on stderr (stdout may be redirected) and default to NO —
+        # reflexive Enter must not allocate slices (reference semantics)
+        print("Proceed? (Yes/[No]) ", end="", file=sys.stderr, flush=True)
+        answer = input().strip().lower()
+        if answer not in ("y", "yes"):
+            from lzy_tpu.core.workflow import WorkflowError
+
+            raise WorkflowError("graph execution declined by user")
 
     # -- polling (reference poll loop, runtime.py:178-205) ---------------------
 

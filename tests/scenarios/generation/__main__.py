@@ -1,0 +1,59 @@
+"""Train a tiny Llama inside a TPU op, then generate from the returned params
+with the KV-cache decoder — the train→serve loop in one workflow."""
+import numpy as np
+
+from tests.scenarios._base import make_lzy
+from lzy_tpu import op
+
+
+@op(tpu="v5e-8")
+def train_tiny() -> dict:
+    import jax
+    import optax
+
+    from lzy_tpu.models import llama, unbox
+    from lzy_tpu.parallel import TrainState, fsdp_mesh, make_train_step
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=64)
+    mesh = fsdp_mesh()
+    boxed, axes = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tx = optax.adam(1e-3)
+    step, shard_state, _ = make_train_step(
+        llama.make_loss_fn(cfg), tx, mesh=mesh, param_logical_axes=axes,
+        batch_logical_axes=("batch", "seq"))
+    state = shard_state(TrainState.create(unbox(boxed), tx))
+    batch = {"tokens": jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 64)}
+    first = last = None
+    for _ in range(4):
+        state, m = step(state, batch)
+        last = float(m["loss"])
+        if first is None:
+            first = last
+    return {"params": jax.device_get(state.params),
+            "improved": bool(last < first)}
+
+
+@op
+def sample(result: dict) -> str:
+    import jax
+    import jax.numpy as jnp
+
+    from lzy_tpu.models import LlamaConfig, generate
+
+    cfg = LlamaConfig.tiny(vocab_size=64)
+    out = generate(cfg, result["params"], jnp.array([[1, 2, 3]], jnp.int32),
+                   max_new_tokens=4)
+    return f"{out.shape[1]} tokens, loss improved: {result['improved']}"
+
+
+def main():
+    cluster, lzy = make_lzy()
+    try:
+        with lzy.workflow("train-and-generate"):
+            print(f"generated: {str(sample(train_tiny()))}")
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()
