@@ -80,7 +80,12 @@ class LocalRuntime(Runtime):
         kwargs = {k: snapshot.get(eid) for k, eid in call.kwarg_entry_ids.items()}
 
         try:
-            result = call.signature.func(*args, **kwargs)
+            # same env application the remote worker performs — runtimes must
+            # not differ in op-visible behavior
+            from lzy_tpu.utils.env import applied_env_vars
+
+            with applied_env_vars(call.env.env_vars):
+                result = call.signature.func(*args, **kwargs)
         except BaseException as e:
             self._store_exception(workflow, call, e)
             raise RemoteCallError(call.op_name, e) from e

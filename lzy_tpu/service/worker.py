@@ -44,57 +44,6 @@ _GANG: contextvars.ContextVar[Optional[Dict[str, Any]]] = contextvars.ContextVar
     "lzy_gang", default=None
 )
 
-# os.environ is process-global. The lock covers only the set/restore phases
-# (NOT the op body — an op can run for hours and may even depend on another
-# env-bearing op's output; holding a lock across it would serialize or wedge
-# the graph). Refcounts make nested/overlapping applications restore the true
-# original once the last user exits; concurrent ops that set CONFLICTING
-# values for the same key observe last-set-wins, the inherent semantics of a
-# process-global environment (the reference sidesteps this with one process
-# per op; process workers here reduce to the same when tasks don't overlap).
-_ENV_LOCK = threading.Lock()
-_ENV_STATE: Dict[str, list] = {}   # key -> [original value, refcount]
-
-
-class _applied_env_vars:
-    def __init__(self, env_vars: Dict[str, str]):
-        # precompute outside the lock: a bad key/value must fail cleanly
-        # before any mutation, never with the lock held
-        self._items = [(str(k), str(v)) for k, v in (env_vars or {}).items()]
-
-    def __enter__(self):
-        with _ENV_LOCK:
-            applied = []
-            try:
-                for k, v in self._items:
-                    state = _ENV_STATE.setdefault(k, [os.environ.get(k), 0])
-                    os.environ[k] = v
-                    state[1] += 1
-                    applied.append(k)
-            except BaseException:
-                for k in applied:
-                    self._release(k)
-                raise
-        return self
-
-    def __exit__(self, *exc):
-        with _ENV_LOCK:
-            for k, _ in self._items:
-                self._release(k)
-
-    @staticmethod
-    def _release(k: str) -> None:
-        state = _ENV_STATE.get(k)
-        if state is None:
-            return
-        state[1] -= 1
-        if state[1] <= 0:
-            del _ENV_STATE[k]
-            if state[0] is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = state[0]
-
 
 def current_gang() -> Optional[Dict[str, Any]]:
     return _GANG.get()
@@ -295,7 +244,9 @@ class WorkerAgent:
         kwargs = {k: self._read_entry(ref) for k, ref in task.kwargs.items()}
         func = self._load_func(task.func_uri)
 
-        with _applied_env_vars(task.env_vars):
+        from lzy_tpu.utils.env import applied_env_vars
+
+        with applied_env_vars(task.env_vars):
             result = func(*args, **kwargs)
 
         n_out = len(task.outputs)

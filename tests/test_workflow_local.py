@@ -130,6 +130,21 @@ def test_bool_and_none_results_materialize_eagerly(lzy):
         assert n is None
 
 
+def test_env_vars_applied_locally(lzy):
+    """LocalRuntime applies call env_vars exactly like remote workers do."""
+    import os
+
+    from lzy_tpu import env_vars
+
+    @op(env=env_vars(LZY_LOCAL_FLAVOR="mint"))
+    def read_flavor() -> str:
+        return os.environ.get("LZY_LOCAL_FLAVOR", "unset")
+
+    with lzy.workflow("wf"):
+        assert str(read_flavor()) == "mint"
+    assert os.environ.get("LZY_LOCAL_FLAVOR") is None
+
+
 def test_optional_annotations_supported(lzy):
     from typing import Optional
 
