@@ -25,6 +25,7 @@ from lzy_tpu.durable import (
     StepResult,
 )
 from lzy_tpu.service.allocator import AllocatorService
+from lzy_tpu.service.allocator import RUNNING as VM_RUNNING
 from lzy_tpu.service.graph import GraphDesc, TaskDesc, build_dependencies
 from lzy_tpu.utils import hashing
 from lzy_tpu.utils.log import get_logger
@@ -52,6 +53,7 @@ class GraphExecutor:
         max_running_tasks: int = 8,
         max_running_tasks_per_user: int = 16,
         poll_period_s: float = 0.05,
+        task_timeout_s: float = 86_400.0,   # hard backstop per task action
     ):
         self._store = store
         self._executor = executor
@@ -60,6 +62,7 @@ class GraphExecutor:
         self.max_running_tasks = max_running_tasks
         self.max_running_tasks_per_user = max_running_tasks_per_user
         self.poll_period_s = poll_period_s
+        self.task_timeout_s = task_timeout_s
         # cross-graph fairness accounting (TasksSchedulerImpl limits
         # `:192-207` parity); in-memory — a restart re-admits from zero
         self._user_running: Dict[str, int] = {}
@@ -187,6 +190,7 @@ class _ExecGraphAction(OperationRunner):
                      "session_id": self.state["session_id"],
                      "graph_id": graph.id},
                     idempotency_key=f"task-{graph.id}-{tid}",
+                    deadline_s=self.svc.task_timeout_s,
                 )
                 info["status"] = RUNNING
                 running += 1
@@ -256,6 +260,15 @@ class _ExecTaskAction(OperationRunner):
             return StepResult.ALREADY_DONE
         task = self.task
         vm_ids = self.state["vm_ids"]
+        # same reboot tolerance as _probe_worker: an op resumed right after a
+        # control-plane restart may reach here before workers re-register
+        for vm_id in vm_ids:
+            try:
+                self.svc._allocator.agent(vm_id)
+            except KeyError:
+                if self._vm_alive(vm_id):
+                    return StepResult.restart(0.5)
+                raise RuntimeError(f"vm {vm_id} lost before execution")
         # rank 0's host is the jax.distributed coordinator for multi-host
         # SPMD (lzy_tpu.parallel.initialize_gang); endpoint-less in-process
         # agents share one runtime and need none. The port is derived from
@@ -278,16 +291,48 @@ class _ExecTaskAction(OperationRunner):
         self.state["worker_op_ids"] = worker_ops
         return StepResult.CONTINUE
 
+    def _vm_alive(self, vm_id: str) -> bool:
+        """VM record present, RUNNING, heartbeat-fresh — the grace window in
+        which a worker may be re-registering with a rebooted control plane."""
+        try:
+            vm = self.svc._allocator.vm(vm_id)
+        except KeyError:
+            return False
+        return vm.status == VM_RUNNING and (
+            time.time() - vm.heartbeat_ts
+            < self.svc._allocator.HEARTBEAT_TIMEOUT_S
+        )
+
+    def _probe_worker(self, vm_id: str, worker_op: str) -> Dict[str, Any]:
+        lost = {"status": "FAILED", "error": f"vm {vm_id} lost",
+                "exception_uri": None}
+        try:
+            agent = self.svc._allocator.agent(vm_id)
+        except KeyError:
+            agent = None
+        if agent is not None:
+            try:
+                return agent.status(worker_op)
+            except KeyError:
+                # a REACHABLE worker that doesn't know the op restarted and
+                # lost its in-memory op state: the work is gone, fail now —
+                # heartbeats alone must not keep this task pending forever
+                return {"status": "FAILED",
+                        "error": f"worker {vm_id} lost op state",
+                        "exception_uri": None}
+            except Exception:
+                pass  # connection-level failure: judge by VM liveness below
+        # endpoint gap or dial failure: alive VM → transient (pending),
+        # dead/stale VM → lost
+        if self._vm_alive(vm_id):
+            return {"status": "RUNNING", "error": None, "exception_uri": None}
+        return lost
+
     def _await_execution(self):
         task = self.task
         statuses = []
         for vm_id, worker_op in self.state["worker_op_ids"].items():
-            try:
-                agent = self.svc._allocator.agent(vm_id)
-                statuses.append(agent.status(worker_op))
-            except KeyError:
-                statuses.append({"status": "FAILED",
-                                 "error": f"vm {vm_id} lost", "exception_uri": None})
+            statuses.append(self._probe_worker(vm_id, worker_op))
         failed = [s for s in statuses if s["status"] == "FAILED"]
         if failed:
             self.state["exception_uri"] = next(
