@@ -5,18 +5,91 @@ Baseline per BASELINE.md north star: 40% MFU for an @op train step
 (the reference publishes no numbers of its own; 0.40 MFU is the target the
 TPU build must reach, so vs_baseline = achieved_mfu / 0.40).
 
-Runs on whatever jax.devices() provides: the driver's single real TPU chip,
-or CPU for local sanity (tiny shapes, placeholder peak).
+Built for a hostile backend (the relayed TPU plugin can hang at init or die
+with UNAVAILABLE): the benchmark body runs in a supervised child process
+under a hard deadline, gets one retry, and on unrecoverable failure the
+supervisor still emits a single parseable JSON line carrying an "error" key
+(exit code 0) instead of a stack trace. Progress is staged on stderr so a
+hang is attributable to a phase.
 """
 
 from __future__ import annotations
 
 import json
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import optax
+ATTEMPT_DEADLINE_S = 560  # per child attempt; first TPU compile alone can take 90 s
+ATTEMPTS = 2
+METRIC = "llama_train_step_mfu"
+
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def supervise() -> None:
+    errors = []
+    for attempt in range(1, ATTEMPTS + 1):
+        _log(f"attempt {attempt}/{ATTEMPTS} (deadline {ATTEMPT_DEADLINE_S}s)")
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__, "--run"],
+                stdout=subprocess.PIPE,  # stderr passes through for live progress
+                timeout=ATTEMPT_DEADLINE_S,
+            )
+        except subprocess.TimeoutExpired as e:
+            # the child may have printed the headline metric before hanging
+            # (e.g. in the optional breakdown pass) — salvage it
+            partial = (e.stdout or b"")
+            if isinstance(partial, bytes):
+                partial = partial.decode("utf-8", "replace")
+            for line in reversed(partial.splitlines()):
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(obj, dict) and obj.get("metric") == METRIC:
+                    _log(f"attempt {attempt}: hung after printing the metric; "
+                         f"using it")
+                    print(line, flush=True)
+                    return
+            errors.append(f"attempt {attempt}: hung, killed after {ATTEMPT_DEADLINE_S}s")
+            _log(errors[-1])
+            continue
+        out = proc.stdout.decode("utf-8", "replace")
+        for line in reversed(out.splitlines()):
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and obj.get("metric") == METRIC:
+                print(line, flush=True)
+                return
+        errors.append(
+            f"attempt {attempt}: rc={proc.returncode} after "
+            f"{time.monotonic() - t0:.0f}s, no metric line in stdout"
+        )
+        _log(errors[-1])
+    print(
+        json.dumps(
+            {
+                "metric": METRIC,
+                "value": 0.0,
+                "unit": "mfu_fraction",
+                "vs_baseline": 0.0,
+                "error": "; ".join(errors) or "no attempts ran",
+            }
+        ),
+        flush=True,
+    )
+
+
+# --------------------------------------------------------------------------
+# child: the actual benchmark
+# --------------------------------------------------------------------------
 
 
 def pick_config(platform: str):
@@ -39,23 +112,54 @@ def pick_config(platform: str):
     return cfg, batch_size, seq_len, steps, warmup
 
 
-def main() -> None:
-    from lzy_tpu.models import count_params, llama, unbox
-    from lzy_tpu.parallel import PEAK_TFLOPS, TrainState, make_train_step, mesh_for, mfu
+def init_devices(timeout_s: float = 240.0):
+    """Backend init under a watchdog: jax.devices() on this relayed platform
+    has been observed to hang for >580 s; surface that as an error promptly
+    instead of eating the whole attempt deadline."""
+    import threading
 
-    devices = jax.devices()
+    result: list = []
+
+    def probe():
+        import jax
+
+        result.append(jax.devices())
+
+    t = threading.Thread(target=probe, daemon=True, name="jax-init")
+    t.start()
+    t.join(timeout_s)
+    if not result:
+        raise RuntimeError(f"jax backend init did not complete in {timeout_s:.0f}s")
+    return result[0]
+
+
+def run() -> None:
+    _log("initializing jax backend...")
+    devices = init_devices()
+    import jax
+
     platform = devices[0].platform
     chip = "v5e" if platform in ("tpu", "axon") else "cpu"
+    _log(f"backend up: {len(devices)}x {platform}")
+
+    import optax
+
+    from lzy_tpu.models import count_params, llama, unbox
+    from lzy_tpu.parallel import TrainState, make_train_step, mesh_for, mfu
+
     cfg, batch_size, seq_len, steps, warmup = pick_config(platform)
 
     mesh = mesh_for(fsdp=-1)
+    _log("initializing params...")
     boxed, axes = llama.init_params(cfg, jax.random.PRNGKey(0))
     params = unbox(boxed)
     n_params = count_params(params)
+    _log(f"model ready: {n_params/1e6:.0f}M params, batch {batch_size} x seq {seq_len}")
 
     tx = optax.adamw(3e-4)
+    loss_fn = llama.make_loss_fn(cfg)
     step, shard_state, _ = make_train_step(
-        llama.make_loss_fn(cfg), tx, mesh=mesh, param_logical_axes=axes,
+        loss_fn, tx, mesh=mesh, param_logical_axes=axes,
         batch_logical_axes=("batch", "seq"),
     )
     state = shard_state(TrainState.create(params, tx))
@@ -68,35 +172,88 @@ def main() -> None:
     # hard sync via host transfer: each step consumes the previous state, so
     # materializing the last loss proves the whole chain executed
     # (block_until_ready alone does not flush on relayed TPU platforms)
-    for _ in range(warmup):
+    _log("compiling + warmup...")
+    for i in range(warmup):
         state, metrics = step(state, batch)
-    float(metrics["loss"])
+        float(metrics["loss"])
+        _log(f"warmup step {i + 1}/{warmup} done")
 
+    _log(f"timing {steps} steps...")
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, batch)
     final_loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
+    step_ms = 1000 * dt / steps
+    _log(f"timed: {step_ms:.1f} ms/step, loss {final_loss:.3f}")
 
     tokens_per_s = batch_size * seq_len * steps / dt
     achieved_mfu = mfu(tokens_per_s, n_params, len(devices), chip=chip)
 
-    print(json.dumps({
-        "metric": "llama_train_step_mfu",
-        "value": round(achieved_mfu, 4),
-        "unit": "mfu_fraction",
-        "vs_baseline": round(achieved_mfu / 0.40, 4),
-        "detail": {
-            "platform": platform,
-            "chips": len(devices),
-            "params": n_params,
-            "tokens_per_s": round(tokens_per_s, 1),
-            "step_time_ms": round(1000 * dt / steps, 2),
-            "batch": batch_size,
-            "seq_len": seq_len,
-        },
-    }))
+    detail = {
+        "platform": platform,
+        "chips": len(devices),
+        "params": n_params,
+        "tokens_per_s": round(tokens_per_s, 1),
+        "step_time_ms": round(step_ms, 2),
+        "batch": batch_size,
+        "seq_len": seq_len,
+    }
+
+    def emit():
+        print(json.dumps({
+            "metric": METRIC,
+            "value": round(achieved_mfu, 4),
+            "unit": "mfu_fraction",
+            "vs_baseline": round(achieved_mfu / 0.40, 4),
+            "detail": detail,
+        }), flush=True)
+
+    # headline FIRST: the breakdown costs two extra compiles, and on this
+    # backend a compile can hang — the supervisor salvages the last metric
+    # line, so a measured MFU must already be on stdout before we risk it
+    emit()
+    extra = step_breakdown(jax, loss_fn, state, batch, mesh, step_ms)
+    if extra:
+        detail.update(extra)
+        emit()
+
+
+def step_breakdown(jax, loss_fn, state, batch, mesh, step_ms: float, n: int = 5):
+    """Best-effort fwd/bwd/opt decomposition of the step time.
+
+    Times a jitted forward (loss only) and a jitted value_and_grad; the
+    optimizer share is the remainder of the full step. Two extra compiles —
+    wrapped so a backend hiccup here never loses the headline metric.
+    """
+    try:
+        _log("breakdown: timing fwd-only...")
+
+        def timed(fn, *args):
+            fn(*args)  # compile + first-run cost
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = fn(*args)
+            # hard sync (see note above): pull one scalar leaf to the host
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            float(jax.numpy.ravel(leaf)[0])
+            return 1000 * (time.perf_counter() - t0) / n
+
+        fwd_ms = timed(jax.jit(loss_fn), state.params, batch)
+        _log("breakdown: timing fwd+bwd...")
+        grad_ms = timed(jax.jit(jax.value_and_grad(loss_fn)), state.params, batch)
+        return {
+            "fwd_ms": round(fwd_ms, 2),
+            "bwd_ms": round(max(grad_ms - fwd_ms, 0.0), 2),
+            "opt_ms": round(max(step_ms - grad_ms, 0.0), 2),
+        }
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        _log(f"breakdown skipped: {type(e).__name__}: {e}")
+        return {}
 
 
 if __name__ == "__main__":
-    main()
+    if "--run" in sys.argv:
+        run()
+    else:
+        supervise()
