@@ -98,6 +98,11 @@ class OperationsExecutor:
         self._cv = threading.Condition()
         self._queue: List[Tuple[float, str]] = []  # (not_before, op_id)
         self._inflight: set = set()                # queued or being driven
+        # op_id -> number of threads currently driving it. A count (not a
+        # set): after a RESTART requeue the next thread can pop the op before
+        # the restarting thread has exited _run_one, so two drivers briefly
+        # overlap on the bookkeeping (never on the op body).
+        self._driving: Dict[str, int] = {}
         self._waiters: Dict[str, threading.Event] = {}
         self._stopped = False
         self._threads = [
@@ -180,6 +185,7 @@ class OperationsExecutor:
                 ready = [i for i, (t, _) in enumerate(self._queue) if t <= now]
                 if ready:
                     _, op_id = self._queue.pop(ready[0])
+                    self._driving[op_id] = self._driving.get(op_id, 0) + 1
                     return op_id
                 timeout = (self._queue[0][0] - now) if self._queue else None
                 self._cv.wait(timeout=timeout)
@@ -195,7 +201,18 @@ class OperationsExecutor:
             except BaseException:
                 _LOG.exception("unexpected error driving operation %s", op_id)
             with self._cv:
-                if all(oid != op_id for _, oid in self._queue):
+                # ownership: after a RESTART requeue another thread may have
+                # already popped the op and be driving it — only the last
+                # thread out (op neither queued nor being driven by anyone
+                # else) may clear _inflight, or a duplicate submit/restore
+                # could start a second concurrent driver
+                left = self._driving.get(op_id, 1) - 1
+                if left > 0:
+                    self._driving[op_id] = left
+                else:
+                    self._driving.pop(op_id, None)
+                if (op_id not in self._driving
+                        and all(oid != op_id for _, oid in self._queue)):
                     self._inflight.discard(op_id)  # terminal or crashed
             event = self._waiters.get(op_id)
             if event is not None:

@@ -7,9 +7,12 @@ single-tenant-friendly but IAM-shaped — subjects, roles, signed tokens, and an
 ``authorize`` check the services call, so a multi-tenant backend can replace
 the token scheme without touching call sites.
 
-Tokens are HMAC-SHA256 over ``subject_id:issued_at`` with a per-deployment
-secret (the stdlib equivalent of the reference's RSA JWTs; the interface —
-issue/authenticate — is the same).
+Tokens are HMAC-SHA256 over ``subject_id:issued_at:generation`` with a
+per-deployment secret (the stdlib equivalent of the reference's RSA JWTs; the
+interface — issue/authenticate — is the same). Like the reference JWTs they
+expire: ``authenticate`` enforces a max token age, and each subject carries a
+generation counter so tokens can be rotated (``rotate_subject``) without
+deleting the subject.
 """
 
 from __future__ import annotations
@@ -60,8 +63,17 @@ class Subject:
 
 
 class IamService:
-    def __init__(self, store: OperationStore, secret: Optional[str] = None):
+    # reference JWTs default to short lifetimes; workers re-issue via the
+    # allocator on reallocation, users via `lzy auth`
+    DEFAULT_MAX_TOKEN_AGE_S = 7 * 24 * 3600.0
+
+    def __init__(self, store: OperationStore, secret: Optional[str] = None,
+                 max_token_age_s: Optional[float] = None):
         self._store = store
+        self.max_token_age_s = (
+            self.DEFAULT_MAX_TOKEN_AGE_S if max_token_age_s is None
+            else max_token_age_s
+        )
         stored = store.kv_get("iam", "__secret__")
         if stored is None:
             stored = secret or secrets.token_hex(32)
@@ -78,31 +90,63 @@ class IamService:
         if role not in _ROLE_PERMISSIONS:
             raise ValueError(f"bad role {role!r}")
         self._store.kv_put("iam", f"subject:{subject_id}",
-                           {"kind": kind, "role": role})
-        return self._issue(subject_id)
+                           {"kind": kind, "role": role, "gen": 0})
+        return self._issue(subject_id, 0)
 
     def remove_subject(self, subject_id: str) -> None:
         self._store.kv_del("iam", f"subject:{subject_id}")
 
+    def rotate_subject(self, subject_id: str) -> str:
+        """Invalidate every outstanding token for the subject (bump its
+        generation) and return a fresh one — revocation without deletion."""
+        doc = self._store.kv_get("iam", f"subject:{subject_id}")
+        if doc is None:
+            raise KeyError(f"unknown subject {subject_id!r}")
+        gen = int(doc.get("gen", 0)) + 1
+        doc["gen"] = gen
+        self._store.kv_put("iam", f"subject:{subject_id}", doc)
+        return self._issue(subject_id, gen)
+
+    def issue_token(self, subject_id: str) -> str:
+        """Fresh token for an existing subject at its current generation."""
+        doc = self._store.kv_get("iam", f"subject:{subject_id}")
+        if doc is None:
+            raise KeyError(f"unknown subject {subject_id!r}")
+        return self._issue(subject_id, int(doc.get("gen", 0)))
+
     # -- tokens ----------------------------------------------------------------
 
-    def _issue(self, subject_id: str) -> str:
+    def _issue(self, subject_id: str, gen: int) -> str:
         ts = str(int(time.time()))
-        sig = hmac.new(self._secret, f"{subject_id}:{ts}".encode(),
+        sig = hmac.new(self._secret, f"{subject_id}:{ts}:{gen}".encode(),
                        hashlib.sha256).hexdigest()
-        return f"{subject_id}:{ts}:{sig}"
+        return f"{subject_id}:{ts}:{gen}:{sig}"
 
     def authenticate(self, token: Optional[str]) -> Subject:
-        if not token or token.count(":") != 2:
+        if token and token.count(":") == 2:
+            # pre-generation token format ("subject:ts:sig"): cryptographically
+            # fine but unrevocable; direct the holder to re-auth instead of a
+            # misleading "malformed"
+            raise AuthError("legacy token format; re-authenticate for a "
+                            "generation-bearing token")
+        if not token or token.count(":") != 3:
             raise AuthError("missing or malformed token")
-        subject_id, ts, sig = token.split(":")
-        expected = hmac.new(self._secret, f"{subject_id}:{ts}".encode(),
+        subject_id, ts, gen, sig = token.split(":")
+        expected = hmac.new(self._secret, f"{subject_id}:{ts}:{gen}".encode(),
                             hashlib.sha256).hexdigest()
         if not hmac.compare_digest(sig, expected):
             raise AuthError("invalid token signature")
+        try:
+            issued_at = float(ts)
+        except ValueError:
+            raise AuthError("malformed token timestamp")
+        if time.time() - issued_at > self.max_token_age_s:
+            raise AuthError("token expired")
         doc = self._store.kv_get("iam", f"subject:{subject_id}")
         if doc is None:
             raise AuthError(f"unknown subject {subject_id!r}")
+        if int(gen) != int(doc.get("gen", 0)):
+            raise AuthError("token revoked (stale generation)")
         return Subject(id=subject_id, kind=doc["kind"], role=doc["role"])
 
     # -- authz -----------------------------------------------------------------

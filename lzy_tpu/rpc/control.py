@@ -29,6 +29,34 @@ from lzy_tpu.utils.log import get_logger
 _LOG = get_logger(__name__)
 
 
+class WorkerToken:
+    """Mutable holder for a worker's credential: heartbeat-delivered
+    refreshes (see ``AllocatorService.refresh_worker_token``) propagate to
+    every client sharing the holder. The previous token stays accepted for
+    one rotation to cover the in-flight window between the control plane
+    persisting the new token and this worker applying it."""
+
+    def __init__(self, value: str):
+        self.current = value
+        self.previous: Optional[str] = None
+
+    def rotate(self, new: str) -> None:
+        if new != self.current:
+            self.previous, self.current = self.current, new
+
+    def accepts(self, token: Optional[str]) -> bool:
+        return token is not None and token in (self.current, self.previous)
+
+
+def _token_value(token) -> Optional[str]:
+    """str | WorkerToken | callable | None → current str value."""
+    if token is None or isinstance(token, str):
+        return token
+    if isinstance(token, WorkerToken):
+        return token.current
+    return token()
+
+
 # -- server ---------------------------------------------------------------------
 
 
@@ -39,6 +67,28 @@ class ControlPlaneServer:
         svc = cluster.workflow_service
         channels = cluster.channels
         allocator = cluster.allocator
+        iam = getattr(cluster, "iam", None)
+
+        def worker_auth(p, vm_id: Optional[str] = None):
+            """Channel-plane and allocator-private methods are worker-only
+            surfaces: with IAM enabled they require a WORKER-kind (or
+            INTERNAL-role) token — previously any network peer could fail
+            channels or register a rogue endpoint (ADVICE r1, medium). For
+            VM-scoped methods the token must belong to that very VM."""
+            if iam is None:
+                return
+            from lzy_tpu.iam import AuthError, INTERNAL, WORKER
+
+            subject = iam.authenticate(p.get("token"))
+            if subject.kind != WORKER and subject.role != INTERNAL:
+                raise AuthError(
+                    f"subject {subject.id} may not call worker-plane APIs"
+                )
+            if (vm_id is not None and subject.kind == WORKER
+                    and subject.id != f"vm/{vm_id}"):
+                raise AuthError(
+                    f"subject {subject.id} does not own vm {vm_id!r}"
+                )
 
         def h_start(p):
             return {"execution_id": svc.start_workflow(
@@ -48,6 +98,7 @@ class ControlPlaneServer:
             )}
 
         def h_wait_channel(p):
+            worker_auth(p)
             # cv-parked bounded wait; completion/failure are the only wake
             # conditions (an early slot peer alone must not wake clients that
             # only act on completion — that would be a zero-delay RPC spin)
@@ -61,10 +112,40 @@ class ControlPlaneServer:
                     "slot_peer": peer, "storage_uri": ch.storage_uri}
 
         def h_register_vm(p):
+            worker_auth(p, vm_id=p["vm_id"])
+            vm_id = p["vm_id"]
+            allocator.vm(vm_id)  # KeyError → NOT_FOUND for unknown VMs
             allocator.register_vm(
-                p["vm_id"], RpcWorkerClient(p["endpoint"])
+                vm_id,
+                # echo the VM's own token on dial-back (read dynamically so a
+                # refreshed token is picked up): the worker verifies it, so
+                # only the control plane can drive its WorkerApi
+                RpcWorkerClient(
+                    p["endpoint"],
+                    token=lambda: allocator.vm(vm_id).worker_token,
+                ),
             )
             return {}
+
+        def h_heartbeat(p):
+            worker_auth(p, vm_id=p["vm_id"])
+            allocator.heartbeat(p["vm_id"])
+            fresh = allocator.refresh_worker_token(p["vm_id"])
+            if fresh is None and iam is not None:
+                # redelivery: if a past rotation's response was lost, the
+                # worker still presents the old (valid-by-generation) token;
+                # hand it the current one so dial-backs stop failing
+                current = allocator.vm(p["vm_id"]).worker_token
+                if current and p.get("token") != current:
+                    fresh = current
+            return {"token": fresh} if fresh else {}
+
+        def _ch(fn):
+            def handler(p):
+                worker_auth(p)
+                return fn(p)
+
+            return handler
 
         handlers = {
             # workflow service
@@ -88,19 +169,19 @@ class ControlPlaneServer:
             "ReadStdLogs": lambda p: {"logs": svc.read_std_logs(
                 p["execution_id"], p.get("offsets") or {},
                 token=p.get("token"))},
-            # channel plane
-            "ChannelBind": lambda p: (
-                channels.bind(p["entry_id"], p["role"], p["task_id"]) and {}),
-            "ChannelCompleted": lambda p: channels.transfer_completed(
-                p["entry_id"]),
-            "ChannelFailed": lambda p: channels.transfer_failed(
-                p["entry_id"], p.get("error", "")),
-            "ChannelPublishPeer": lambda p: channels.publish_peer(
-                p["entry_id"], SlotPeer(**p["peer"])),
+            # channel plane (worker-only surface)
+            "ChannelBind": _ch(lambda p: (
+                channels.bind(p["entry_id"], p["role"], p["task_id"]) and {})),
+            "ChannelCompleted": _ch(lambda p: channels.transfer_completed(
+                p["entry_id"])),
+            "ChannelFailed": _ch(lambda p: channels.transfer_failed(
+                p["entry_id"], p.get("error", ""))),
+            "ChannelPublishPeer": _ch(lambda p: channels.publish_peer(
+                p["entry_id"], SlotPeer(**p["peer"]))),
             "WaitChannel": h_wait_channel,
-            # allocator private
+            # allocator private (worker-only surface, VM-scoped)
             "RegisterVm": h_register_vm,
-            "Heartbeat": lambda p: allocator.heartbeat(p["vm_id"]),
+            "Heartbeat": h_heartbeat,
         }
         self._server = JsonRpcServer(handlers, port=port)
         self.address = self._server.address
@@ -117,24 +198,32 @@ class RpcWorkerClient:
     """What the graph executor holds for a process worker; dials the worker's
     own gRPC server for Init/Execute/Status."""
 
-    def __init__(self, endpoint: str):
+    def __init__(self, endpoint: str, token=None):
         self.endpoint = endpoint
+        # str or zero-arg callable; the VM's own token, echoed as mutual
+        # proof (callable keeps it current across refreshes)
+        self._token = token
         self._client = JsonRpcClient(endpoint)
 
     def init(self, owner: str) -> None:
-        self._client.call("Init", {"owner": owner})
+        self._client.call("Init", {"owner": owner,
+                                   "token": _token_value(self._token)})
 
     def execute(self, task: TaskDesc, gang_rank: int, gang: Dict[str, Any]) -> str:
         return self._client.call("Execute", {
             "task": task.to_doc(), "gang_rank": gang_rank, "gang": gang,
+            "token": _token_value(self._token),
         })["op_id"]
 
     def status(self, op_id: str) -> Dict[str, Any]:
-        return self._client.call("Status", {"op_id": op_id})
+        return self._client.call("Status", {
+            "op_id": op_id, "token": _token_value(self._token)})
 
     def stop(self) -> None:
         try:
-            self._client.call("Shutdown", {}, timeout_s=2.0)
+            self._client.call("Shutdown",
+                              {"token": _token_value(self._token)},
+                              timeout_s=2.0)
         except Exception:
             pass
         self._client.close()
@@ -146,24 +235,32 @@ class RpcWorkerClient:
 class RpcAllocatorClient:
     """The worker agent's view of AllocatorPrivate."""
 
-    def __init__(self, client: JsonRpcClient, endpoint: str):
+    def __init__(self, client: JsonRpcClient, endpoint: str, token=None):
         self._client = client
         self._endpoint = endpoint
+        self._token = token                # str or shared WorkerToken holder
 
     def register_vm(self, vm_id: str, agent: Any) -> None:
         # the live agent object cannot travel; its gRPC endpoint does
         self._client.call("RegisterVm", {"vm_id": vm_id,
-                                         "endpoint": self._endpoint})
+                                         "endpoint": self._endpoint,
+                                         "token": _token_value(self._token)})
 
     def heartbeat(self, vm_id: str) -> None:
         try:
-            self._client.call("Heartbeat", {"vm_id": vm_id})
+            resp = self._client.call("Heartbeat", {
+                "vm_id": vm_id, "token": _token_value(self._token)})
+            if resp and resp.get("token") and isinstance(self._token,
+                                                         WorkerToken):
+                # control plane reissued our credential (half-life refresh)
+                self._token.rotate(resp["token"])
         except KeyError:
             # a rebooted control plane restored our VM record but lost the
             # endpoint: re-register to reconnect. If the record itself is gone
             # this raises too, and the agent's failure counting takes over.
             self._client.call("RegisterVm", {"vm_id": vm_id,
-                                             "endpoint": self._endpoint})
+                                             "endpoint": self._endpoint,
+                                             "token": _token_value(self._token)})
 
 
 @dataclasses.dataclass
@@ -179,27 +276,32 @@ class RpcChannelsClient:
     the subset of ChannelManager the worker uses. Device residency stays
     process-local (that is its meaning)."""
 
-    def __init__(self, client: JsonRpcClient):
+    def __init__(self, client: JsonRpcClient, token=None):
         from lzy_tpu.channels.manager import DeviceResidency
 
         self._client = client
+        self._token = token                # str or shared WorkerToken holder
         self.device = DeviceResidency()
 
     def bind(self, entry_id: str, role: str, task_id: str) -> None:
         self._client.call("ChannelBind", {
             "entry_id": entry_id, "role": role, "task_id": task_id,
+            "token": _token_value(self._token),
         })
 
     def transfer_completed(self, entry_id: str) -> None:
-        self._client.call("ChannelCompleted", {"entry_id": entry_id})
+        self._client.call("ChannelCompleted", {
+            "entry_id": entry_id, "token": _token_value(self._token)})
 
     def transfer_failed(self, entry_id: str, error: str) -> None:
-        self._client.call("ChannelFailed", {"entry_id": entry_id,
-                                            "error": error})
+        self._client.call("ChannelFailed", {
+            "entry_id": entry_id, "error": error,
+            "token": _token_value(self._token)})
 
     def publish_peer(self, entry_id: str, peer: SlotPeer) -> None:
         self._client.call("ChannelPublishPeer", {
             "entry_id": entry_id, "peer": dataclasses.asdict(peer),
+            "token": _token_value(self._token),
         })
 
     def wait_available(self, entry_id: str,
@@ -210,6 +312,7 @@ class RpcChannelsClient:
         while True:
             doc = self._client.call("WaitChannel", {
                 "entry_id": entry_id, "timeout_s": 2.0,
+                "token": _token_value(self._token),
             })
             if doc["failed"]:
                 raise ChannelFailed(entry_id, doc["failed"])

@@ -40,27 +40,47 @@ def main(argv=None) -> None:
 
     os.environ.setdefault("LZY_WORKER_ISOLATED", "1")  # sync user modules
 
+    # WORKER-role IAM token minted by the allocator at launch (env, never
+    # argv): presented on every control-plane call, and required back from
+    # the control plane on our own WorkerApi — nobody else knows it. Shared
+    # as a WorkerToken holder so heartbeat-delivered refreshes reach every
+    # client (long-lived VMs must never age out of authentication).
+    from lzy_tpu.rpc.control import WorkerToken
+
+    raw_token = os.environ.get("LZY_WORKER_TOKEN") or None
+    token = WorkerToken(raw_token) if raw_token else None
+
     control = JsonRpcClient(args.control)
     storage = client_for(StorageConfig(uri=args.storage_uri))
-    channels = RpcChannelsClient(control)
+    channels = RpcChannelsClient(control, token=token)
 
     stop_event = threading.Event()
     agent_box = {}
 
+    def check_caller(p):
+        if token is not None and not token.accepts(p.get("token")):
+            from lzy_tpu.iam import AuthError  # maps to PERMISSION_DENIED
+
+            raise AuthError("WorkerApi call without the VM's token")
+
     def h_init(p):
+        check_caller(p)
         agent_box["agent"].init(p.get("owner", ""))
         return {}
 
     def h_execute(p):
+        check_caller(p)
         op_id = agent_box["agent"].execute(
             TaskDesc.from_doc(p["task"]), p["gang_rank"], p.get("gang", {})
         )
         return {"op_id": op_id}
 
     def h_status(p):
+        check_caller(p)
         return agent_box["agent"].status(p["op_id"])
 
     def h_shutdown(p):
+        check_caller(p)
         stop_event.set()
         return {}
 
@@ -71,7 +91,8 @@ def main(argv=None) -> None:
         "Shutdown": h_shutdown,
     }, port=args.port, advertise_host=args.advertise_host)
 
-    allocator = RpcAllocatorClient(control, endpoint=server.address)
+    allocator = RpcAllocatorClient(control, endpoint=server.address,
+                                   token=token)
     agent = WorkerAgent(
         args.vm_id,
         allocator=allocator,

@@ -73,6 +73,10 @@ class Vm:
     heartbeat_ts: float = 0.0
     idle_since: Optional[float] = None
     created_ts: float = dataclasses.field(default_factory=time.time)
+    # WORKER-role IAM token issued at allocation (None in open deployments):
+    # the worker presents it on channel-plane/allocator-private RPCs, and the
+    # control plane echoes it back on WorkerApi calls as mutual proof
+    worker_token: Optional[str] = None
 
     def to_doc(self) -> dict:
         return dataclasses.asdict(self)
@@ -112,10 +116,12 @@ class AllocatorService:
         pools: Sequence[PoolSpec],
         *,
         allocate_timeout_s: float = 120.0,
+        iam=None,                          # Optional[IamService]
     ):
         self._store = store
         self._executor = executor
         self._backend = backend
+        self._iam = iam
         self._pools: Dict[str, PoolSpec] = {p.label: p for p in pools}
         self._sessions: Dict[str, Session] = {}
         self._vms: Dict[str, Vm] = {}
@@ -221,6 +227,26 @@ class AllocatorService:
                 raise KeyError(f"vm {vm_id!r} has no registered agent")
             vm.heartbeat_ts = time.time()
 
+    def refresh_worker_token(self, vm_id: str) -> Optional[str]:
+        """Reissue the VM's WORKER token once it is past half-life, so
+        long-lived (cached/reused) VMs never age out of authentication.
+        Returns the fresh token to hand back on the heartbeat, else None."""
+        if self._iam is None:
+            return None
+        with self._lock:
+            vm = self._vms.get(vm_id)
+            if vm is None or not vm.worker_token:
+                return None
+            try:
+                issued_at = float(vm.worker_token.split(":")[1])
+            except (IndexError, ValueError):
+                issued_at = 0.0
+            if time.time() - issued_at <= 0.5 * self._iam.max_token_age_s:
+                return None
+            vm.worker_token = self._iam.issue_token(f"vm/{vm.id}")
+            self._persist(vm)
+            return vm.worker_token
+
     def agent(self, vm_id: str) -> Any:
         with self._lock:
             return self._agents[vm_id]
@@ -264,6 +290,17 @@ class AllocatorService:
 
     # -- internals -------------------------------------------------------------
 
+    def _issue_worker_token(self, vm_id: str) -> Optional[str]:
+        """WORKER-role credential minted at allocation time; the RPC layer
+        requires it on channel-plane and allocator-private methods
+        (ADVICE r1: those surfaces were previously unauthenticated)."""
+        if self._iam is None:
+            return None
+        from lzy_tpu.iam import WORKER, WORKER_ROLE
+
+        return self._iam.create_subject(f"vm/{vm_id}", kind=WORKER,
+                                        role=WORKER_ROLE)
+
     def _persist(self, vm: Vm) -> None:
         self._store.kv_put("vms", vm.id, vm.to_doc())
         _update_vm_gauge(self.vms())  # every status transition passes here
@@ -284,6 +321,9 @@ class AllocatorService:
             with self._lock:
                 self._vms.pop(vm.id, None)
             self._store.kv_del("vms", vm.id)
+            if self._iam is not None and vm.worker_token:
+                # the credential dies with the VM
+                self._iam.remove_subject(f"vm/{vm.id}")
             _update_vm_gauge(self.vms())
 
     def _find_cached_gang(self, session_id: str, pool_label: str,
@@ -353,12 +393,15 @@ class _AllocateGangAction(OperationRunner):
         _M_ALLOCS.inc(pool=pool_label, source="launch")
 
         gang_id = gen_id("gang")
-        vms = [
-            Vm(id=gen_id("vm"), session_id=session_id, pool_label=pool_label,
-               status=ALLOCATING, gang_id=gang_id, host_index=i,
-               gang_size=gang_size)
-            for i in range(gang_size)
-        ]
+        vms = []
+        for i in range(gang_size):
+            vm_id = gen_id("vm")
+            vms.append(Vm(
+                id=vm_id, session_id=session_id, pool_label=pool_label,
+                status=ALLOCATING, gang_id=gang_id, host_index=i,
+                gang_size=gang_size,
+                worker_token=self.svc._issue_worker_token(vm_id),
+            ))
         with self.svc._lock:
             for vm in vms:
                 self.svc._vms[vm.id] = vm

@@ -49,6 +49,7 @@ class InProcessCluster:
         vm_boot_delay_s: float = 0.0,
         p2p_spill_root: Optional[str] = None,
         with_iam: bool = False,
+        container_runtime="auto",         # forwarded to thread workers
         worker_mode: str = "thread",      # "thread" | "process"
         worker_pythonpath: Optional[str] = None,
         rpc_port: int = 0,                # fixed port lets workers reconnect
@@ -79,20 +80,22 @@ class InProcessCluster:
             self.backend = ThreadVmBackend(
                 self.channels, self.storage_client, self.serializers,
                 launch_delay_s=vm_boot_delay_s, spill_root=p2p_spill_root,
+                container_runtime=container_runtime,
             )
+        self.iam = None
+        if with_iam:
+            from lzy_tpu.iam import IamService
+
+            self.iam = IamService(self.store)
         self.allocator = AllocatorService(
-            self.store, self.executor, self.backend, pools or DEFAULT_POOLS
+            self.store, self.executor, self.backend, pools or DEFAULT_POOLS,
+            iam=self.iam,
         )
         self.backend.allocator = self.allocator
         self.graph_executor = GraphExecutor(
             self.store, self.executor, self.allocator, self.channels,
             max_running_tasks=max_running_tasks, poll_period_s=poll_period_s,
         )
-        self.iam = None
-        if with_iam:
-            from lzy_tpu.iam import IamService
-
-            self.iam = IamService(self.store)
         self.workflow_service = WorkflowService(
             self.store, self.executor, self.allocator, self.channels,
             self.graph_executor, self.storage_client, iam=self.iam,

@@ -52,6 +52,28 @@ class TestIam:
             iam.authenticate(token)
         store.close()
 
+    def test_token_expiry(self, tmp_path):
+        import time
+
+        store = OperationStore(str(tmp_path / "iam.db"))
+        iam = IamService(store, max_token_age_s=0.0)
+        token = iam.create_subject("alice")
+        time.sleep(1.1)  # issued_at has 1 s resolution
+        with pytest.raises(AuthError, match="expired"):
+            iam.authenticate(token)
+        store.close()
+
+    def test_token_rotation_revokes_old_generation(self, tmp_path):
+        store = OperationStore(str(tmp_path / "iam.db"))
+        iam = IamService(store)
+        old = iam.create_subject("alice")
+        assert iam.authenticate(old).id == "alice"
+        new = iam.rotate_subject("alice")
+        with pytest.raises(AuthError, match="revoked"):
+            iam.authenticate(old)
+        assert iam.authenticate(new).id == "alice"
+        store.close()
+
     def test_secret_survives_restart(self, tmp_path):
         store = OperationStore(str(tmp_path / "iam.db"))
         token = IamService(store).create_subject("alice")
@@ -194,3 +216,45 @@ class TestCli:
         result = self.run_cli("executions")
         assert result.returncode == 2
         assert "--db" in result.stderr
+
+
+class TestWorkerTokenRefresh:
+    def test_refresh_past_half_life(self, tmp_path):
+        """Cached/reused VMs outliving the token lifetime get a reissued
+        credential via the heartbeat path instead of aging out."""
+        import time
+
+        from lzy_tpu.durable import OperationsExecutor, OperationStore
+        from lzy_tpu.service.allocator import RUNNING, AllocatorService, Vm
+        from lzy_tpu.service.backends import ThreadVmBackend
+        from lzy_tpu.types import VmSpec
+
+        store = OperationStore(str(tmp_path / "m.db"))
+        executor = OperationsExecutor(store, workers=1)
+        iam = IamService(store, max_token_age_s=1.0)
+        svc = AllocatorService(
+            store, executor, ThreadVmBackend(None, None),
+            [VmSpec(label="cpu", cpu_count=1, ram_gb=1)], iam=iam,
+        )
+        tok = iam.create_subject("vm/vm-1", kind="WORKER", role="WORKER")
+        vm = Vm(id="vm-1", session_id="s", pool_label="cpu", status=RUNNING,
+                gang_id="g", host_index=0, gang_size=1, worker_token=tok)
+        svc._vms[vm.id] = vm
+        assert svc.refresh_worker_token("vm-1") is None  # inside half-life
+        time.sleep(1.1)                                  # past 0.5 * 1.0s
+        fresh = svc.refresh_worker_token("vm-1")
+        assert fresh and fresh != tok
+        assert iam.authenticate(fresh).id == "vm/vm-1"
+        assert svc.vm("vm-1").worker_token == fresh      # persisted
+        executor.shutdown()
+        store.close()
+
+    def test_worker_token_holder_rotation(self):
+        from lzy_tpu.rpc.control import WorkerToken
+
+        t = WorkerToken("old")
+        assert t.accepts("old") and not t.accepts("new") and not t.accepts(None)
+        t.rotate("new")
+        assert t.accepts("new") and t.accepts("old")     # one-rotation grace
+        t.rotate("newer")
+        assert not t.accepts("old")

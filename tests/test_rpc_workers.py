@@ -287,3 +287,59 @@ def test_task_survives_control_plane_reboot_mid_execution(tmp_path):
         from lzy_tpu.core.workflow import LzyWorkflow
 
         LzyWorkflow._active = None
+
+
+def test_worker_plane_requires_worker_token(tmp_path):
+    """ADVICE r1 (medium): with IAM enabled the channel-plane and
+    allocator-private RPCs are worker-only — anonymous peers and mere USER
+    tokens are rejected, while the real worker (holding its allocation-time
+    WORKER token) completes a full graph end to end."""
+    from lzy_tpu.iam import AuthError
+    from lzy_tpu.rpc.core import JsonRpcClient
+
+    c = InProcessCluster(
+        db_path=str(tmp_path / "meta.db"),
+        storage_uri=f"file://{tmp_path}/storage",
+        worker_mode="process",
+        worker_pythonpath=TESTS_DIR,
+        poll_period_s=0.1,
+        with_iam=True,
+    )
+    client = RpcWorkflowClient(c.rpc_server.address)
+    raw = JsonRpcClient(c.rpc_server.address)
+    try:
+        user_token = c.iam.create_subject("alice")
+        storage = DefaultStorageRegistry()
+        storage.register_storage(
+            "default", StorageConfig(uri=c.storage_uri), default=True
+        )
+        from lzy_tpu.core.lzy import Lzy
+
+        lzy = Lzy(
+            runtime=RemoteRuntime(client, user="alice", token=user_token,
+                                  poll_period_s=0.1, stream_logs=False,
+                                  graph_timeout_s=180),
+            storage_registry=storage,
+        )
+        # the full data path works: the worker authenticated every channel
+        # bind / publish / complete and its register/heartbeats with its token
+        with lzy.workflow("iam-proc-wf"):
+            assert int(proc_square(6)) == 36
+
+        # anonymous peer cannot touch the channel plane
+        with pytest.raises(AuthError):
+            raw.call("ChannelFailed", {"entry_id": "x", "error": "evil"})
+        # a USER token is not a worker credential
+        with pytest.raises(AuthError):
+            raw.call("RegisterVm", {"vm_id": "vm-x",
+                                    "endpoint": "127.0.0.1:1",
+                                    "token": user_token})
+        # one VM's token cannot heartbeat for another VM
+        (vm,) = [v for v in c.allocator.vms()]
+        with pytest.raises(AuthError):
+            raw.call("Heartbeat", {"vm_id": "some-other-vm",
+                                   "token": vm.worker_token})
+    finally:
+        raw.close()
+        client.close()
+        c.shutdown()
