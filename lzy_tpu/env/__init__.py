@@ -8,6 +8,12 @@ from lzy_tpu.env.provisioning import (
 )
 from lzy_tpu.env.python_env import AutoPythonEnv, ManualPythonEnv, PythonEnvSpec
 from lzy_tpu.env.container import BaseContainer, DockerContainer, NoContainer
+from lzy_tpu.env.realize import EnvBuildError, EnvRealizer, validate_spec
+from lzy_tpu.env.container_runtime import (
+    ContainerError,
+    DockerRuntime,
+    LocalProcessRuntime,
+)
 
 __all__ = [
     "LzyEnvironment",
@@ -23,4 +29,10 @@ __all__ = [
     "BaseContainer",
     "DockerContainer",
     "NoContainer",
+    "EnvBuildError",
+    "EnvRealizer",
+    "validate_spec",
+    "ContainerError",
+    "DockerRuntime",
+    "LocalProcessRuntime",
 ]

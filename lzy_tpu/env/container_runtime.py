@@ -1,0 +1,183 @@
+"""Container runtimes: how a worker runs an op inside a container image.
+
+Counterpart of the reference's ``DockerEnvironment``
+(``lzy/execution-env/src/main/java/ai/lzy/env/base/DockerEnvironment.java:40`` —
+pull policy, registry credentials, mounted working dirs, exec inside the
+container). The worker stays the host-side control plane; a
+:class:`ContainerRuntime` only has to execute the ``container_exec`` step
+(see ``lzy_tpu/service/container_exec.py``) inside the image with the
+exchange directory mounted.
+
+``DockerRuntime`` builds real ``docker`` command lines (unit-testable
+without a docker daemon via ``exec_fn`` injection). ``LocalProcessRuntime``
+runs the identical exchange protocol in a plain subprocess — the dev/test
+runtime, and the proof that the boundary carries everything the op needs.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+from typing import Callable, Dict, List, Optional
+
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+
+class ContainerError(RuntimeError):
+    pass
+
+
+def container_from_doc(doc: Optional[dict]):
+    if not doc:
+        return None
+    from lzy_tpu.env.container import DockerContainer
+
+    return DockerContainer(**doc)
+
+
+def container_to_doc(container) -> Optional[dict]:
+    import dataclasses
+
+    from lzy_tpu.env.container import DockerContainer, NoContainer
+
+    if container is None or isinstance(container, NoContainer):
+        return None
+    if isinstance(container, DockerContainer):
+        return dataclasses.asdict(container)
+    raise TypeError(f"unsupported container spec {type(container).__name__}")
+
+
+def _package_root() -> str:
+    """Directory that contains the ``lzy_tpu`` package (mounted into the
+    container so container_exec is importable in any image)."""
+    return str(pathlib.Path(__file__).resolve().parents[2])
+
+
+class ContainerRuntime:
+    def run_exec(self, container, exchange_dir: str,
+                 env: Optional[Dict[str, str]] = None,
+                 extra_paths=()) -> int:
+        """``extra_paths``: host dirs with synced user modules the op imports
+        from (mounted + put on PYTHONPATH inside the boundary)."""
+        raise NotImplementedError
+
+
+class DockerRuntime(ContainerRuntime):
+    """Builds ``docker login``/``pull``/``run`` command lines.
+
+    ``exec_fn(argv, env) -> returncode`` is injectable so pod-spec/argv
+    construction is unit-tested without a daemon (MockKuberClientFactory
+    pattern); the default shells out to the docker CLI.
+    """
+
+    def __init__(self, docker: str = "docker",
+                 exec_fn: Optional[Callable[..., int]] = None,
+                 python: str = "python3"):
+        self._docker = docker
+        self._python = python
+        self._exec = exec_fn or self._run_subprocess
+
+    @staticmethod
+    def available(docker: str = "docker") -> bool:
+        return shutil.which(docker) is not None
+
+    def plan(self, container, exchange_dir: str,
+             env: Optional[Dict[str, str]] = None,
+             extra_paths=()) -> List[List[str]]:
+        """The exact command sequence for this op: optional login, optional
+        pull (policy "always"; "if_not_present" lets `docker run` pull), then
+        the exec with the package + exchange + user-module mounts."""
+        image = container.image
+        if container.registry:
+            image = f"{container.registry}/{image}"
+        cmds: List[List[str]] = []
+        if container.username:
+            cmds.append([
+                self._docker, "login",
+                *( [container.registry] if container.registry else [] ),
+                "--username", container.username,
+                "--password-stdin",     # the password never hits argv
+            ])
+        if container.pull_policy == "always":
+            cmds.append([self._docker, "pull", image])
+        run = [
+            self._docker, "run", "--rm",
+            "-v", f"{_package_root()}:/lzy/pkg:ro",
+            "-v", f"{os.path.abspath(exchange_dir)}:/lzy/exchange",
+        ]
+        pythonpath = ["/lzy/pkg"]
+        for i, p in enumerate(extra_paths):
+            run += ["-v", f"{os.path.abspath(p)}:/lzy/mod{i}:ro"]
+            pythonpath.append(f"/lzy/mod{i}")
+        run += ["-e", "PYTHONPATH=" + ":".join(pythonpath)]
+        for k in (env or {}):
+            # name-only -e: docker takes the value from our process env, so
+            # secrets in env_vars never show up in host `ps`
+            run += ["-e", k]
+        run += [image, self._python, "-m", "lzy_tpu.service.container_exec",
+                "/lzy/exchange"]
+        cmds.append(run)
+        return cmds
+
+    def run_exec(self, container, exchange_dir: str,
+                 env: Optional[Dict[str, str]] = None,
+                 extra_paths=()) -> int:
+        child_env = {**os.environ, **(env or {})}
+        rc = 0
+        for argv in self.plan(container, exchange_dir, env, extra_paths):
+            stdin = None
+            if argv[:2] == [self._docker, "login"]:
+                stdin = (container.password or "").encode()
+            rc = self._exec(argv, stdin=stdin, env=child_env)
+            if rc != 0 and argv[:2] != [self._docker, "run"]:
+                raise ContainerError(
+                    f"container setup step failed rc={rc}: {' '.join(argv[:3])}"
+                )
+        return rc
+
+    @staticmethod
+    def _run_subprocess(argv: List[str], stdin: Optional[bytes] = None,
+                        env: Optional[Dict[str, str]] = None) -> int:
+        proc = subprocess.run(argv, input=stdin, env=env)
+        return proc.returncode
+
+
+class LocalProcessRuntime(ContainerRuntime):
+    """Runs the exchange protocol in a local subprocess — no image, same
+    boundary. Keeps container ops testable everywhere and doubles as the
+    'process isolation without docker' mode."""
+
+    def run_exec(self, container, exchange_dir: str,
+                 env: Optional[Dict[str, str]] = None,
+                 extra_paths=()) -> int:
+        child_env = dict(os.environ)
+        child_env.update(env or {})
+        child_env["PYTHONPATH"] = os.pathsep.join(
+            [_package_root(), *map(os.path.abspath, extra_paths)]
+            + ([child_env["PYTHONPATH"]] if child_env.get("PYTHONPATH") else [])
+        )
+        proc = subprocess.run(
+            [sys.executable, "-m", "lzy_tpu.service.container_exec",
+             exchange_dir],
+            env=child_env,
+        )
+        return proc.returncode
+
+
+def default_runtime() -> Optional[ContainerRuntime]:
+    """Pick the runtime for this host: honour LZY_CONTAINER_RUNTIME
+    (docker|local|none), else docker when the CLI exists, else None (ops that
+    require a container fail fast with a clear error)."""
+    choice = os.environ.get("LZY_CONTAINER_RUNTIME", "").lower()
+    if choice == "docker":
+        return DockerRuntime()
+    if choice == "local":
+        return LocalProcessRuntime()
+    if choice == "none":
+        return None
+    return DockerRuntime() if DockerRuntime.available() else None

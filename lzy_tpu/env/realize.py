@@ -1,0 +1,195 @@
+"""Worker-side environment realization.
+
+Counterpart of the reference's ``execution-env`` auxiliary environments
+(``lzy/execution-env/src/main/java/ai/lzy/env/aux/CondaEnvironment.java:67-125``
+installs the captured conda yaml + pip packages before the op runs, failing
+fast on an unbuildable env). TPU-native redesign: instead of a multi-minute
+conda solve on every VM, the worker
+
+1. **diffs** the captured :class:`PythonEnvSpec` against its own interpreter
+   (version + installed distributions);
+2. **overlays** what's missing: ``pip install --target <overlay>`` into a
+   per-spec cached directory that is prepended to ``sys.path`` around the op
+   (a venv-grade isolation without re-resolving the packages the TPU image
+   already bakes in — jax/libtpu stay host-provided);
+3. **fails fast** with :class:`EnvBuildError` at env-build time on a python
+   version conflict or an uninstallable package — not at unpickle time deep
+   inside the op (the silent-mismatch failure mode called out in round 1).
+
+Shared-interpreter (thread) workers cannot safely mutate their own process,
+so they run in *validate* mode: any mismatch is an immediate, attributable
+``EnvBuildError``. ``spec.to_conda_yaml()`` remains the portable fallback
+artifact for environments that do want a full conda build.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+
+class EnvBuildError(RuntimeError):
+    """The captured env cannot be realized on this worker."""
+
+
+def spec_to_doc(spec) -> dict:
+    """Wire form of a PythonEnvSpec (local_module_paths travel separately as
+    module archives)."""
+    return {
+        "python_version": spec.python_version,
+        "packages": [[n, v] for n, v in spec.packages],
+    }
+
+
+def spec_fingerprint(spec_doc: dict) -> str:
+    blob = json.dumps(spec_doc, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def installed_version(name: str) -> Optional[str]:
+    import importlib.metadata as md
+
+    try:
+        return md.version(name)
+    except md.PackageNotFoundError:
+        return None
+
+
+def diff_spec(spec_doc: dict) -> List[Tuple[str, str, Optional[str]]]:
+    """Returns [(name, required_version, installed_version_or_None), ...] for
+    every package whose installed version differs from the requirement.
+    Raises EnvBuildError on an interpreter version mismatch — nothing can be
+    overlaid across python minors."""
+    required_py = spec_doc.get("python_version")
+    have_py = "%d.%d" % sys.version_info[:2]
+    if required_py and required_py != have_py:
+        raise EnvBuildError(
+            f"op requires python {required_py} but the worker runs {have_py}; "
+            f"provision a matching pool or relax the captured env"
+        )
+    mismatched = []
+    for name, version in spec_doc.get("packages", []):
+        have = installed_version(name)
+        if have != version:
+            mismatched.append((name, version, have))
+    return mismatched
+
+
+def validate_spec(spec_doc: dict) -> None:
+    """Shared-interpreter mode: the env must already match; a diff is a
+    build-time failure with a precise message (no overlay can be applied to
+    an interpreter other ops share)."""
+    mismatched = diff_spec(spec_doc)
+    if mismatched:
+        details = ", ".join(
+            f"{n}=={req} (worker has {have or 'nothing'})"
+            for n, req, have in mismatched
+        )
+        raise EnvBuildError(
+            f"op env does not match the shared worker interpreter: {details}; "
+            f"run on an isolated worker (process/pod) to get an overlay, or "
+            f"align the versions"
+        )
+
+
+class EnvRealizer:
+    """Builds and caches pip overlays for isolated workers.
+
+    ``pip_args``: extra pip flags (index URL, ``--find-links`` mirrors, …);
+    defaults to the ``LZY_PIP_ARGS`` env var so deployments configure their
+    mirror without code changes.
+    """
+
+    def __init__(self, root: str, pip_args: Optional[List[str]] = None):
+        self._root = root
+        self._lock = threading.Lock()
+        if pip_args is None:
+            pip_args = os.environ.get("LZY_PIP_ARGS", "").split()
+        self._pip_args = pip_args
+
+    def realize(self, spec_doc: dict) -> Optional[str]:
+        """Returns the overlay dir (None when the env already matches).
+        Idempotent and cached by spec fingerprint; concurrent tasks with the
+        same spec share one build."""
+        mismatched = diff_spec(spec_doc)
+        if not mismatched:
+            return None
+        overlay = os.path.join(self._root, spec_fingerprint(spec_doc))
+        marker = os.path.join(overlay, ".lzy-env-ready")
+        with self._lock:
+            if os.path.exists(marker):
+                return overlay
+            os.makedirs(overlay, exist_ok=True)
+            reqs = [f"{name}=={version}" for name, version, _ in mismatched]
+            _LOG.info("building env overlay %s: %s", overlay, reqs)
+            cmd = [
+                sys.executable, "-m", "pip", "install",
+                "--quiet", "--no-deps", "--target", overlay,
+                *self._pip_args, *reqs,
+            ]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                tail = (proc.stderr or proc.stdout or "").strip()[-2000:]
+                raise EnvBuildError(
+                    f"pip could not build the op env overlay "
+                    f"({' '.join(reqs)}): {tail}"
+                )
+            with open(marker, "w") as f:
+                f.write(json.dumps(spec_doc))
+            return overlay
+
+
+class applied_overlay:
+    """Context manager: make ``overlay`` the highest-priority import source
+    (and visible to subprocesses via PYTHONPATH) for the op's duration."""
+
+    def __init__(self, overlay: Optional[str]):
+        self._overlay = overlay
+        self._old_pythonpath: Optional[str] = None
+
+    def __enter__(self):
+        if self._overlay is None:
+            return self
+        sys.path.insert(0, self._overlay)
+        self._old_pythonpath = os.environ.get("PYTHONPATH")
+        parts = [self._overlay] + (
+            [self._old_pythonpath] if self._old_pythonpath else []
+        )
+        os.environ["PYTHONPATH"] = os.pathsep.join(parts)
+        # modules imported before the overlay existed would shadow it; drop
+        # cached top-levels the overlay provides so the op imports ours
+        for name in list(sys.modules):
+            top = name.split(".")[0]
+            if os.path.isdir(os.path.join(self._overlay, top)) or os.path.isfile(
+                os.path.join(self._overlay, f"{top}.py")
+            ):
+                sys.modules.pop(name, None)
+        return self
+
+    def __exit__(self, *exc):
+        if self._overlay is None:
+            return False
+        try:
+            sys.path.remove(self._overlay)
+        except ValueError:
+            pass
+        if self._old_pythonpath is None:
+            os.environ.pop("PYTHONPATH", None)
+        else:
+            os.environ["PYTHONPATH"] = self._old_pythonpath
+        # evict overlay-imported modules so later ops (different env) resolve
+        # against their own overlays, not this one's cache
+        for name, mod in list(sys.modules.items()):
+            f = getattr(mod, "__file__", None)
+            if f and f.startswith(self._overlay + os.sep):
+                sys.modules.pop(name, None)
+        return False

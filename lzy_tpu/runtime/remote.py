@@ -97,7 +97,7 @@ class RemoteRuntime(Runtime):
         snapshot = workflow.snapshot
         config = workflow.owner.storage_registry.default_config()
         pools = self._client.get_pool_specs()
-        module_cache: Dict[int, List[str]] = {}
+        module_cache: Dict[int, tuple] = {}  # id(env) -> (archives, spec doc)
         tasks: List[TaskDesc] = []
         for call in calls:
             prov = call.env.provisioning or Provisioning()
@@ -108,17 +108,26 @@ class RemoteRuntime(Runtime):
             )
 
             archives: List[str] = []
+            env_doc = None
             if call.env.python_env is not None:
                 key = id(call.env.python_env)
                 if key not in module_cache:
                     from lzy_tpu.env.modules import upload_local_modules
+                    from lzy_tpu.env.realize import spec_to_doc
 
                     spec = call.env.python_env.spec()
-                    module_cache[key] = upload_local_modules(
-                        spec.local_module_paths, snapshot.storage_client,
-                        config.uri,
+                    module_cache[key] = (
+                        upload_local_modules(
+                            spec.local_module_paths, snapshot.storage_client,
+                            config.uri,
+                        ),
+                        spec_to_doc(spec),
                     )
-                archives = module_cache[key]
+                archives, env_doc = module_cache[key]
+
+            from lzy_tpu.env.container_runtime import container_to_doc
+
+            container_doc = container_to_doc(call.env.container)
 
             def ref(eid: str, name: str = "") -> EntryRef:
                 entry = snapshot.get_entry(eid)
@@ -139,6 +148,8 @@ class RemoteRuntime(Runtime):
                 env_vars=dict(call.env.env_vars),
                 std_logs_uri=join_uri(snapshot.storage_prefix, "logs"),
                 module_archives=archives,
+                python_env=env_doc,
+                container=container_doc,
             ))
         return GraphDesc(
             id=gen_id("graph"),

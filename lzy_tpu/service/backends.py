@@ -41,6 +41,7 @@ class ThreadVmBackend(VmBackend):
         heartbeat_period_s: float = 1.0,
         launch_delay_s: float = 0.0,      # simulate boot latency in tests
         spill_root: Optional[str] = None,  # per-VM dirs; enables native p2p
+        container_runtime="auto",          # forwarded to WorkerAgent
     ):
         self._channels = channels
         self._storage = storage_client
@@ -48,6 +49,7 @@ class ThreadVmBackend(VmBackend):
         self._heartbeat_period_s = heartbeat_period_s
         self._launch_delay_s = launch_delay_s
         self._spill_root = spill_root
+        self._container_runtime = container_runtime
         self._agents: Dict[str, WorkerAgent] = {}
         self._lock = threading.Lock()
         self.allocator = None             # wired by the harness after both exist
@@ -75,6 +77,7 @@ class ThreadVmBackend(VmBackend):
                 serializers=self._serializers,
                 heartbeat_period_s=self._heartbeat_period_s,
                 spill_root=spill,
+                container_runtime=self._container_runtime,
             )
             with self._lock:
                 self._agents[vm.id] = agent
@@ -130,6 +133,9 @@ class ProcessVmBackend(VmBackend):
             pypath.append(env["PYTHONPATH"])
         env["PYTHONPATH"] = os.pathsep.join(pypath)
         env.setdefault("JAX_PLATFORMS", "cpu")
+        if vm.worker_token:
+            # via env, not argv: tokens must not show up in `ps`
+            env["LZY_WORKER_TOKEN"] = vm.worker_token
         args = [
             sys.executable, "-m", "lzy_tpu.rpc.worker_main",
             "--control", self._control_address_factory(),

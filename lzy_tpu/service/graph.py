@@ -41,6 +41,11 @@ class TaskDesc:
     env_vars: Dict[str, str] = dataclasses.field(default_factory=dict)
     std_logs_uri: str = ""              # where the worker writes <task>.log
     module_archives: List[str] = dataclasses.field(default_factory=list)
+    # captured PythonEnvSpec wire doc (env/realize.spec_to_doc); the worker
+    # validates or overlays it before running the op
+    python_env: Optional[dict] = None
+    # DockerContainer wire doc; the worker executes the op inside the image
+    container: Optional[dict] = None
 
     @property
     def input_entries(self) -> List[EntryRef]:
@@ -60,6 +65,8 @@ class TaskDesc:
             "env_vars": dict(self.env_vars),
             "std_logs_uri": self.std_logs_uri,
             "module_archives": list(self.module_archives),
+            "python_env": self.python_env,
+            "container": self.container,
         }
 
     @staticmethod
@@ -77,6 +84,8 @@ class TaskDesc:
             env_vars=doc.get("env_vars", {}),
             std_logs_uri=doc.get("std_logs_uri", ""),
             module_archives=doc.get("module_archives", []),
+            python_env=doc.get("python_env"),
+            container=doc.get("container"),
         )
 
 

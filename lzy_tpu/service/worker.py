@@ -94,6 +94,7 @@ class WorkerAgent:
         advertise_host: str = "127.0.0.1", # routable address for p2p peers
         max_heartbeat_failures: Optional[int] = None,
         on_disconnected=None,              # called when the limit is reached
+        container_runtime="auto",          # ContainerRuntime | None | "auto"
     ):
         self.vm_id = vm_id
         self._allocator = allocator
@@ -115,6 +116,13 @@ class WorkerAgent:
                 self._slot_server = SlotServer(spill_root)
         self._max_heartbeat_failures = max_heartbeat_failures
         self._on_disconnected = on_disconnected
+        if container_runtime == "auto":
+            from lzy_tpu.env.container_runtime import default_runtime
+
+            container_runtime = default_runtime()
+        self._container_runtime = container_runtime
+        self._env_realizer = None          # built lazily (isolated mode only)
+        self._env_lock = threading.RLock()
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_period_s,),
             name=f"hb-{vm_id}", daemon=True,
@@ -217,15 +225,15 @@ class WorkerAgent:
         # isolated workers (own interpreter, real remote backends) sync the
         # user's local modules first; in-process thread workers share the
         # client interpreter and skip (startup.py LOCAL_MODULES parity)
+        module_dirs: list = []
         if task.module_archives and os.environ.get("LZY_WORKER_ISOLATED"):
             import tempfile
 
             from lzy_tpu.env.modules import unpack_modules
 
-            unpack_modules(
-                task.module_archives, self._storage,
-                tempfile.mkdtemp(prefix="lzy_modules_"),
-            )
+            dest = tempfile.mkdtemp(prefix="lzy_modules_")
+            unpack_modules(task.module_archives, self._storage, dest)
+            module_dirs.append(dest)
         for ref in task.input_entries:
             self._channels.bind(ref.id, CONSUMER, task.id)
         for ref in task.outputs:
@@ -240,24 +248,127 @@ class WorkerAgent:
                 self._channels.wait_available(out.id, timeout_s=None)
             return
 
-        args = [self._read_entry(ref) for ref in task.args]
-        kwargs = {k: self._read_entry(ref) for k, ref in task.kwargs.items()}
-        func = self._load_func(task.func_uri)
+        # env assembly BEFORE touching inputs: a wrong env must fail here
+        # with an attributable message, not at unpickle time inside the op
+        # (CondaEnvironment.install parity — fail fast on unbuildable envs).
+        # Containerized ops skip it: their deps live in the image, and a
+        # host-side diff would reject envs the container satisfies.
+        overlay = None if task.container else self._prepare_python_env(task)
 
-        from lzy_tpu.utils.env import applied_env_vars
+        import contextlib
 
-        with applied_env_vars(task.env_vars):
-            result = func(*args, **kwargs)
+        # the overlay must cover unpickling too: the pickled func/args (and
+        # the serialized outputs) may reference overlay-installed packages
+        with contextlib.ExitStack() as stack:
+            if overlay is not None:
+                from lzy_tpu.env.realize import applied_overlay
 
-        n_out = len(task.outputs)
-        outputs = result if n_out > 1 and isinstance(result, tuple) else (result,)
-        if len(outputs) != n_out:
-            raise ValueError(
-                f"op {task.name}() returned {len(outputs)} values, declared {n_out}"
+                # overlays rebind process-global import state; one at a time
+                stack.enter_context(self._env_lock)
+                stack.enter_context(applied_overlay(overlay))
+
+            args = [self._read_entry(ref) for ref in task.args]
+            kwargs = {k: self._read_entry(ref)
+                      for k, ref in task.kwargs.items()}
+            func = self._load_func(task.func_uri)
+
+            from lzy_tpu.utils.env import applied_env_vars
+
+            with applied_env_vars(task.env_vars):
+                if task.container:
+                    result = self._run_in_container(
+                        task, func, args, kwargs, extra_paths=module_dirs
+                    )
+                else:
+                    result = func(*args, **kwargs)
+
+            n_out = len(task.outputs)
+            outputs = (result if n_out > 1 and isinstance(result, tuple)
+                       else (result,))
+            if len(outputs) != n_out:
+                raise ValueError(
+                    f"op {task.name}() returned {len(outputs)} values, "
+                    f"declared {n_out}"
+                )
+            for ref, value in zip(task.outputs, outputs):
+                self._write_entry(ref, value)
+                self._channels.transfer_completed(ref.id)
+
+    # -- environment assembly (execution-env parity) ---------------------------
+
+    def _prepare_python_env(self, task: TaskDesc):
+        """Returns an overlay dir to apply around the op, or None.
+
+        Isolated workers (own interpreter) build a pip overlay for the diff;
+        shared-interpreter thread workers cannot mutate the process other ops
+        share, so they validate and fail fast on any mismatch."""
+        if not task.python_env:
+            return None
+        from lzy_tpu.env.realize import EnvRealizer, validate_spec
+
+        if not os.environ.get("LZY_WORKER_ISOLATED"):
+            validate_spec(task.python_env)
+            return None
+        with self._env_lock:
+            if self._env_realizer is None:
+                import tempfile
+
+                root = (os.path.join(self._spill_root, "envs")
+                        if self._spill_root
+                        else tempfile.mkdtemp(prefix="lzy_envs_"))
+                self._env_realizer = EnvRealizer(root)
+        return self._env_realizer.realize(task.python_env)
+
+    def _run_in_container(self, task: TaskDesc, func, args, kwargs,
+                          extra_paths=()):
+        """Execute the op inside its image via the exchange-dir protocol
+        (DockerEnvironment parity); channels/storage stay host-side.
+        ``extra_paths``: synced user-module dirs the image must import from."""
+        import tempfile
+
+        import cloudpickle
+
+        from lzy_tpu.env.container_runtime import (
+            ContainerError,
+            container_from_doc,
+        )
+        from lzy_tpu.service import container_exec as ce
+
+        if self._container_runtime is None:
+            raise ContainerError(
+                f"op {task.name} requires container image "
+                f"{task.container.get('image')!r} but this worker has no "
+                f"container runtime (set LZY_CONTAINER_RUNTIME or install "
+                f"docker)"
             )
-        for ref, value in zip(task.outputs, outputs):
-            self._write_entry(ref, value)
-            self._channels.transfer_completed(ref.id)
+        container = container_from_doc(task.container)
+        exchange = tempfile.mkdtemp(prefix=f"lzy_ctr_{task.id}_")
+        try:
+            with open(os.path.join(exchange, ce.PAYLOAD), "wb") as f:
+                cloudpickle.dump(
+                    {"func": func, "args": args, "kwargs": kwargs}, f
+                )
+            rc = self._container_runtime.run_exec(
+                container, exchange, env=dict(task.env_vars),
+                extra_paths=tuple(extra_paths),
+            )
+            error_path = os.path.join(exchange, ce.ERROR)
+            if os.path.exists(error_path):
+                with open(error_path, "rb") as f:
+                    raise pickle.load(f)
+            result_path = os.path.join(exchange, ce.RESULT)
+            if rc != 0 or not os.path.exists(result_path):
+                raise ContainerError(
+                    f"container exec for op {task.name} exited rc={rc} "
+                    f"without a result"
+                )
+            with open(result_path, "rb") as f:
+                return pickle.load(f)
+        finally:
+            # pickled args/results can be huge; never let exchanges pile up
+            import shutil
+
+            shutil.rmtree(exchange, ignore_errors=True)
 
     # -- data plane (startup.py read_data/write_data parity) -------------------
 

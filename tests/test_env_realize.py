@@ -1,0 +1,289 @@
+"""Worker-side environment realization: spec diff/validation, pip overlays
+built from the captured spec, fail-fast conflicts, and container execution
+(reference execution-env parity: ``CondaEnvironment.java:67-125`` installs the
+captured env before the op; ``DockerEnvironment.java:40`` runs it in-image)."""
+
+import os
+import pathlib
+import sys
+import zipfile
+
+import pytest
+
+from lzy_tpu import op
+from lzy_tpu.core.workflow import RemoteCallError
+from lzy_tpu.env import (
+    DockerContainer,
+    EnvBuildError,
+    EnvRealizer,
+    LocalProcessRuntime,
+    ManualPythonEnv,
+)
+from lzy_tpu.env.realize import applied_overlay, diff_spec, validate_spec
+from lzy_tpu.service import InProcessCluster
+
+TESTS_DIR = str(pathlib.Path(__file__).parent)
+PY_VERSION = "%d.%d" % sys.version_info[:2]
+
+
+def make_wheel(directory, name: str, version: str, body: str) -> str:
+    """Handmade minimal wheel so pip can install fully offline
+    (``--no-index --find-links``)."""
+    mod = name.replace("-", "_")
+    path = os.path.join(directory, f"{mod}-{version}-py3-none-any.whl")
+    dist_info = f"{mod}-{version}.dist-info"
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr(f"{mod}/__init__.py", body)
+        z.writestr(
+            f"{dist_info}/METADATA",
+            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n",
+        )
+        z.writestr(
+            f"{dist_info}/WHEEL",
+            "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: true\n"
+            "Tag: py3-none-any\n",
+        )
+        z.writestr(
+            f"{dist_info}/RECORD",
+            f"{mod}/__init__.py,,\n{dist_info}/METADATA,,\n"
+            f"{dist_info}/WHEEL,,\n{dist_info}/RECORD,,\n",
+        )
+    return path
+
+
+class TestSpecDiff:
+    def test_matching_env_is_empty_diff(self):
+        import pytest as _pytest  # an installed dist we know the version of
+
+        doc = {"python_version": PY_VERSION,
+               "packages": [["pytest", _pytest.__version__]]}
+        assert diff_spec(doc) == []
+        validate_spec(doc)  # no raise
+
+    def test_python_version_conflict_fails_fast(self):
+        doc = {"python_version": "2.7", "packages": []}
+        with pytest.raises(EnvBuildError, match="requires python 2.7"):
+            diff_spec(doc)
+
+    def test_package_mismatch_is_reported_precisely(self):
+        doc = {"python_version": PY_VERSION,
+               "packages": [["lzy-no-such-pkg", "1.0"]]}
+        assert diff_spec(doc) == [("lzy-no-such-pkg", "1.0", None)]
+        with pytest.raises(EnvBuildError,
+                           match=r"lzy-no-such-pkg==1.0 \(worker has nothing\)"):
+            validate_spec(doc)
+
+
+class TestOverlay:
+    def test_realize_installs_into_cached_overlay(self, tmp_path):
+        wheels = tmp_path / "wheels"
+        wheels.mkdir()
+        make_wheel(str(wheels), "lzy-testpkg", "2.0", "VALUE = '2.0'\n")
+        realizer = EnvRealizer(
+            str(tmp_path / "envs"),
+            pip_args=["--no-index", "--find-links", str(wheels)],
+        )
+        doc = {"python_version": PY_VERSION,
+               "packages": [["lzy-testpkg", "2.0"]]}
+        overlay = realizer.realize(doc)
+        assert overlay and os.path.isdir(os.path.join(overlay, "lzy_testpkg"))
+        # cached: second call returns the same dir without re-running pip
+        assert realizer.realize(doc) == overlay
+
+        with applied_overlay(overlay):
+            import lzy_testpkg
+
+            assert lzy_testpkg.VALUE == "2.0"
+        # overlay modules do not leak past the context
+        assert "lzy_testpkg" not in sys.modules
+        with pytest.raises(ImportError):
+            import lzy_testpkg  # noqa: F401, F811
+
+    def test_unbuildable_env_raises(self, tmp_path):
+        realizer = EnvRealizer(
+            str(tmp_path / "envs"),
+            pip_args=["--no-index", "--find-links", str(tmp_path)],
+        )
+        doc = {"python_version": PY_VERSION,
+               "packages": [["lzy-testpkg", "9.9"]]}
+        with pytest.raises(EnvBuildError, match="pip could not build"):
+            realizer.realize(doc)
+
+
+# module-level ops: worker processes resolve them by reference
+@op
+def read_testpkg_value() -> str:
+    import lzy_testpkg
+
+    return lzy_testpkg.VALUE
+
+
+@op
+def trivial_add(a: int, b: int) -> int:
+    return a + b
+
+
+def _pinned_env(version: str) -> ManualPythonEnv:
+    return ManualPythonEnv(python_version=PY_VERSION,
+                           packages={"lzy-testpkg": version})
+
+
+class TestWorkerEnvRealization:
+    def test_op_with_differently_pinned_package_passes(self, tmp_path,
+                                                       monkeypatch):
+        """The op needs lzy-testpkg==2.0, which the control plane does not
+        have at all: the isolated worker builds the overlay and the op runs —
+        the round-1 gap (captured env was decorative) closed."""
+        wheels = tmp_path / "wheels"
+        wheels.mkdir()
+        make_wheel(str(wheels), "lzy-testpkg", "2.0", "VALUE = '2.0'\n")
+        monkeypatch.setenv(
+            "LZY_PIP_ARGS", f"--no-index --find-links {wheels}"
+        )
+        c = InProcessCluster(
+            db_path=str(tmp_path / "meta.db"),
+            storage_uri=f"file://{tmp_path}/storage",
+            worker_mode="process",
+            worker_pythonpath=TESTS_DIR,
+            poll_period_s=0.1,
+        )
+        try:
+            lzy = c.lzy()
+            with lzy.workflow("env-overlay-wf"):
+                r = read_testpkg_value.with_python_env(_pinned_env("2.0"))()
+                assert str(r) == "2.0"
+        finally:
+            c.shutdown()
+
+    def test_env_conflict_fails_at_build_time(self, tmp_path, monkeypatch):
+        """An uninstallable pin fails in env assembly with a pip message —
+        before inputs are read or the function unpickled."""
+        monkeypatch.setenv(
+            "LZY_PIP_ARGS", f"--no-index --find-links {tmp_path}"
+        )
+        c = InProcessCluster(
+            db_path=str(tmp_path / "meta.db"),
+            storage_uri=f"file://{tmp_path}/storage",
+            worker_mode="process",
+            worker_pythonpath=TESTS_DIR,
+            poll_period_s=0.1,
+        )
+        try:
+            lzy = c.lzy()
+            with pytest.raises(RemoteCallError) as exc_info:
+                with lzy.workflow("env-conflict-wf"):
+                    r = read_testpkg_value.with_python_env(_pinned_env("9.9"))()
+                    _ = str(r)
+            assert "pip could not build" in repr(exc_info.value.__cause__)
+        finally:
+            c.shutdown()
+
+    def test_shared_worker_validates_and_fails_fast(self, tmp_path):
+        """Thread (shared-interpreter) workers cannot overlay; a mismatch is
+        an immediate, attributable error instead of an unpickle-time one."""
+        c = InProcessCluster(db_path=str(tmp_path / "meta.db"))
+        try:
+            lzy = c.lzy()
+            with pytest.raises(RemoteCallError) as exc_info:
+                with lzy.workflow("env-validate-wf"):
+                    r = trivial_add.with_python_env(_pinned_env("2.0"))(1, 2)
+                    _ = int(r)
+            assert "does not match the shared worker" in repr(
+                exc_info.value.__cause__
+            )
+        finally:
+            c.shutdown()
+
+
+@op
+def containerized_square(x: int) -> int:
+    return x * x
+
+
+class TestContainerExecution:
+    def test_docker_argv_construction(self, tmp_path):
+        from lzy_tpu.env import DockerRuntime
+
+        calls = []
+        rt = DockerRuntime(exec_fn=lambda argv, stdin=None, env=None:
+                           calls.append((argv, stdin, env)) or 0)
+        spec = DockerContainer(image="tpu-train:1.2", registry="eu.gcr.io/p",
+                               pull_policy="always", username="bot",
+                               password="hunter2")
+        mod_dir = str(tmp_path / "mods")
+        plan = rt.plan(spec, str(tmp_path), env={"HF_TOKEN": "secret"},
+                       extra_paths=[mod_dir])
+        assert plan[0][:2] == ["docker", "login"]
+        assert "--password-stdin" in plan[0] and "hunter2" not in " ".join(
+            plan[0]
+        )
+        assert plan[1] == ["docker", "pull", "eu.gcr.io/p/tpu-train:1.2"]
+        run = plan[2]
+        assert run[:3] == ["docker", "run", "--rm"]
+        assert f"{os.path.abspath(tmp_path)}:/lzy/exchange" in run
+        assert f"{os.path.abspath(mod_dir)}:/lzy/mod0:ro" in run
+        assert "PYTHONPATH=/lzy/pkg:/lzy/mod0" in run
+        assert "eu.gcr.io/p/tpu-train:1.2" in run
+        assert run[-1] == "/lzy/exchange"
+        # env var by NAME only: the secret value must never hit argv
+        assert "HF_TOKEN" in run and "secret" not in " ".join(run)
+
+        rt.run_exec(spec, str(tmp_path), env={"HF_TOKEN": "secret"})
+        assert [c[0][1] for c in calls] == ["login", "pull", "run"]
+        assert calls[0][1] == b"hunter2"   # password via stdin, not argv
+        assert calls[2][2]["HF_TOKEN"] == "secret"  # value via process env
+
+    def test_op_runs_through_container_boundary(self, tmp_path):
+        """End-to-end through the exchange-dir protocol with the local
+        process runtime: same boundary as docker, no daemon needed."""
+        c = InProcessCluster(db_path=str(tmp_path / "meta.db"),
+                             storage_uri=f"file://{tmp_path}/storage",
+                             container_runtime=LocalProcessRuntime())
+        try:
+            lzy = c.lzy()
+            with lzy.workflow("container-wf"):
+                r = containerized_square.with_container(
+                    DockerContainer(image="whatever:latest")
+                )(7)
+                assert int(r) == 49
+        finally:
+            c.shutdown()
+
+    def test_container_exception_crosses_boundary(self, tmp_path):
+        @op
+        def boom() -> int:
+            raise ValueError("exploded in container")
+
+        c = InProcessCluster(db_path=str(tmp_path / "meta.db"),
+                             storage_uri=f"file://{tmp_path}/storage",
+                             container_runtime=LocalProcessRuntime())
+        try:
+            lzy = c.lzy()
+            with pytest.raises(RemoteCallError) as exc_info:
+                with lzy.workflow("container-boom-wf"):
+                    r = boom.with_container(
+                        DockerContainer(image="whatever:latest")
+                    )()
+                    _ = int(r)
+            cause = exc_info.value.__cause__
+            assert isinstance(cause, ValueError)
+            assert any("container traceback" in n
+                       for n in getattr(cause, "__notes__", []))
+        finally:
+            c.shutdown()
+
+    def test_missing_runtime_is_a_clear_error(self, tmp_path):
+        c = InProcessCluster(db_path=str(tmp_path / "meta.db"),
+                             storage_uri=f"file://{tmp_path}/storage",
+                             container_runtime=None)
+        try:
+            lzy = c.lzy()
+            with pytest.raises(RemoteCallError) as exc_info:
+                with lzy.workflow("container-none-wf"):
+                    r = containerized_square.with_container(
+                        DockerContainer(image="whatever:latest")
+                    )(3)
+                    _ = int(r)
+            assert "no container runtime" in repr(exc_info.value.__cause__)
+        finally:
+            c.shutdown()
