@@ -48,7 +48,14 @@ def container_to_doc(container) -> Optional[dict]:
     if container is None or isinstance(container, NoContainer):
         return None
     if isinstance(container, DockerContainer):
-        return dataclasses.asdict(container)
+        doc = dataclasses.asdict(container)
+        # registry credentials NEVER travel in task docs: the wire doc is
+        # persisted in the durable metadata store and crosses the control
+        # plane in plaintext. Workers resolve credentials locally
+        # (LZY_REGISTRY_USERNAME/PASSWORD or a pre-configured docker login).
+        doc.pop("username", None)
+        doc.pop("password", None)
+        return doc
     raise TypeError(f"unsupported container spec {type(container).__name__}")
 
 
@@ -96,11 +103,18 @@ class DockerRuntime(ContainerRuntime):
         if container.registry:
             image = f"{container.registry}/{image}"
         cmds: List[List[str]] = []
-        if container.username:
+        username = container.username or os.environ.get(
+            "LZY_REGISTRY_USERNAME"
+        )
+        if username:
+            # docker keys credentials by registry HOST: a registry value like
+            # "eu.gcr.io/project" must be logged in as "eu.gcr.io" or pulls
+            # will not find the auth
+            registry_host = (container.registry or "").split("/")[0]
             cmds.append([
                 self._docker, "login",
-                *( [container.registry] if container.registry else [] ),
-                "--username", container.username,
+                *( [registry_host] if registry_host else [] ),
+                "--username", username,
                 "--password-stdin",     # the password never hits argv
             ])
         if container.pull_policy == "always":
@@ -132,7 +146,10 @@ class DockerRuntime(ContainerRuntime):
         for argv in self.plan(container, exchange_dir, env, extra_paths):
             stdin = None
             if argv[:2] == [self._docker, "login"]:
-                stdin = (container.password or "").encode()
+                password = container.password or os.environ.get(
+                    "LZY_REGISTRY_PASSWORD", ""
+                )
+                stdin = password.encode()
             rc = self._exec(argv, stdin=stdin, env=child_env)
             if rc != 0 and argv[:2] != [self._docker, "run"]:
                 raise ContainerError(

@@ -41,6 +41,20 @@ class EnvBuildError(RuntimeError):
     """The captured env cannot be realized on this worker."""
 
 
+# Accelerator-stack packages that must stay host-provided: the worker image's
+# jax/jaxlib are matched to its libtpu/PJRT plugin, and overlaying a client's
+# pinned version would shadow the working stack (or fail on an air-gapped
+# pod). AutoPythonEnv captures them because this library imports jax, so the
+# realizer skips them instead of diffing them.
+HOST_PROVIDED = frozenset({
+    "jax", "jaxlib", "libtpu", "libtpu-nightly", "lzy-tpu", "lzy_tpu",
+})
+
+
+def _norm(name: str) -> str:
+    return name.lower().replace("_", "-")
+
+
 def spec_to_doc(spec) -> dict:
     """Wire form of a PythonEnvSpec (local_module_paths travel separately as
     module archives)."""
@@ -64,11 +78,14 @@ def installed_version(name: str) -> Optional[str]:
         return None
 
 
-def diff_spec(spec_doc: dict) -> List[Tuple[str, str, Optional[str]]]:
+def diff_spec(spec_doc: dict,
+              host_provided: frozenset = HOST_PROVIDED,
+              ) -> List[Tuple[str, str, Optional[str]]]:
     """Returns [(name, required_version, installed_version_or_None), ...] for
     every package whose installed version differs from the requirement.
     Raises EnvBuildError on an interpreter version mismatch — nothing can be
-    overlaid across python minors."""
+    overlaid across python minors. ``host_provided`` packages are excluded
+    from the diff (see HOST_PROVIDED)."""
     required_py = spec_doc.get("python_version")
     have_py = "%d.%d" % sys.version_info[:2]
     if required_py and required_py != have_py:
@@ -76,8 +93,11 @@ def diff_spec(spec_doc: dict) -> List[Tuple[str, str, Optional[str]]]:
             f"op requires python {required_py} but the worker runs {have_py}; "
             f"provision a matching pool or relax the captured env"
         )
+    skip = {_norm(n) for n in host_provided}
     mismatched = []
     for name, version in spec_doc.get("packages", []):
+        if _norm(name) in skip:
+            continue
         have = installed_version(name)
         if have != version:
             mismatched.append((name, version, have))

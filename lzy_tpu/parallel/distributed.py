@@ -68,6 +68,13 @@ def initialize_gang(coordinator_address: Optional[str] = None) -> dict:
             )
         return {"rank": rank, "size": size, "initialized": True}
 
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # cross-process collectives on the CPU backend ride gloo; harmless
+        # if this jax already defaults to it
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
     jax.distributed.initialize(
         coordinator_address=target,
         num_processes=size,

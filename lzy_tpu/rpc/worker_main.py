@@ -14,6 +14,24 @@ import argparse
 import os
 import threading
 
+
+def _apply_platform_contract() -> None:
+    """Honor the backend's JAX_PLATFORMS env contract at the jax-config
+    level: a site customization may have registered a pinned platform plugin
+    that env vars alone cannot override (same recipe as tests/conftest.py),
+    which would otherwise break CPU workers — and hang
+    ``jax.distributed.initialize`` for SPMD gangs. Must run before the first
+    backend query; a no-op when the env var is unset (real TPU pods)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    except Exception:
+        pass
+
 from lzy_tpu.rpc.control import RpcAllocatorClient, RpcChannelsClient
 from lzy_tpu.rpc.core import JsonRpcClient, JsonRpcServer
 from lzy_tpu.service.graph import TaskDesc
@@ -38,6 +56,7 @@ def main(argv=None) -> None:
              "multi-host deployments)")
     args = parser.parse_args(argv)
 
+    _apply_platform_contract()
     os.environ.setdefault("LZY_WORKER_ISOLATED", "1")  # sync user modules
 
     # WORKER-role IAM token minted by the allocator at launch (env, never

@@ -25,6 +25,8 @@ import traceback
 PAYLOAD = "payload.pkl"
 RESULT = "result.pkl"
 ERROR = "error.pkl"
+ERROR_TEXT = "error.txt"   # traceback as text, for when error.pkl references
+                           # classes only the image has
 
 
 def main(argv=None) -> int:
@@ -54,6 +56,8 @@ def main(argv=None) -> int:
             blob = cloudpickle.dumps(RuntimeError(f"{e!r} (unpicklable)\n{tb}"))
         with open(os.path.join(exchange, ERROR), "wb") as f:
             f.write(blob)
+        with open(os.path.join(exchange, ERROR_TEXT), "w") as f:
+            f.write(f"{e!r}\n{tb}")
         return 1
     with open(os.path.join(exchange, RESULT), "wb") as f:
         cloudpickle.dump(result, f)

@@ -10,12 +10,14 @@ op, write outputs/exception, pump stdout/stderr to the log plane. The
 TPU-first notes:
 - inputs take the device-residency fast path when the value is already in HBM
   on this slice (ICI), falling back to the durable storage peer;
-- a gang task runs SPMD: every host executes the same program. Under the
-  in-process thread backend only host 0 executes the function body (one
-  process = one JAX runtime; the program would collide with itself), while
-  ranks>0 participate in the gang barrier — control-plane semantics stay
-  identical, and real multi-host SPMD execution is exercised via the GKE-style
-  backend and the multichip dryrun (``__graft_entry__.dryrun_multichip``).
+- a gang task runs SPMD: every host executes the same program. Isolated
+  workers (process/pod, ``LZY_WORKER_ISOLATED``) run the full body on every
+  rank — each joins the mesh via ``lzy_tpu.parallel.initialize_gang`` and
+  rank 0 alone publishes outputs (proven end to end by
+  ``tests/test_rpc_workers.py::test_multihost_spmd_psum_across_worker_processes``,
+  a real cross-process collective). Under the in-process thread backend only
+  host 0 executes the body (one process = one JAX runtime; the program would
+  collide with itself) while ranks>0 participate in the gang barrier.
 """
 
 from __future__ import annotations
@@ -193,7 +195,7 @@ class WorkerAgent:
              gang: Dict[str, Any]) -> None:
         _StdRouter.install()
         log_buf = io.StringIO()
-        token_route = _StdRouter._route.set(log_buf if gang_rank == 0 else None)
+        token_route = _StdRouter._route.set(log_buf)
         token_gang = _GANG.set({"rank": gang_rank, "size": task.gang_size, **gang})
         try:
             with logging_context(task=task.id, vm=self.vm_id, rank=str(gang_rank)):
@@ -218,8 +220,9 @@ class WorkerAgent:
         finally:
             _GANG.reset(token_gang)
             _StdRouter._route.reset(token_route)
-            if gang_rank == 0:
-                self._flush_logs(task, log_buf.getvalue())
+            # every rank's output reaches the log plane (isolated gang ranks
+            # run the full SPMD body; a rank-3 crash must be diagnosable)
+            self._flush_logs(task, log_buf.getvalue(), rank=gang_rank)
 
     def _execute_task(self, task: TaskDesc, gang_rank: int) -> None:
         # isolated workers (own interpreter, real remote backends) sync the
@@ -239,9 +242,13 @@ class WorkerAgent:
         for ref in task.outputs:
             self._channels.bind(ref.id, PRODUCER, task.id)
 
-        if gang_rank != 0:
-            # non-zero ranks of an in-process gang: wait for host 0's outputs
-            # (real multi-host backends run the SPMD program here instead).
+        isolated = bool(os.environ.get("LZY_WORKER_ISOLATED"))
+        if gang_rank != 0 and not isolated:
+            # non-zero ranks of an IN-PROCESS gang: one process = one JAX
+            # runtime, so only host 0 can run the program; the others wait
+            # for its outputs. Isolated (process/pod) gang workers fall
+            # through and execute the full SPMD body below instead — every
+            # host runs the same program, ranks join via initialize_gang().
             # No timeout: a healthy training op can run for hours; the graph
             # deadline is the backstop.
             for out in task.outputs:
@@ -281,6 +288,11 @@ class WorkerAgent:
                     )
                 else:
                     result = func(*args, **kwargs)
+
+            if gang_rank != 0:
+                # SPMD convention (reference worker + jax multi-host alike):
+                # every host computes, host 0 alone publishes the outputs
+                return
 
             n_out = len(task.outputs)
             outputs = (result if n_out > 1 and isinstance(result, tuple)
@@ -354,8 +366,23 @@ class WorkerAgent:
             )
             error_path = os.path.join(exchange, ce.ERROR)
             if os.path.exists(error_path):
-                with open(error_path, "rb") as f:
-                    raise pickle.load(f)
+                try:
+                    with open(error_path, "rb") as f:
+                        exc = pickle.load(f)
+                except Exception:
+                    # the exception class lives in an image-only package;
+                    # fall back to the textual traceback so the real failure
+                    # is never masked by a host-side ModuleNotFoundError
+                    text_path = os.path.join(exchange, ce.ERROR_TEXT)
+                    detail = ""
+                    if os.path.exists(text_path):
+                        with open(text_path) as f:
+                            detail = f.read()
+                    raise ContainerError(
+                        f"op {task.name} failed in container "
+                        f"(exception class not importable on host):\n{detail}"
+                    )
+                raise exc
             result_path = os.path.join(exchange, ce.RESULT)
             if rc != 0 or not os.path.exists(result_path):
                 raise ContainerError(
@@ -461,10 +488,11 @@ class WorkerAgent:
         self._storage.write_bytes(task.exception.uri, payload)
         return task.exception.uri
 
-    def _flush_logs(self, task: TaskDesc, text: str) -> None:
+    def _flush_logs(self, task: TaskDesc, text: str, rank: int = 0) -> None:
         if not text or not task.std_logs_uri:
             return
-        uri = join_uri(task.std_logs_uri, f"{task.id}.log")
+        name = f"{task.id}.log" if rank == 0 else f"{task.id}.r{rank}.log"
+        uri = join_uri(task.std_logs_uri, name)
         try:
             self._storage.write_bytes(uri, text.encode("utf-8"))
         except Exception:

@@ -214,6 +214,9 @@ class TestContainerExecution:
         plan = rt.plan(spec, str(tmp_path), env={"HF_TOKEN": "secret"},
                        extra_paths=[mod_dir])
         assert plan[0][:2] == ["docker", "login"]
+        # login targets the registry HOST (docker keys auth by host, not by
+        # the image-path prefix) and the password never hits argv
+        assert "eu.gcr.io" in plan[0] and "eu.gcr.io/p" not in plan[0]
         assert "--password-stdin" in plan[0] and "hunter2" not in " ".join(
             plan[0]
         )
@@ -287,3 +290,26 @@ class TestContainerExecution:
             assert "no container runtime" in repr(exc_info.value.__cause__)
         finally:
             c.shutdown()
+
+
+class TestHostProvidedAndCredHygiene:
+    def test_accelerator_stack_is_never_overlaid(self):
+        import jax
+
+        doc = {"python_version": PY_VERSION,
+               "packages": [["jax", "0.0.1"], ["jaxlib", "0.0.1"],
+                            ["libtpu", "0.0.1"]]}
+        # version drift in host-provided packages is ignored, not a conflict
+        assert diff_spec(doc) == []
+        validate_spec(doc)
+        assert jax.__version__ != "0.0.1"  # really would have mismatched
+
+    def test_registry_credentials_never_enter_task_docs(self):
+        from lzy_tpu.env.container_runtime import container_to_doc
+
+        doc = container_to_doc(DockerContainer(
+            image="x:1", registry="eu.gcr.io/p", username="bot",
+            password="hunter2",
+        ))
+        assert "password" not in doc and "username" not in doc
+        assert doc["image"] == "x:1" and doc["registry"] == "eu.gcr.io/p"
