@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 from lzy_tpu.channels.manager import ChannelManager
 from lzy_tpu.serialization import SerializerRegistry
@@ -166,24 +166,152 @@ class ProcessVmBackend(VmBackend):
 
 
 class GkeTpuBackend(VmBackend):
-    """Cloud path: one Vm record = one TPU host pod in a slice node pool."""
+    """Cloud path: one Vm record = one TPU host pod in a slice node pool.
 
-    def __init__(self, *, namespace: str = "lzy-tpu", image: str = ""):
-        try:
-            import kubernetes  # type: ignore # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "GkeTpuBackend requires the kubernetes python client, which is "
-                "not installed in this environment; use ThreadVmBackend"
-            ) from e
+    Mirrors ``KuberVmAllocator.allocate``
+    (``lzy/allocator/.../kuber/KuberVmAllocator.java:84-197``): build the pod
+    spec, create it through the k8s API (idempotent on 409 so durable-op
+    resume never double-provisions), delete on destroy (404 tolerated), and
+    reconcile leaked pods by label. The worker env/arg contract matches
+    ``PodSpecBuilder.java:91-150``: the pod runs ``lzy_tpu.rpc.worker_main``
+    with the control-plane address, VM id, storage, and (secret-mounted
+    via env) the VM's WORKER token; registration/heartbeat then proceed
+    exactly as for process workers.
+    """
+
+    def __init__(self, *, control_address: str, storage_uri: str,
+                 image: str, namespace: str = "lzy-tpu",
+                 api=None, service_account: Optional[str] = None,
+                 spill_dir: str = "/tmp/lzy-spill"):
+        from lzy_tpu.service.kube import KubeApi, KubernetesKubeApi
+
+        self._api: "KubeApi" = api or KubernetesKubeApi()
         self._namespace = namespace
         self._image = image
+        self._control_address = control_address
+        self._storage_uri = storage_uri
+        self._service_account = service_account
+        self._spill_dir = spill_dir
+        self.allocator = None
 
-    def launch(self, vm: Vm, pool: PoolSpec) -> None:  # pragma: no cover
-        raise NotImplementedError(
-            "GKE pod-slice provisioning is wired in a cloud deployment; "
-            "see SURVEY.md §7 step 3"
-        )
+    @staticmethod
+    def pod_name(vm: Vm) -> str:
+        return f"lzy-{vm.id}".lower().replace("_", "-")
 
-    def destroy(self, vm: Vm) -> None:  # pragma: no cover
-        raise NotImplementedError
+    def build_pod_manifest(self, vm: Vm, pool: PoolSpec) -> dict:
+        from lzy_tpu.service.kube import GKE_TPU_ACCELERATOR
+        from lzy_tpu.types import TpuPoolSpec, _CHIPS_PER_HOST
+
+        is_tpu = isinstance(pool, TpuPoolSpec) and pool.tpu_type
+        env = [
+            {"name": "LZY_WORKER_ADVERTISE_HOST",
+             "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}}},
+        ]
+        if vm.worker_token:
+            env.append({"name": "LZY_WORKER_TOKEN",
+                        "value": vm.worker_token})
+        container = {
+            "name": "worker",
+            "image": self._image,
+            "args": [
+                "python", "-m", "lzy_tpu.rpc.worker_main",
+                "--control", self._control_address,
+                "--vm-id", vm.id,
+                "--storage-uri", self._storage_uri,
+                "--spill-root", f"{self._spill_dir}/{vm.id}",
+                "--port", "18900",
+            ],
+            "env": env,
+            "ports": [{"containerPort": 18900, "name": "worker-api"}],
+        }
+        spec: dict = {"containers": [container], "restartPolicy": "Never"}
+        if self._service_account:
+            spec["serviceAccountName"] = self._service_account
+        if is_tpu:
+            chips = _CHIPS_PER_HOST[pool.tpu_type]
+            spec["nodeSelector"] = {
+                "cloud.google.com/gke-tpu-accelerator":
+                    GKE_TPU_ACCELERATOR[pool.tpu_type],
+                "cloud.google.com/gke-tpu-topology": pool.topology,
+            }
+            container["resources"] = {
+                "requests": {"google.com/tpu": str(chips)},
+                "limits": {"google.com/tpu": str(chips)},
+            }
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": self.pod_name(vm),
+                "labels": {
+                    "lzy/vm-id": vm.id,
+                    "lzy/gang-id": vm.gang_id,
+                    "lzy/session-id": vm.session_id,
+                    "lzy/host-index": str(vm.host_index),
+                    "app.kubernetes.io/managed-by": "lzy-tpu",
+                },
+            },
+            "spec": spec,
+        }
+
+    def launch(self, vm: Vm, pool: PoolSpec) -> None:
+        from lzy_tpu.service.kube import KubeConflict, KubeNotFound
+
+        manifest = self.build_pod_manifest(vm, pool)
+        try:
+            self._api.create_pod(self._namespace, manifest)
+        except KubeConflict:
+            # durable-op resume re-requests hosts already created — but only
+            # a live pod counts: one that already terminated (ImagePull
+            # failure, crashed worker; restartPolicy=Never) would stall the
+            # gang until the task deadline, so recreate it
+            # (KuberVmAllocator inspects the existing pod the same way)
+            phase = self._pod_phase(vm)
+            if phase in ("Failed", "Succeeded"):
+                _LOG.warning("pod %s exists but is %s; recreating",
+                             self.pod_name(vm), phase)
+                try:
+                    self._api.delete_pod(self._namespace, self.pod_name(vm))
+                except KubeNotFound:
+                    pass
+                self._api.create_pod(self._namespace, manifest)
+            else:
+                _LOG.info("pod %s already exists (%s); resume",
+                          self.pod_name(vm), phase or "phase unknown")
+
+    def _pod_phase(self, vm: Vm) -> Optional[str]:
+        for manifest in self._api.list_pods(
+            self._namespace, label_selector=f"lzy/vm-id={vm.id}"
+        ):
+            return manifest.get("status", {}).get("phase")
+        return None
+
+    def destroy(self, vm: Vm) -> None:
+        from lzy_tpu.service.kube import KubeNotFound
+
+        try:
+            self._api.delete_pod(self._namespace, self.pod_name(vm))
+        except KubeNotFound:
+            pass
+
+    def reconcile_orphans(self, live_vm_ids) -> List[str]:
+        """Delete managed pods whose VM record no longer exists (crash between
+        pod creation and record cleanup) — KuberVmAllocator GC parity.
+        Returns deleted pod names."""
+        from lzy_tpu.service.kube import KubeNotFound
+
+        live = set(live_vm_ids)
+        deleted = []
+        for manifest in self._api.list_pods(
+            self._namespace,
+            label_selector="app.kubernetes.io/managed-by=lzy-tpu",
+        ):
+            meta = manifest.get("metadata", {})
+            vm_id = meta.get("labels", {}).get("lzy/vm-id")
+            if vm_id and vm_id not in live:
+                try:
+                    self._api.delete_pod(self._namespace, meta["name"])
+                    deleted.append(meta["name"])
+                except KubeNotFound:
+                    pass
+        return deleted

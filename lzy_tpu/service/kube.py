@@ -1,0 +1,139 @@
+"""Kubernetes pod API abstraction + GKE TPU pod-spec construction.
+
+Counterpart of the reference's cloud allocator backend: ``KuberVmAllocator``
+creates one pod per VM through the k8s API
+(``lzy/allocator/src/main/java/ai/lzy/allocator/alloc/impl/kuber/KuberVmAllocator.java:84-197``)
+and ``PodSpecBuilder`` encodes the worker env-var contract
+(``.../kuber/PodSpecBuilder.java:91-150``). TPU redesign: a VM is one *host*
+of a TPU slice; GKE schedules it onto a TPU slice node pool via the
+``gke-tpu-accelerator``/``gke-tpu-topology`` node selectors and the
+``google.com/tpu`` chip resource, and the gang's hosts find each other
+through the worker registration flow (the same contract the thread/process
+backends use), so no k8s-side JobSet machinery is required.
+
+``KubeApi`` is the minimal surface the backend needs; the real
+implementation wraps the ``kubernetes`` python client when it is installed,
+and tests inject a fake (the reference's ``MockKuberClientFactory`` pattern,
+``lzy/allocator/src/test/.../test/MockKuberClientFactory.java``).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, List, Optional
+
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+# GKE accelerator selector values per TPU generation
+# (node pools created with `gcloud container node-pools create --tpu-topology`)
+GKE_TPU_ACCELERATOR = {
+    "v4": "tpu-v4-podslice",
+    "v5e": "tpu-v5-lite-podslice",
+    "v5p": "tpu-v5p-slice",
+    "v6e": "tpu-v6e-slice",
+}
+
+
+class KubeConflict(Exception):
+    """Pod already exists (HTTP 409)."""
+
+
+class KubeNotFound(Exception):
+    """Pod absent (HTTP 404)."""
+
+
+class KubeApi(abc.ABC):
+    @abc.abstractmethod
+    def create_pod(self, namespace: str, manifest: dict) -> None:
+        """Raises KubeConflict if a pod with that name exists."""
+
+    @abc.abstractmethod
+    def delete_pod(self, namespace: str, name: str) -> None:
+        """Raises KubeNotFound if absent."""
+
+    @abc.abstractmethod
+    def list_pods(self, namespace: str,
+                  label_selector: str = "") -> List[dict]:
+        """Returns pod manifests (dicts with metadata/spec/status)."""
+
+
+class KubernetesKubeApi(KubeApi):
+    """Real cluster API via the ``kubernetes`` python client (not bundled in
+    this image; constructing raises ImportError so deployments notice)."""
+
+    def __init__(self, kubeconfig: Optional[str] = None):
+        import kubernetes  # noqa: F401 — ImportError is the gate
+
+        if kubeconfig:
+            kubernetes.config.load_kube_config(kubeconfig)
+        else:
+            try:
+                kubernetes.config.load_incluster_config()
+            except Exception:
+                kubernetes.config.load_kube_config()
+        self._core = kubernetes.client.CoreV1Api()
+        self._exc = kubernetes.client.exceptions.ApiException
+
+    def create_pod(self, namespace: str, manifest: dict) -> None:
+        try:
+            self._core.create_namespaced_pod(namespace, manifest)
+        except self._exc as e:
+            if e.status == 409:
+                raise KubeConflict(manifest["metadata"]["name"]) from e
+            raise
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        try:
+            self._core.delete_namespaced_pod(name, namespace)
+        except self._exc as e:
+            if e.status == 404:
+                raise KubeNotFound(name) from e
+            raise
+
+    def list_pods(self, namespace: str,
+                  label_selector: str = "") -> List[dict]:
+        ret = self._core.list_namespaced_pod(
+            namespace, label_selector=label_selector
+        )
+        return [self._core.api_client.sanitize_for_serialization(p)
+                for p in ret.items]
+
+
+class FakeKubeApi(KubeApi):
+    """In-memory cluster for tests and dry runs: stores manifests, enforces
+    name uniqueness, supports equality-based label selectors."""
+
+    def __init__(self):
+        self.pods: Dict[str, Dict[str, dict]] = {}   # ns -> name -> manifest
+        self.create_calls = 0
+        self.delete_calls = 0
+
+    def create_pod(self, namespace: str, manifest: dict) -> None:
+        self.create_calls += 1
+        ns = self.pods.setdefault(namespace, {})
+        name = manifest["metadata"]["name"]
+        if name in ns:
+            raise KubeConflict(name)
+        ns[name] = manifest
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self.delete_calls += 1
+        ns = self.pods.get(namespace, {})
+        if name not in ns:
+            raise KubeNotFound(name)
+        del ns[name]
+
+    def list_pods(self, namespace: str,
+                  label_selector: str = "") -> List[dict]:
+        wanted = dict(
+            part.split("=", 1)
+            for part in label_selector.split(",") if "=" in part
+        )
+        out = []
+        for manifest in self.pods.get(namespace, {}).values():
+            labels = manifest.get("metadata", {}).get("labels", {})
+            if all(labels.get(k) == v for k, v in wanted.items()):
+                out.append(manifest)
+        return out
