@@ -52,21 +52,32 @@ class JsonRpcServer:
         self._advertise_host = advertise_host
         server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
 
-        def make_behavior(fn):
+        def make_behavior(name, fn):
             def behavior(request: bytes, context) -> bytes:
+                from lzy_tpu.rpc import schema as wire
+
+                payload: dict = {}
                 try:
                     payload = json.loads(request.decode("utf-8")) if request else {}
+                    # typed wire contract: violations become INVALID_ARGUMENT
+                    # at the boundary, not a stack trace inside the handler
+                    wire.validate_request(name, payload)
+                    if _LOG.isEnabledFor(10):  # DEBUG
+                        _LOG.debug("rpc %s <- %s", name,
+                                   wire.mask_request(name, payload))
                     result = fn(payload)
                     return json.dumps(result if result is not None else {}).encode()
                 except BaseException as e:  # noqa: BLE001 — mapped to status
-                    _LOG.info("rpc handler error: %r", e)
+                    # payloads carry credentials: log only the masked form
+                    _LOG.info("rpc %s error: %r (request: %s)", name, e,
+                              wire.mask_request(name, payload))
                     context.abort(_codes(e), f"{type(e).__name__}: {e}")
 
             return behavior
 
         method_handlers = {
             name: grpc.unary_unary_rpc_method_handler(
-                make_behavior(fn),
+                make_behavior(name, fn),
                 request_deserializer=None,
                 response_serializer=None,
             )

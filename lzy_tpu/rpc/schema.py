@@ -1,0 +1,256 @@
+"""Versioned wire schemas + sensitive-field masking.
+
+Counterpart of the reference's protobuf model (40 proto files under
+``model/src/main/proto`` — e.g. ``ai/lzy/model/operation.proto:12-44`` for
+Operation/TaskDesc — plus the ``(validation.sensitive)`` option honoured by
+``util/util-grpc/.../ProtoPrinter.java`` when printing messages to logs).
+
+Redesign rather than codegen: the RPC layer is JSON-over-gRPC
+(``lzy_tpu/rpc/core.py``), so the contract lives here as declarative
+:class:`Schema` objects the server enforces at the boundary —
+
+- **typed**: field presence and python/JSON types are validated before the
+  handler runs; violations map to INVALID_ARGUMENT, not a deep stack trace;
+- **versioned**: every schema carries a version, payloads may carry ``_v``;
+  unknown fields are ALWAYS accepted and preserved (the proto3 rule), so a
+  newer client adding a field keeps working against an older server and
+  vice versa — the wire-compat tests pin this;
+- **masked**: fields marked ``sensitive`` (tokens, credentials, env values)
+  are replaced with ``***`` by :func:`Schema.mask` before any payload
+  reaches a log line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Mapping, Optional, Tuple, Union
+
+MASK = "***"
+
+
+class SchemaError(ValueError):
+    """Payload does not conform to the wire schema (→ INVALID_ARGUMENT)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    """One wire field. ``types`` are the accepted JSON-decoded python types;
+    ``nested`` validates the value itself against a sub-schema; ``item``
+    validates each element of a list / each value of a dict; ``sensitive``
+    masks the value (or every value, for dicts) in logs."""
+
+    types: Tuple[type, ...]
+    required: bool = False
+    sensitive: bool = False
+    nested: Optional["Schema"] = None
+    item: Optional["Schema"] = None
+
+
+def f(*types: type, required: bool = False, sensitive: bool = False,
+      nested: Optional["Schema"] = None,
+      item: Optional["Schema"] = None) -> Field:
+    return Field(types=types, required=required, sensitive=sensitive,
+                 nested=nested, item=item)
+
+
+class Schema:
+    def __init__(self, name: str, fields: Dict[str, Field], version: int = 1):
+        self.name = name
+        self.fields = fields
+        self.version = version
+
+    # -- validation ------------------------------------------------------------
+
+    def validate(self, doc: Any, path: str = "") -> None:
+        where = path or self.name
+        if not isinstance(doc, Mapping):
+            raise SchemaError(f"{where}: expected an object, got "
+                              f"{type(doc).__name__}")
+        for fname, field in self.fields.items():
+            present = fname in doc and doc[fname] is not None
+            if field.required and not present:
+                raise SchemaError(f"{where}.{fname}: required field missing")
+            if not present:
+                continue
+            value = doc[fname]
+            if field.types:
+                # bool is an int subclass; don't let True pass as int
+                wrong_bool = (isinstance(value, bool)
+                              and bool not in field.types)
+                if wrong_bool or not isinstance(value, field.types):
+                    raise SchemaError(
+                        f"{where}.{fname}: expected "
+                        f"{'/'.join(t.__name__ for t in field.types)}, got "
+                        f"{type(value).__name__}"
+                    )
+            if field.nested is not None:
+                field.nested.validate(value, f"{where}.{fname}")
+            if field.item is not None:
+                if isinstance(value, list):
+                    for i, el in enumerate(value):
+                        field.item.validate(el, f"{where}.{fname}[{i}]")
+                elif isinstance(value, Mapping):
+                    for k, el in value.items():
+                        field.item.validate(el, f"{where}.{fname}[{k!r}]")
+        # unknown fields: accepted and preserved (wire evolution, proto3 rule)
+
+    # -- masking ---------------------------------------------------------------
+
+    def mask(self, doc: Any) -> Any:
+        """Deep copy with sensitive values replaced; safe on non-conforming
+        docs (masking must never fail a log line)."""
+        if not isinstance(doc, Mapping):
+            return doc
+        out: Dict[str, Any] = {}
+        for k, v in doc.items():
+            field = self.fields.get(k)
+            if field is None:
+                out[k] = v
+            elif field.sensitive and v is not None:
+                out[k] = ({key: MASK for key in v}
+                          if isinstance(v, Mapping) else MASK)
+            elif field.nested is not None and isinstance(v, Mapping):
+                out[k] = field.nested.mask(v)
+            elif field.item is not None and isinstance(v, list):
+                out[k] = [field.item.mask(el) for el in v]
+            elif field.item is not None and isinstance(v, Mapping):
+                out[k] = {key: field.item.mask(el) for key, el in v.items()}
+            else:
+                out[k] = v
+        return out
+
+
+# -- message schemas (model/.../operation.proto + workflow/channel/vm APIs) ----
+
+ENTRY_REF = Schema("EntryRef", {
+    "id": f(str, required=True),
+    "uri": f(str, required=True),
+    "name": f(str),
+})
+
+TASK_DESC = Schema("TaskDesc", {
+    "id": f(str, required=True),
+    "name": f(str, required=True),
+    "func_uri": f(str, required=True),
+    "args": f(list, required=True, item=ENTRY_REF),
+    "kwargs": f(dict, required=True, item=ENTRY_REF),
+    "outputs": f(list, required=True, item=ENTRY_REF),
+    "exception": f(dict, required=True, nested=ENTRY_REF),
+    "pool_label": f(str, required=True),
+    "gang_size": f(int),
+    # env var VALUES routinely hold credentials (HF_TOKEN, WANDB_API_KEY...)
+    "env_vars": f(dict, sensitive=True),
+    "std_logs_uri": f(str),
+    "module_archives": f(list),
+    "python_env": f(dict),
+    "container": f(dict),
+})
+
+GRAPH_DESC = Schema("GraphDesc", {
+    "id": f(str, required=True),
+    "execution_id": f(str, required=True),
+    "storage_uri": f(str, required=True),
+    "tasks": f(list, required=True, item=TASK_DESC),
+})
+
+SLOT_PEER = Schema("SlotPeer", {
+    "host": f(str, required=True),
+    "port": f(int, required=True),
+    "name": f(str, required=True),
+    "fnv1a": f(str, int),
+})
+
+VM = Schema("Vm", {
+    "id": f(str, required=True),
+    "session_id": f(str, required=True),
+    "pool_label": f(str, required=True),
+    "status": f(str, required=True),
+    "gang_id": f(str, required=True),
+    "host_index": f(int, required=True),
+    "gang_size": f(int, required=True),
+    "heartbeat_ts": f(float, int),
+    "idle_since": f(float, int),
+    "created_ts": f(float, int),
+    "worker_token": f(str, sensitive=True),
+})
+
+_TOKEN = {"token": f(str, sensitive=True)}
+
+# request schemas per RPC method (ControlPlaneServer handler map)
+REQUESTS: Dict[str, Schema] = {
+    "StartWorkflow": Schema("StartWorkflowRequest", {
+        "user": f(str),
+        "workflow_name": f(str, required=True),
+        "storage_uri": f(str, required=True),
+        "execution_id": f(str),
+        "client_version": f(str),
+        **_TOKEN,
+    }),
+    "FinishWorkflow": Schema("FinishWorkflowRequest", {
+        "execution_id": f(str, required=True), **_TOKEN}),
+    "AbortWorkflow": Schema("AbortWorkflowRequest", {
+        "execution_id": f(str, required=True), **_TOKEN}),
+    "ExecuteGraph": Schema("ExecuteGraphRequest", {
+        "execution_id": f(str, required=True),
+        "graph": f(dict, required=True, nested=GRAPH_DESC),
+        **_TOKEN,
+    }),
+    "GraphStatus": Schema("GraphStatusRequest", {
+        "execution_id": f(str, required=True),
+        "graph_op_id": f(str, required=True), **_TOKEN}),
+    "StopGraph": Schema("StopGraphRequest", {
+        "execution_id": f(str, required=True),
+        "graph_op_id": f(str, required=True), **_TOKEN}),
+    "GetPoolSpecs": Schema("GetPoolSpecsRequest", {}),
+    "ReadStdLogs": Schema("ReadStdLogsRequest", {
+        "execution_id": f(str, required=True),
+        "offsets": f(dict), **_TOKEN}),
+    "ChannelBind": Schema("ChannelBindRequest", {
+        "entry_id": f(str, required=True),
+        "role": f(str, required=True),
+        "task_id": f(str, required=True), **_TOKEN}),
+    "ChannelCompleted": Schema("ChannelCompletedRequest", {
+        "entry_id": f(str, required=True), **_TOKEN}),
+    "ChannelFailed": Schema("ChannelFailedRequest", {
+        "entry_id": f(str, required=True),
+        "error": f(str), **_TOKEN}),
+    "ChannelPublishPeer": Schema("ChannelPublishPeerRequest", {
+        "entry_id": f(str, required=True),
+        "peer": f(dict, required=True, nested=SLOT_PEER), **_TOKEN}),
+    "WaitChannel": Schema("WaitChannelRequest", {
+        "entry_id": f(str, required=True),
+        "timeout_s": f(float, int), **_TOKEN}),
+    "RegisterVm": Schema("RegisterVmRequest", {
+        "vm_id": f(str, required=True),
+        "endpoint": f(str, required=True), **_TOKEN}),
+    "Heartbeat": Schema("HeartbeatRequest", {
+        "vm_id": f(str, required=True), **_TOKEN}),
+    # WorkerApi (the worker's own server)
+    "Init": Schema("InitRequest", {"owner": f(str), **_TOKEN}),
+    "Execute": Schema("ExecuteRequest", {
+        "task": f(dict, required=True, nested=TASK_DESC),
+        "gang_rank": f(int, required=True),
+        "gang": f(dict), **_TOKEN}),
+    "Status": Schema("StatusRequest", {
+        "op_id": f(str, required=True), **_TOKEN}),
+    "Shutdown": Schema("ShutdownRequest", {**_TOKEN}),
+}
+
+def validate_request(method: str, payload: dict) -> None:
+    schema = REQUESTS.get(method)
+    if schema is not None:
+        schema.validate(payload)
+
+
+def mask_request(method: str, payload: Any) -> Any:
+    """Log-safe view of a request payload; unknown methods get a generic
+    credential-key scrub so a missing schema never leaks a secret."""
+    schema = REQUESTS.get(method)
+    masked = schema.mask(payload) if schema is not None else payload
+    if isinstance(masked, Mapping):
+        masked = {
+            k: (MASK if k in ("token", "password", "worker_token",
+                              "secret") and v is not None else v)
+            for k, v in masked.items()
+        }
+    return masked

@@ -1,0 +1,160 @@
+"""Typed wire schemas: round-trips, evolution (unknown fields accepted),
+boundary validation → INVALID_ARGUMENT, and sensitive-field masking so
+credentials never reach log lines (reference parity: protobuf model
+``model/.../operation.proto:12-44`` + ``(validation.sensitive)`` masking in
+``util-grpc/.../ProtoPrinter.java``)."""
+
+import logging
+
+import pytest
+
+from lzy_tpu.rpc.schema import (
+    GRAPH_DESC,
+    MASK,
+    REQUESTS,
+    TASK_DESC,
+    SchemaError,
+    mask_request,
+    validate_request,
+)
+from lzy_tpu.service.graph import EntryRef, GraphDesc, TaskDesc
+
+
+def make_task(tid="t1") -> TaskDesc:
+    ref = lambda n: EntryRef(id=f"{tid}-{n}", uri=f"mem://x/{tid}/{n}", name=n)  # noqa: E731
+    return TaskDesc(
+        id=tid, name="op", func_uri=f"mem://x/{tid}/fn",
+        args=[ref("a0")], kwargs={"k": ref("k0")}, outputs=[ref("o0")],
+        exception=ref("exc"), pool_label="cpu-small",
+        env_vars={"HF_TOKEN": "hf_secret_123"},
+    )
+
+
+class TestRoundTrip:
+    def test_task_doc_conforms(self):
+        TASK_DESC.validate(make_task().to_doc())
+
+    def test_graph_doc_conforms_and_round_trips(self):
+        g = GraphDesc(id="g1", execution_id="e1", storage_uri="mem://x",
+                      tasks=[make_task("t1"), make_task("t2")])
+        doc = g.to_doc()
+        GRAPH_DESC.validate(doc)
+        g2 = GraphDesc.from_doc(doc)
+        assert g2.to_doc() == doc
+
+    def test_every_rpc_method_has_a_schema(self):
+        from lzy_tpu.rpc.control import ControlPlaneServer  # noqa: F401
+
+        for method in ("StartWorkflow", "FinishWorkflow", "AbortWorkflow",
+                       "ExecuteGraph", "GraphStatus", "StopGraph",
+                       "GetPoolSpecs", "ReadStdLogs", "ChannelBind",
+                       "ChannelCompleted", "ChannelFailed",
+                       "ChannelPublishPeer", "WaitChannel", "RegisterVm",
+                       "Heartbeat", "Init", "Execute", "Status", "Shutdown"):
+            assert method in REQUESTS, f"no wire schema for {method}"
+
+
+class TestEvolution:
+    def test_unknown_fields_accepted(self):
+        """proto3 rule: a newer peer adding a field must not break an older
+        one — unknown fields pass validation and survive masking."""
+        doc = make_task().to_doc()
+        doc["brand_new_field"] = {"anything": 1}
+        TASK_DESC.validate(doc)
+        assert TASK_DESC.mask(doc)["brand_new_field"] == {"anything": 1}
+
+    def test_missing_required_rejected(self):
+        doc = make_task().to_doc()
+        del doc["func_uri"]
+        with pytest.raises(SchemaError, match=r"func_uri: required"):
+            TASK_DESC.validate(doc)
+
+    def test_wrong_type_rejected_with_path(self):
+        doc = make_task().to_doc()
+        doc["args"][0]["uri"] = 42
+        with pytest.raises(SchemaError, match=r"args\[0\].uri: expected str"):
+            TASK_DESC.validate(doc)
+
+    def test_bool_does_not_pass_as_int(self):
+        with pytest.raises(SchemaError, match="gang_rank"):
+            validate_request("Execute", {
+                "task": make_task().to_doc(), "gang_rank": True,
+            })
+
+    def test_request_validation_catches_nested_graph(self):
+        with pytest.raises(SchemaError, match=r"graph.tasks\[0\]"):
+            validate_request("ExecuteGraph", {
+                "execution_id": "e", "graph": {
+                    "id": "g", "execution_id": "e", "storage_uri": "mem://x",
+                    "tasks": [{"id": "t"}],            # missing required
+                }})
+
+
+class TestMasking:
+    def test_env_var_values_masked(self):
+        masked = TASK_DESC.mask(make_task().to_doc())
+        assert masked["env_vars"] == {"HF_TOKEN": MASK}
+        assert "hf_secret_123" not in repr(masked)
+
+    def test_tokens_masked_in_requests(self):
+        masked = mask_request("Heartbeat", {"vm_id": "vm1",
+                                            "token": "vm1:123:0:sig"})
+        assert masked == {"vm_id": "vm1", "token": MASK}
+
+    def test_graph_request_masks_task_env_vars(self):
+        payload = {"execution_id": "e", "token": "user-token", "graph": {
+            "id": "g", "execution_id": "e", "storage_uri": "mem://x",
+            "tasks": [make_task().to_doc()],
+        }}
+        masked = mask_request("ExecuteGraph", payload)
+        assert masked["token"] == MASK
+        assert masked["graph"]["tasks"][0]["env_vars"] == {"HF_TOKEN": MASK}
+        assert "hf_secret_123" not in repr(masked)
+
+    def test_unknown_method_still_scrubs_credential_keys(self):
+        masked = mask_request("SomeFutureMethod", {"token": "t", "x": 1})
+        assert masked == {"token": MASK, "x": 1}
+
+    def test_mask_never_fails_on_junk(self):
+        assert mask_request("Heartbeat", "not-a-dict") == "not-a-dict"
+        assert TASK_DESC.mask(None) is None
+
+
+class TestServerBoundary:
+    def test_invalid_payload_maps_to_value_error(self, tmp_path):
+        from lzy_tpu.rpc import RpcWorkflowClient
+        from lzy_tpu.rpc.core import JsonRpcClient
+        from lzy_tpu.service import InProcessCluster
+
+        c = InProcessCluster(db_path=str(tmp_path / "m.db"))
+        server = c.serve()
+        raw = JsonRpcClient(server.address)
+        try:
+            with pytest.raises(ValueError, match="required field missing"):
+                raw.call("ExecuteGraph", {"graph": {"id": "g"}})
+        finally:
+            raw.close()
+            c.shutdown()
+
+    def test_secrets_never_reach_server_logs(self, tmp_path, caplog):
+        """A failing RPC logs the request — the masked form only."""
+        from lzy_tpu.rpc.core import JsonRpcClient
+        from lzy_tpu.service import InProcessCluster
+
+        c = InProcessCluster(db_path=str(tmp_path / "m.db"))
+        server = c.serve()
+        raw = JsonRpcClient(server.address)
+        try:
+            with caplog.at_level(logging.INFO, logger="lzy_tpu.rpc.core"):
+                with pytest.raises(Exception):
+                    raw.call("FinishWorkflow", {
+                        "execution_id": "no-such-execution",
+                        "token": "alice:1:0:super-secret-sig",
+                    })
+            text = "\n".join(r.getMessage() for r in caplog.records)
+            assert "rpc FinishWorkflow error" in text
+            assert "super-secret-sig" not in text
+            assert MASK in text
+        finally:
+            raw.close()
+            c.shutdown()
