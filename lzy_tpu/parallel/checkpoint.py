@@ -46,9 +46,16 @@ class CheckpointManager:
         uri = join_uri(self._base, f"step_{step:010d}")
 
         def upload() -> None:
+            from lzy_tpu.storage.transfer import log_progress, upload_bytes
+
             buf = io.BytesIO()
             self._ser.serialize(host_state, buf)
-            self._client.write_bytes(join_uri(uri, "state"), buf.getvalue())
+            # multipart + retries + progress for multi-GB states; small
+            # checkpoints take the single-write path inside upload_bytes
+            upload_bytes(
+                self._client, join_uri(uri, "state"), buf.getvalue(),
+                progress=log_progress(f"checkpoint step {step}"),
+            )
             manifest = {"step": step, "metrics": metrics or {}}
             self._client.write_bytes(
                 join_uri(uri, "manifest.json"),

@@ -45,6 +45,16 @@ class FsStorageClient(StorageClient):
             raise
         return path.stat().st_size
 
+    def multipart_upload(self, uri: str, *, size, read_span, config,
+                         advance) -> int:
+        """Parallel ranged copy + atomic rename (transfer-engine capability;
+        the fs analog of S3 multipart completion)."""
+        from lzy_tpu.storage.transfer import fs_multipart_upload
+
+        return fs_multipart_upload(self._path, uri, size=size,
+                                   read_span=read_span, config=config,
+                                   advance=advance)
+
     def open_read(self, uri: str) -> BinaryIO:
         return open(self._path(uri), "rb")
 

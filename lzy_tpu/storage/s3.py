@@ -111,3 +111,66 @@ class S3StorageClient(StorageClient):
         return self._s3.generate_presigned_url(
             "get_object", Params={"Bucket": bucket, "Key": key}, ExpiresIn=3600
         )
+
+    def multipart_upload(self, uri: str, *, size, read_span, config,
+                         advance) -> int:
+        """Real S3 multipart (create/upload_part/complete with per-part
+        retries, abort on failure) — UploadProcessingLoop parity. Boto's
+        managed transfer is bypassed so retry policy, concurrency, and
+        progress are the transfer engine's, not botocore defaults.
+        ``read_span(offset, length)`` abstracts the source (file or
+        in-memory slice)."""
+        from lzy_tpu.storage.transfer import _with_retries
+
+        bucket, key = self._split(uri)
+        total = size
+        if total <= config.part_size:
+            def put():
+                self._s3.put_object(Bucket=bucket, Key=key,
+                                    Body=bytes(read_span(0, total)))
+                return total
+
+            n = _with_retries(put, config, f"put_object({uri})")
+            advance(total)
+            return n
+
+        mp = self._s3.create_multipart_upload(Bucket=bucket, Key=key)
+        upload_id = mp["UploadId"]
+        try:
+            from concurrent import futures as _futures
+
+            spans = [(i + 1, off, min(config.part_size, total - off))
+                     for i, off in enumerate(
+                         range(0, total, config.part_size))]
+
+            def upload_part(part_no: int, offset: int, length: int) -> dict:
+                def one():
+                    resp = self._s3.upload_part(
+                        Bucket=bucket, Key=key, UploadId=upload_id,
+                        PartNumber=part_no,
+                        Body=bytes(read_span(offset, length)),
+                    )
+                    return resp["ETag"]
+
+                etag = _with_retries(one, config,
+                                     f"upload_part({uri}#{part_no})")
+                advance(length)
+                return {"PartNumber": part_no, "ETag": etag}
+
+            with _futures.ThreadPoolExecutor(config.max_workers) as pool:
+                parts = list(pool.map(lambda s: upload_part(*s), spans))
+            self._s3.complete_multipart_upload(
+                Bucket=bucket, Key=key, UploadId=upload_id,
+                MultipartUpload={
+                    "Parts": sorted(parts, key=lambda p: p["PartNumber"])
+                },
+            )
+        except BaseException:
+            # a dangling multipart upload bills storage forever; always abort
+            try:
+                self._s3.abort_multipart_upload(Bucket=bucket, Key=key,
+                                                UploadId=upload_id)
+            except Exception:
+                pass
+            raise
+        return total

@@ -1,0 +1,253 @@
+"""Parallel ranged transfer engine with retries and progress.
+
+Counterpart of the reference's ``util/util-s3`` transmitter
+(``util/util-s3/src/main/java/ru/yandex/qe/s3/transfer/loop/UploadProcessingLoop.java``
+and its download twin: bounded worker pools moving a stream in parts, with
+per-part retry and rollback) and the pylzy async S3 multipart path. TPU
+framing: multi-GB ``jax.Array`` spills and checkpoints move between HBM-host
+RAM and object storage; a single-stream put/get leaves most of the NIC idle,
+so transfers here are split into ranged parts executed by a thread pool —
+per-part retries with exponential backoff, byte-accurate progress callbacks,
+and atomic completion (tmp + rename on fs; multipart-complete on S3, which
+is what makes a crashed producer invisible to readers).
+
+Works against ANY :class:`StorageClient`: downloads need only
+``read_range``; uploads use the client's ``multipart_upload`` capability
+when present (fs, s3) and fall back to a retried streaming write.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import time
+from concurrent import futures
+from typing import Callable, Optional
+
+from lzy_tpu.storage.api import StorageClient
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+Progress = Callable[[int, int], None]      # (bytes_done, bytes_total)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransferConfig:
+    part_size: int = 32 * 1024 * 1024
+    max_workers: int = 8
+    retries: int = 3                        # attempts per part
+    backoff_s: float = 0.25                 # doubles per retry
+
+    def __post_init__(self):
+        if self.part_size <= 0 or self.max_workers <= 0 or self.retries <= 0:
+            raise ValueError("part_size, max_workers, retries must be > 0")
+
+
+DEFAULT = TransferConfig()
+
+
+class TransferError(RuntimeError):
+    pass
+
+
+def _with_retries(fn, config: TransferConfig, what: str):
+    delay = config.backoff_s
+    last: Optional[BaseException] = None
+    for attempt in range(1, config.retries + 1):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — retried, then surfaced
+            last = e
+            if attempt < config.retries:
+                _LOG.warning("%s failed (attempt %d/%d): %r; retrying in "
+                             "%.2fs", what, attempt, config.retries, e, delay)
+                time.sleep(delay)
+                delay *= 2
+    raise TransferError(f"{what} failed after {config.retries} attempts: "
+                        f"{last!r}") from last
+
+
+class _ProgressMeter:
+    """Thread-safe byte counter fanning out to the user callback."""
+
+    def __init__(self, total: int, progress: Optional[Progress]):
+        import threading
+
+        self.total = total
+        self._done = 0
+        self._lock = threading.Lock()
+        self._progress = progress
+
+    def advance(self, n: int) -> None:
+        if self._progress is None:
+            return
+        with self._lock:
+            self._done += n
+            done = self._done
+        self._progress(done, self.total)
+
+
+def log_progress(name: str, period_s: float = 5.0) -> Progress:
+    """A ready-made progress callback that logs percent at most every
+    ``period_s`` (tqdm-free; works in workers and CLIs)."""
+    state = {"t": 0.0}
+
+    def cb(done: int, total: int) -> None:
+        now = time.monotonic()
+        if done >= total or now - state["t"] >= period_s:
+            state["t"] = now
+            pct = 100.0 * done / total if total else 100.0
+            _LOG.info("%s: %.1f%% (%d/%d bytes)", name, pct, done, total)
+
+    return cb
+
+
+def download(client: StorageClient, uri: str, dest_path: str, *,
+             config: TransferConfig = DEFAULT,
+             progress: Optional[Progress] = None) -> int:
+    """Concurrent ranged download to ``dest_path`` (atomic: .part + rename).
+    Needs only ``read_range`` + ``size`` from the backend."""
+    total = _with_retries(lambda: client.size(uri), config, f"size({uri})")
+    meter = _ProgressMeter(total, progress)
+    tmp = dest_path + ".part"
+    os.makedirs(os.path.dirname(os.path.abspath(dest_path)), exist_ok=True)
+    fd = os.open(tmp, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+    try:
+        os.truncate(fd, total)
+
+        def fetch(offset: int, length: int) -> None:
+            def one():
+                data = client.read_range(uri, offset, length)
+                if len(data) != length:
+                    raise TransferError(
+                        f"short range read at {offset}: got {len(data)}, "
+                        f"want {length}"
+                    )
+                return data
+
+            data = _with_retries(one, config, f"read_range({uri}@{offset})")
+            os.pwrite(fd, data, offset)
+            meter.advance(length)
+
+        parts = [(off, min(config.part_size, total - off))
+                 for off in range(0, total, config.part_size)]
+        if not parts:
+            pass  # zero-byte object
+        elif len(parts) == 1:
+            fetch(*parts[0])
+        else:
+            with futures.ThreadPoolExecutor(config.max_workers) as pool:
+                list(pool.map(lambda p: fetch(*p), parts))
+        os.close(fd)
+        fd = -1
+        os.replace(tmp, dest_path)
+    except BaseException:
+        if fd >= 0:
+            os.close(fd)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return total
+
+
+def upload(client: StorageClient, uri: str, src_path: str, *,
+           config: TransferConfig = DEFAULT,
+           progress: Optional[Progress] = None) -> int:
+    """Parallel multipart upload when the backend supports it, else a
+    retried streaming write. Either way the object is never readable
+    half-written."""
+    total = os.path.getsize(src_path)
+    meter = _ProgressMeter(total, progress)
+    multipart = getattr(client, "multipart_upload", None)
+    if multipart is not None:
+        src_fd = os.open(src_path, os.O_RDONLY)
+        try:
+            return multipart(
+                uri, size=total,
+                read_span=lambda off, ln: os.pread(src_fd, ln, off),
+                config=config, advance=meter.advance,
+            )
+        finally:
+            os.close(src_fd)
+
+    def stream():
+        with open(src_path, "rb") as f:
+            n = client.write(uri, f)
+        meter.advance(total)
+        return n
+
+    return _with_retries(stream, config, f"write({uri})")
+
+
+def upload_bytes(client: StorageClient, uri: str, data: bytes, *,
+                 config: TransferConfig = DEFAULT,
+                 progress: Optional[Progress] = None) -> int:
+    """In-memory payloads (checkpoint shards, spilled arrays): zero-copy
+    multipart when large and the backend supports it (memoryview slices per
+    part — no temp spill, no RAM doubling), else one retried write."""
+    multipart = getattr(client, "multipart_upload", None)
+    if len(data) > config.part_size and multipart is not None:
+        meter = _ProgressMeter(len(data), progress)
+        view = memoryview(data)
+        return multipart(
+            uri, size=len(data),
+            read_span=lambda off, ln: view[off:off + ln],
+            config=config, advance=meter.advance,
+        )
+    meter = _ProgressMeter(len(data), progress)
+
+    def put():
+        n = client.write_bytes(uri, data)
+        meter.advance(len(data))
+        return n
+
+    return _with_retries(put, config, f"write({uri})")
+
+
+def fs_multipart_upload(path_of, uri: str, *, size: int,
+                        read_span: Callable[[int, int], bytes],
+                        config: TransferConfig,
+                        advance: Callable[[int], None]) -> int:
+    """Shared fs implementation: concurrent pwrite into a temp file in the
+    destination dir, then atomic rename (the fs analog of S3
+    complete_multipart_upload). ``read_span(offset, length)`` abstracts the
+    source (file pread or an in-memory slice)."""
+    dest = path_of(uri)
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    tmp = tempfile.NamedTemporaryFile(dir=dest.parent, delete=False)
+    tmp.close()
+    out_fd = os.open(tmp.name, os.O_WRONLY)
+    try:
+        os.truncate(out_fd, size)
+
+        def copy_part(offset: int, length: int) -> None:
+            def one():
+                os.pwrite(out_fd, read_span(offset, length), offset)
+
+            _with_retries(one, config, f"fs part @{offset}")
+            advance(length)
+
+        parts = [(off, min(config.part_size, size - off))
+                 for off in range(0, size, config.part_size)]
+        if parts:
+            with futures.ThreadPoolExecutor(config.max_workers) as pool:
+                list(pool.map(lambda p: copy_part(*p), parts))
+        os.close(out_fd)
+        out_fd = -1
+        umask = os.umask(0)
+        os.umask(umask)
+        os.chmod(tmp.name, 0o666 & ~umask)
+        os.replace(tmp.name, dest)
+    except BaseException:
+        if out_fd >= 0:
+            os.close(out_fd)
+        try:
+            os.unlink(tmp.name)
+        except OSError:
+            pass
+        raise
+    return size
