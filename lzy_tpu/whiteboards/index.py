@@ -2,11 +2,16 @@
 
 The reference runs a dedicated whiteboard service with Postgres
 (``lzy/whiteboard/.../WhiteboardService.java:45``, proto
-``whiteboard-api/.../whiteboard-service.proto:11-17``). Here the index is a
-storage-native manifest layout — ``<root>/whiteboards/<id>/manifest.json`` plus
-one object per field — so whiteboards survive with the data itself and need no
-extra service for single-tenant deployments; a service-backed index can slot in
-behind the same interface later.
+``whiteboard-api/.../whiteboard-service.proto:11-17``) whose DB indexes make
+list-by-user/name/tags/time cheap. Here the layout is storage-native —
+``<root>/whiteboards/<id>/manifest.json`` plus one object per field — so
+whiteboards survive with the data itself; the DB indexes are replaced by
+**index records**: at finalize time a compact (~200 B) record is written
+under ``.index/all/``, ``.index/name/<name>/`` and ``.index/tag/<tag>/``,
+its object name prefixed with the creation timestamp. Queries list only the
+narrowest applicable index prefix, prune by timestamp on object NAMES (no
+read at all), filter on the tiny records, and load full manifests only for
+actual matches — O(matches), not O(all whiteboards).
 """
 
 from __future__ import annotations
@@ -14,6 +19,7 @@ from __future__ import annotations
 import datetime
 import json
 from typing import TYPE_CHECKING, Any, Dict, List, Optional, Sequence
+from urllib.parse import quote
 
 from lzy_tpu.storage.api import StorageClient, join_uri
 from lzy_tpu.types import DataScheme
@@ -95,11 +101,54 @@ class WhiteboardIndex:
         manifest.doc["fields"] = fields
         manifest.doc["status"] = FINALIZED
         self._write(wb_id, manifest.doc)
+        # index records come LAST: a query never surfaces a whiteboard whose
+        # manifest is not yet durable
+        self._write_index_records(manifest.doc)
 
     def _write(self, wb_id: str, doc: Dict[str, Any]) -> None:
         self._client.write_bytes(
             self._manifest_uri(wb_id), json.dumps(doc, indent=1).encode("utf-8")
         )
+
+    # -- index records (the storage-native analog of the reference's DB
+    #    indexes on name/tags/created_at) --------------------------------------
+
+    def _index_leaf(self, doc: Dict[str, Any]) -> str:
+        # timestamp prefix → object names sort by creation time, so time
+        # ranges prune on the NAME without reading the record
+        return f"{doc['created_at']}_{doc['id']}.json"
+
+    def _index_uris(self, doc: Dict[str, Any]) -> List[str]:
+        leaf = self._index_leaf(doc)
+        uris = [join_uri(self._root, ".index", "all", leaf),
+                join_uri(self._root, ".index", "name",
+                         quote(doc["name"], safe=""), leaf)]
+        for tag in doc.get("tags", []):
+            uris.append(join_uri(self._root, ".index", "tag",
+                                 quote(tag, safe=""), leaf))
+        return uris
+
+    def _write_index_records(self, doc: Dict[str, Any]) -> None:
+        record = json.dumps({
+            "id": doc["id"], "name": doc["name"], "status": doc["status"],
+            "tags": doc.get("tags", []), "created_at": doc["created_at"],
+        }).encode("utf-8")
+        for uri in self._index_uris(doc):
+            self._client.write_bytes(uri, record)
+
+    def reindex(self) -> int:
+        """Rebuild index records from manifests (migration for whiteboards
+        finalized before the index existed, or after index loss). Returns the
+        number of whiteboards indexed."""
+        n = 0
+        for uri in self._client.list(self._root):
+            if "/.index/" in uri or not uri.endswith("/manifest.json"):
+                continue
+            doc = json.loads(self._client.read_bytes(uri))
+            if doc.get("status") == FINALIZED:
+                self._write_index_records(doc)
+                n += 1
+        return n
 
     def get(self, *, id_: Optional[str] = None,
             storage_uri: Optional[str] = None) -> WhiteboardManifest:
@@ -115,21 +164,50 @@ class WhiteboardIndex:
     def query(self, *, name: Optional[str] = None, tags: Sequence[str] = (),
               not_before: Optional[datetime.datetime] = None,
               not_after: Optional[datetime.datetime] = None) -> List[WhiteboardManifest]:
+        """O(matches): list the narrowest index prefix (name > tag > all),
+        prune time ranges on object names, filter remaining predicates on the
+        compact records, and read full manifests only for matches."""
+        # trailing "/" matters: list() is raw string-prefix on every backend,
+        # so "name/foo" would also match "name/foobar/..."
+        if name is not None:
+            prefix = join_uri(self._root, ".index", "name",
+                              quote(name, safe="")) + "/"
+        elif tags:
+            prefix = join_uri(self._root, ".index", "tag",
+                              quote(tags[0], safe="")) + "/"
+        else:
+            prefix = join_uri(self._root, ".index", "all") + "/"
+
+        def utc_iso(dt: Optional[datetime.datetime]) -> Optional[str]:
+            # lexically comparable with record timestamps (which are UTC
+            # isoformat); naive datetimes skip the name-level prune and are
+            # still filtered precisely on the record below
+            if dt is None or dt.tzinfo is None:
+                return None
+            return dt.astimezone(datetime.timezone.utc).isoformat()
+
+        lo, hi = utc_iso(not_before), utc_iso(not_after)
         out = []
-        for uri in self._client.list(self._root):
-            if not uri.endswith("/manifest.json"):
+        for uri in self._client.list(prefix):
+            # leaf is "<iso-ts>_<id>.json"; iso never contains "_", ids may
+            ts = uri.rsplit("/", 1)[-1].split("_", 1)[0]
+            # iso timestamps sort lexically: prune without reading anything
+            if (lo is not None and ts < lo) or (hi is not None and ts > hi):
                 continue
-            m = WhiteboardManifest(json.loads(self._client.read_bytes(uri)))
-            if m.status != FINALIZED:
+            record = json.loads(self._client.read_bytes(uri))
+            if record.get("status") != FINALIZED:
                 continue
-            if name is not None and m.name != name:
+            # re-check every predicate on the record itself — the prefix is
+            # routing, not authority
+            if name is not None and record.get("name") != name:
                 continue
-            if tags and not set(tags).issubset(m.tags):
+            if tags and not set(tags).issubset(record.get("tags", [])):
                 continue
-            if not_before is not None and m.created_at < not_before:
+            created = datetime.datetime.fromisoformat(record["created_at"])
+            if not_before is not None and created < not_before:
                 continue
-            if not_after is not None and m.created_at > not_after:
+            if not_after is not None and created > not_after:
                 continue
-            out.append(m)
+            out.append(self.get(id_=record["id"]))
         out.sort(key=lambda m: m.created_at, reverse=True)
         return out
