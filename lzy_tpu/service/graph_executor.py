@@ -64,11 +64,36 @@ class GraphExecutor:
         self.poll_period_s = poll_period_s
         self.task_timeout_s = task_timeout_s
         # cross-graph fairness accounting (TasksSchedulerImpl limits
-        # `:192-207` parity); in-memory — a restart re-admits from zero
+        # `:192-207` parity). The counters are in-memory for speed but the
+        # ground truth is durable: every admitted task is a RUNNING entry in
+        # its exec_graph op's persisted state, so _restore_admissions()
+        # rebuilds the counts on boot — a control-plane bounce cannot double
+        # a user's quota.
         self._user_running: Dict[str, int] = {}
         self._user_lock = threading.Lock()
         executor.register("exec_graph", self._make_graph_action)
         executor.register("exec_task", self._make_task_action)
+        self._restore_admissions()
+
+    def _restore_admissions(self) -> None:
+        """Boot-time recovery of per-user running counts from the persisted
+        exec_graph op states (reference persists scheduler state in the DB,
+        ``TasksSchedulerImpl.java:192-207``)."""
+        counts: Dict[str, int] = {}
+        for record in self._store.running_ops():
+            if record.kind != "exec_graph":
+                continue
+            user = record.state.get("user", "")
+            running = sum(
+                1 for info in record.state.get("tasks", {}).values()
+                if info.get("status") == RUNNING
+            )
+            if running:
+                counts[user] = counts.get(user, 0) + running
+        with self._user_lock:
+            self._user_running = counts
+        if counts:
+            _LOG.info("restored per-user admissions: %s", counts)
 
     def execute(self, graph: GraphDesc, session_id: str,
                 user: str = "") -> str:
