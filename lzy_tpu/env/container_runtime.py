@@ -53,8 +53,15 @@ def container_to_doc(container) -> Optional[dict]:
         # persisted in the durable metadata store and crosses the control
         # plane in plaintext. Workers resolve credentials locally
         # (LZY_REGISTRY_USERNAME/PASSWORD or a pre-configured docker login).
-        doc.pop("username", None)
-        doc.pop("password", None)
+        username, password = doc.pop("username", None), doc.pop("password", None)
+        if username or password:
+            _LOG.warning(
+                "DockerContainer credentials for %s are not shipped to "
+                "workers (they would persist in plaintext); set "
+                "LZY_REGISTRY_USERNAME/LZY_REGISTRY_PASSWORD on the workers "
+                "or pre-login docker there",
+                container.image,
+            )
         return doc
     raise TypeError(f"unsupported container spec {type(container).__name__}")
 
