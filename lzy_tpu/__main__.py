@@ -1,26 +1,33 @@
 """CLI status surface: ``python -m lzy_tpu <command>``.
 
 The reference ships a web console (``lzy/site`` + React frontend) listing
-tasks/executions; a terminal status surface fits the TPU build's
-single-metadata-store design: commands read the deployment's store
-(``--db``, default ``$LZY_TPU_DB``) and print tables.
+tasks/executions; the TPU build offers the same state three ways — this
+CLI, the ``GetStatus`` RPC, and the HTML console
+(``lzy_tpu.service.console``). The CLI reads either the deployment's
+metadata store directly (``--db``, default ``$LZY_TPU_DB``) or a LIVE
+remote control plane over gRPC (``--address``, with ``--token`` when the
+deployment runs IAM) — so operators do not need filesystem access to the
+control plane host.
 
-Commands: executions, graphs, vms, ops, whiteboards, version.
+Commands: executions, graphs, vms, ops, whiteboards, serve-console, version.
 """
 
 from __future__ import annotations
 
 import argparse
-import datetime
-import json
 import os
 import sys
 
-
-def _fmt_ts(ts) -> str:
-    if not ts:
-        return "-"
-    return datetime.datetime.fromtimestamp(ts).strftime("%Y-%m-%d %H:%M:%S")
+# header labels per shared column order (lzy_tpu.service.status.COLUMNS)
+_HEADERS = {
+    "executions": ["EXECUTION", "WORKFLOW", "USER", "STATUS", "STARTED",
+                   "GRAPHS"],
+    "graphs": ["GRAPH-OP", "WORKFLOW", "STATUS", "DONE", "TOTAL", "FAILED"],
+    "vms": ["VM", "POOL", "STATUS", "GANG", "HOST", "GANG-SIZE", "HEARTBEAT"],
+    "operations": ["OPERATION", "KIND", "STATUS", "STEP"],
+}
+_VIEW_OF_COMMAND = {"executions": "executions", "graphs": "graphs",
+                    "vms": "vms", "ops": "operations"}
 
 
 def _table(rows, headers) -> str:
@@ -32,51 +39,41 @@ def _table(rows, headers) -> str:
     return "\n".join(out)
 
 
-def cmd_executions(store, args) -> None:
-    rows = []
-    for eid, doc in sorted(store.kv_list("executions").items(),
-                           key=lambda kv: kv[1].get("started_at", 0)):
-        rows.append([
-            eid, doc.get("workflow_name"), doc.get("user"),
-            doc.get("status"), _fmt_ts(doc.get("started_at")),
-            len(doc.get("graphs", [])),
-        ])
-    print(_table(rows, ["EXECUTION", "WORKFLOW", "USER", "STATUS",
-                        "STARTED", "GRAPHS"]))
+def _fetch_rows(args, view: str):
+    if args.address:
+        from lzy_tpu.rpc.core import JsonRpcClient
+
+        client = JsonRpcClient(args.address)
+        try:
+            return client.call("GetStatus", {"view": view,
+                                             "token": args.token})["rows"]
+        finally:
+            client.close()
+    if not args.db:
+        print("pass --db <path> / $LZY_TPU_DB, or --address <host:port>",
+              file=sys.stderr)
+        sys.exit(2)
+    from lzy_tpu.durable import OperationStore
+    from lzy_tpu.service import status as status_views
+
+    store = OperationStore(args.db)
+    try:
+        return status_views.collect(store, view)
+    finally:
+        store.close()
 
 
-def cmd_graphs(store, args) -> None:
-    rows = []
-    for doc in store.kv_list("executions").values():
-        for graph_op_id in doc.get("graphs", []):
-            try:
-                record = store.load(graph_op_id)
-            except KeyError:
-                continue
-            tasks = record.state.get("tasks", {})
-            done = sum(1 for t in tasks.values() if t["status"] == "COMPLETED")
-            rows.append([graph_op_id, doc.get("workflow_name"), record.status,
-                         f"{done}/{len(tasks)}"])
-    print(_table(rows, ["GRAPH-OP", "WORKFLOW", "STATUS", "TASKS"]))
+def cmd_status_view(args, command: str) -> None:
+    from lzy_tpu.service.status import COLUMNS, fmt_cell
+
+    view = _VIEW_OF_COMMAND[command]
+    rows = _fetch_rows(args, view)
+    cols, headers = COLUMNS[view], _HEADERS[view]
+    print(_table([[fmt_cell(c, r.get(c)) for c in cols] for r in rows],
+                 headers))
 
 
-def cmd_vms(store, args) -> None:
-    rows = []
-    for vm_id, doc in sorted(store.kv_list("vms").items()):
-        rows.append([vm_id, doc.get("pool_label"), doc.get("status"),
-                     doc.get("gang_id"),
-                     f"{doc.get('host_index')}/{doc.get('gang_size')}"])
-    print(_table(rows, ["VM", "POOL", "STATUS", "GANG", "HOST"]))
-
-
-def cmd_ops(store, args) -> None:
-    rows = []
-    for record in store.running_ops():
-        rows.append([record.id, record.kind, record.status, record.step])
-    print(_table(rows, ["OPERATION", "KIND", "STATUS", "STEP"]))
-
-
-def cmd_whiteboards(store, args) -> None:
+def cmd_whiteboards(args) -> None:
     from lzy_tpu.storage import StorageConfig
     from lzy_tpu.storage.registry import client_for
     from lzy_tpu.whiteboards.index import WhiteboardIndex
@@ -86,9 +83,32 @@ def cmd_whiteboards(store, args) -> None:
         sys.exit(2)
     index = WhiteboardIndex(client_for(StorageConfig(uri=args.storage)),
                             args.storage)
-    rows = [[m.id, m.name, ",".join(m.tags), m.created_at.strftime("%Y-%m-%d %H:%M")]
+    rows = [[m.id, m.name, ",".join(m.tags),
+             m.created_at.strftime("%Y-%m-%d %H:%M")]
             for m in index.query()]
     print(_table(rows, ["ID", "NAME", "TAGS", "CREATED"]))
+
+
+def cmd_serve_console(args) -> None:
+    if not args.db:
+        print("console serves a local store; pass --db <path>",
+              file=sys.stderr)
+        sys.exit(2)
+    from lzy_tpu.durable import OperationStore
+    from lzy_tpu.service.console import StatusConsole
+
+    store = OperationStore(args.db)
+    console = StatusConsole(store, port=args.port, bind_host=args.bind)
+    print(f"console on http://{console.address}/ (Ctrl-C to stop)")
+    try:
+        import threading
+
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        console.stop()
+        store.close()
 
 
 def main(argv=None) -> None:
@@ -97,40 +117,36 @@ def main(argv=None) -> None:
     )
     parser.add_argument("--db", default=os.environ.get("LZY_TPU_DB"),
                         help="metadata store path (or $LZY_TPU_DB)")
+    parser.add_argument("--address",
+                        default=os.environ.get("LZY_TPU_ADDRESS"),
+                        help="control-plane host:port for remote status")
+    parser.add_argument("--token", default=os.environ.get("LZY_TPU_TOKEN"),
+                        help="IAM token for --address deployments")
     parser.add_argument("--storage", default=os.environ.get("LZY_TPU_STORAGE"),
                         help="storage uri (whiteboards command)")
     sub = parser.add_subparsers(dest="command", required=True)
-    for name in ("executions", "graphs", "vms", "ops", "whiteboards"):
+    for name in ("executions", "graphs", "vms", "ops", "whiteboards",
+                 "version"):
         sub.add_parser(name)
-    version_parser = sub.add_parser("version")
+    serve = sub.add_parser("serve-console",
+                           help="serve the HTML/JSON status console")
+    serve.add_argument("--port", type=int, default=8788)
+    serve.add_argument("--bind", default="127.0.0.1",
+                       help="bind host; the console is UNAUTHENTICATED — "
+                            "expose beyond loopback only behind your own "
+                            "auth proxy")
     args = parser.parse_args(argv)
 
     if args.command == "version":
         from lzy_tpu import __version__
 
         print(__version__)
-        return
-
-    if args.command == "whiteboards" and args.storage:
-        cmd_whiteboards(None, args)
-        return
-
-    if not args.db:
-        print("pass --db <path> (or set LZY_TPU_DB)", file=sys.stderr)
-        sys.exit(2)
-    from lzy_tpu.durable import OperationStore
-
-    store = OperationStore(args.db)
-    try:
-        {
-            "executions": cmd_executions,
-            "graphs": cmd_graphs,
-            "vms": cmd_vms,
-            "ops": cmd_ops,
-            "whiteboards": cmd_whiteboards,
-        }[args.command](store, args)
-    finally:
-        store.close()
+    elif args.command == "whiteboards":
+        cmd_whiteboards(args)
+    elif args.command == "serve-console":
+        cmd_serve_console(args)
+    else:
+        cmd_status_view(args, args.command)
 
 
 if __name__ == "__main__":

@@ -147,6 +147,35 @@ class ControlPlaneServer:
 
             return handler
 
+        def h_get_status(p):
+            # read-only deployment views, scoped like the other read paths:
+            # INTERNAL sees everything; users see their OWN executions/graphs
+            # only; VM worker tokens get nothing (a compromised worker must
+            # not enumerate the deployment); anonymous only in open mode
+            from lzy_tpu.service import status as status_views
+
+            user = None
+            if iam is not None:
+                from lzy_tpu.iam import (
+                    AuthError, INTERNAL, WORKER, WORKFLOW_READ,
+                )
+
+                subject = iam.authenticate(p.get("token"))
+                iam.authorize(subject, WORKFLOW_READ)
+                if subject.kind == WORKER:
+                    raise AuthError(
+                        "worker credentials may not read deployment status"
+                    )
+                if subject.role != INTERNAL:
+                    if p["view"] not in status_views.USER_SCOPED_VIEWS:
+                        raise AuthError(
+                            f"view {p['view']!r} is operator-only "
+                            f"(INTERNAL role)"
+                        )
+                    user = subject.id
+            return {"rows": status_views.collect(cluster.store, p["view"],
+                                                 user=user)}
+
         handlers = {
             # workflow service
             "StartWorkflow": h_start,
@@ -182,6 +211,8 @@ class ControlPlaneServer:
             # allocator private (worker-only surface, VM-scoped)
             "RegisterVm": h_register_vm,
             "Heartbeat": h_heartbeat,
+            # status surface (CLI --address / console over RPC)
+            "GetStatus": h_get_status,
         }
         self._server = JsonRpcServer(handlers, port=port)
         self.address = self._server.address

@@ -234,6 +234,9 @@ REQUESTS: Dict[str, Schema] = {
     "Status": Schema("StatusRequest", {
         "op_id": f(str, required=True), **_TOKEN}),
     "Shutdown": Schema("ShutdownRequest", {**_TOKEN}),
+    # status surface
+    "GetStatus": Schema("GetStatusRequest", {
+        "view": f(str, required=True), **_TOKEN}),
 }
 
 def validate_request(method: str, payload: dict) -> None:

@@ -1,0 +1,159 @@
+"""Web console: HTML + JSON status surface over a deployment.
+
+Counterpart of the reference's ``lzy/site`` service + React ``frontend/``
+(task/execution listings). Redesigned dependency-free: a stdlib threaded
+HTTP server rendering server-side HTML from the shared status views
+(``lzy_tpu/service/status.py``), plus a JSON API and the Prometheus
+metrics exposition — enough for an operator dashboard on any deployment,
+including one running in a TPU pod, without shipping a JS toolchain.
+
+Routes: ``/`` (overview, auto-refresh), ``/api/<view>`` (JSON),
+``/healthz``, ``/metrics`` (Prometheus text).
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from lzy_tpu.service import status as status_views
+from lzy_tpu.utils.log import get_logger
+from lzy_tpu.utils.metrics import REGISTRY
+
+_LOG = get_logger(__name__)
+
+_COLUMNS = status_views.COLUMNS
+
+_STYLE = """
+body { font-family: system-ui, sans-serif; margin: 2rem; color: #1a1a2e; }
+h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.6rem; }
+table { border-collapse: collapse; width: 100%; font-size: 0.85rem; }
+th, td { text-align: left; padding: 0.3rem 0.7rem;
+         border-bottom: 1px solid #ddd; }
+th { background: #f4f4f8; }
+.status-ACTIVE, .status-RUNNING { color: #0a7d36; font-weight: 600; }
+.status-FAILED, .status-ABORTED { color: #c0261e; font-weight: 600; }
+.status-DONE, .status-COMPLETED, .status-FINISHED { color: #555; }
+.empty { color: #888; font-style: italic; }
+"""
+
+
+_fmt = status_views.fmt_cell
+
+
+def _render_table(view: str, rows: List[Dict[str, Any]]) -> str:
+    cols = _COLUMNS[view]
+    if not rows:
+        return f'<p class="empty">no {view}</p>'
+    head = "".join(f"<th>{html.escape(c)}</th>" for c in cols)
+    body = []
+    for row in rows:
+        cells = []
+        for c in cols:
+            v = _fmt(c, row.get(c))
+            css = f' class="status-{html.escape(v)}"' if c == "status" else ""
+            cells.append(f"<td{css}>{html.escape(v)}</td>")
+        body.append("<tr>" + "".join(cells) + "</tr>")
+    return (f"<table><thead><tr>{head}</tr></thead>"
+            f"<tbody>{''.join(body)}</tbody></table>")
+
+
+class StatusConsole:
+    """Serves the console over the deployment's metadata store."""
+
+    def __init__(self, store, port: int = 0, bind_host: str = "127.0.0.1",
+                 refresh_s: int = 5):
+        """The console is UNAUTHENTICATED (an operator tool for the control-
+        plane host), so it binds loopback by default; expose it network-wide
+        only deliberately (``bind_host="0.0.0.0"``) behind your own auth
+        proxy — the token-scoped alternative is the GetStatus RPC."""
+        self._store = store
+        self._bind_host = bind_host
+        self._refresh_s = refresh_s
+        console = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: N802
+                _LOG.debug("console: " + fmt, *args)
+
+            def do_GET(self):  # noqa: N802
+                try:
+                    console._route(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001 — console must not die
+                    _LOG.warning("console error on %s: %r", self.path, e)
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((bind_host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="status-console", daemon=True)
+        self._thread.start()
+
+    # -- routing ---------------------------------------------------------------
+
+    def _route(self, req: BaseHTTPRequestHandler) -> None:
+        path = req.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/":
+            self._send(req, 200, "text/html; charset=utf-8",
+                       self._render_home().encode())
+        elif path.startswith("/api/"):
+            view = path[len("/api/"):]
+            try:
+                rows = status_views.collect(self._store, view)
+            except KeyError as e:
+                self._send(req, 404, "application/json",
+                           json.dumps({"error": str(e)}).encode())
+                return
+            self._send(req, 200, "application/json",
+                       json.dumps({view: rows}).encode())
+        elif path == "/healthz":
+            self._send(req, 200, "text/plain", b"ok")
+        elif path == "/metrics":
+            self._send(req, 200, "text/plain; version=0.0.4",
+                       REGISTRY.exposition().encode())
+        else:
+            self._send(req, 404, "text/plain", b"not found")
+
+    @staticmethod
+    def _send(req: BaseHTTPRequestHandler, code: int, ctype: str,
+              body: bytes) -> None:
+        req.send_response(code)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    def _render_home(self) -> str:
+        sections = []
+        for view in ("executions", "graphs", "vms", "operations"):
+            rows = status_views.collect(self._store, view)
+            sections.append(f"<h2>{view} ({len(rows)})</h2>"
+                            + _render_table(view, rows))
+        return (
+            "<!doctype html><html><head>"
+            f'<meta http-equiv="refresh" content="{self._refresh_s}">'
+            "<title>lzy-tpu console</title>"
+            f"<style>{_STYLE}</style></head><body>"
+            "<h1>lzy-tpu deployment</h1>"
+            + "".join(sections)
+            + '<p><a href="/metrics">metrics</a></p>'
+            "</body></html>"
+        )
+
+    @property
+    def address(self) -> str:
+        host = "127.0.0.1" if self._bind_host in ("0.0.0.0", "") \
+            else self._bind_host
+        return f"{host}:{self.port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,123 @@
+"""Deployment status views: one data layer for CLI, RPC, and web console.
+
+The reference exposes deployment state through ``lzy/site`` + a React
+frontend; here the same rows back three surfaces — ``python -m lzy_tpu``
+(local store or ``--address`` against a live control plane), the
+``List*`` status RPCs, and the HTML/JSON console
+(``lzy_tpu/service/console.py``). Secrets (VM worker tokens) are stripped
+at this layer so no surface can leak them.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, Dict, List, Optional
+
+# column orders shared by the CLI tables and the web console
+COLUMNS = {
+    "executions": ["id", "workflow_name", "user", "status", "started_at",
+                   "graphs"],
+    "graphs": ["id", "workflow_name", "status", "tasks_done", "tasks_total",
+               "failed_task"],
+    "vms": ["id", "pool_label", "status", "gang_id", "host_index",
+            "gang_size", "heartbeat_ts"],
+    "operations": ["id", "kind", "status", "step"],
+}
+
+
+def fmt_cell(col: str, value: Any) -> str:
+    """Render one cell the same way on every surface."""
+    if value is None:
+        return "-"
+    if col.endswith("_ts") or col.endswith("_at"):
+        try:
+            return datetime.datetime.fromtimestamp(float(value)).strftime(
+                "%Y-%m-%d %H:%M:%S")
+        except (TypeError, ValueError, OSError):
+            return str(value)
+    return str(value)
+
+
+def executions(store, user: Optional[str] = None) -> List[Dict[str, Any]]:
+    rows = []
+    for eid, doc in sorted(store.kv_list("executions").items(),
+                           key=lambda kv: kv[1].get("started_at", 0)):
+        if user is not None and doc.get("user") != user:
+            continue
+        rows.append({
+            "id": eid,
+            "workflow_name": doc.get("workflow_name"),
+            "user": doc.get("user"),
+            "status": doc.get("status"),
+            "started_at": doc.get("started_at"),
+            "graphs": len(doc.get("graphs", [])),
+        })
+    return rows
+
+
+def graphs(store, user: Optional[str] = None) -> List[Dict[str, Any]]:
+    rows = []
+    for doc in store.kv_list("executions").values():
+        if user is not None and doc.get("user") != user:
+            continue
+        for graph_op_id in doc.get("graphs", []):
+            try:
+                record = store.load(graph_op_id)
+            except KeyError:
+                continue
+            tasks = record.state.get("tasks", {})
+            rows.append({
+                "id": graph_op_id,
+                "workflow_name": doc.get("workflow_name"),
+                "status": record.status,
+                "tasks_done": sum(1 for t in tasks.values()
+                                  if t["status"] == "COMPLETED"),
+                "tasks_total": len(tasks),
+                "failed_task": record.state.get("failed_task"),
+            })
+    return rows
+
+
+def vms(store) -> List[Dict[str, Any]]:
+    rows = []
+    for vm_id, doc in sorted(store.kv_list("vms").items()):
+        rows.append({
+            "id": vm_id,
+            "pool_label": doc.get("pool_label"),
+            "status": doc.get("status"),
+            "gang_id": doc.get("gang_id"),
+            "host_index": doc.get("host_index"),
+            "gang_size": doc.get("gang_size"),
+            "heartbeat_ts": doc.get("heartbeat_ts"),
+            # worker_token is a credential: never crosses a status surface
+        })
+    return rows
+
+
+def operations(store) -> List[Dict[str, Any]]:
+    return [{"id": r.id, "kind": r.kind, "status": r.status, "step": r.step}
+            for r in store.running_ops()]
+
+
+VIEWS = {
+    "executions": executions,
+    "graphs": graphs,
+    "vms": vms,
+    "operations": operations,
+}
+
+# views that can be scoped to one user; the rest (vms, operations) expose
+# deployment-wide infrastructure and are operator-only under IAM
+USER_SCOPED_VIEWS = ("executions", "graphs")
+
+
+def collect(store, view: str,
+            user: Optional[str] = None) -> List[Dict[str, Any]]:
+    try:
+        fn = VIEWS[view]
+    except KeyError:
+        raise KeyError(f"unknown status view {view!r}; "
+                       f"known: {sorted(VIEWS)}")
+    if view in USER_SCOPED_VIEWS:
+        return fn(store, user)
+    return fn(store)

@@ -1,0 +1,159 @@
+"""Status surfaces: web console (HTML + JSON + metrics), GetStatus RPC, and
+the CLI against a remote control plane (reference lzy/site + frontend
+parity)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from lzy_tpu import op
+from lzy_tpu.service import InProcessCluster
+from lzy_tpu.service.console import StatusConsole
+
+
+@op
+def console_double(x: int) -> int:
+    return x * 2
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = InProcessCluster(db_path=str(tmp_path / "meta.db"))
+    lzy = c.lzy()
+    with lzy.workflow("console-wf"):
+        assert int(console_double(21)) == 42
+    yield c
+    c.shutdown()
+
+
+def get(console, path):
+    with urllib.request.urlopen(f"http://{console.address}{path}") as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestWebConsole:
+    def test_overview_and_json_api(self, cluster):
+        console = StatusConsole(cluster.store, bind_host="127.0.0.1")
+        try:
+            status, home = get(console, "/")
+            assert status == 200
+            assert "console-wf" in home and "executions" in home
+
+            status, body = get(console, "/api/executions")
+            rows = json.loads(body)["executions"]
+            assert status == 200 and len(rows) == 1
+            assert rows[0]["workflow_name"] == "console-wf"
+            assert rows[0]["status"] == "FINISHED"
+
+            _, body = get(console, "/api/graphs")
+            g = json.loads(body)["graphs"][0]
+            assert g["tasks_done"] == g["tasks_total"] == 1
+
+            status, body = get(console, "/healthz")
+            assert (status, body) == (200, "ok")
+
+            status, body = get(console, "/metrics")
+            assert status == 200 and "lzy_" in body
+        finally:
+            console.stop()
+
+    def test_vm_rows_never_carry_tokens(self, tmp_path):
+        c = InProcessCluster(db_path=str(tmp_path / "m.db"), with_iam=True)
+        token = c.iam.create_subject("alice")
+        lzy = c.lzy(token=token)
+        console = StatusConsole(c.store, bind_host="127.0.0.1")
+        try:
+            # sample while the workflow is open: VMs are alive and their
+            # records (with worker_token) sit in the store
+            with lzy.workflow("tok-wf"):
+                assert int(console_double(2)) == 4
+                _, body = get(console, "/api/vms")
+                rows = json.loads(body)["vms"]
+                assert rows, "expected at least one VM"
+                assert all("worker_token" not in r for r in rows)
+                vm_tokens = [v.worker_token for v in c.allocator.vms()]
+                assert vm_tokens and all(t for t in vm_tokens)
+                assert all(t not in body for t in vm_tokens)
+                _, home = get(console, "/")
+                assert all(t not in home for t in vm_tokens)
+        finally:
+            console.stop()
+            c.shutdown()
+
+    def test_unknown_view_404(self, cluster):
+        console = StatusConsole(cluster.store, bind_host="127.0.0.1")
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                get(console, "/api/nonsense")
+            assert e.value.code == 404
+        finally:
+            console.stop()
+
+
+class TestRemoteCli:
+    def test_cli_against_live_control_plane(self, cluster, capsys):
+        from lzy_tpu.__main__ import main
+
+        server = cluster.serve()
+        main(["--address", server.address, "executions"])
+        out = capsys.readouterr().out
+        assert "console-wf" in out and "FINISHED" in out
+
+        main(["--address", server.address, "graphs"])
+        out = capsys.readouterr().out
+        assert "console-wf" in out and "DONE" in out
+
+    def test_remote_status_requires_token_with_iam(self, tmp_path, capsys):
+        from lzy_tpu.iam import AuthError
+        from lzy_tpu.__main__ import main
+
+        c = InProcessCluster(db_path=str(tmp_path / "m.db"), with_iam=True)
+        server = c.serve()
+        try:
+            with pytest.raises(AuthError):
+                main(["--address", server.address, "executions"])
+            token = c.iam.create_subject("reader", role="READER")
+            main(["--address", server.address, "--token", token,
+                  "executions"])
+            assert "EXECUTION" in capsys.readouterr().out
+        finally:
+            c.shutdown()
+
+    def test_remote_status_is_scoped_per_user(self, tmp_path, capsys):
+        """GetStatus honours the same ownership scoping as the other read
+        paths: users see their OWN executions; infrastructure views are
+        operator-only; worker tokens see nothing."""
+        from lzy_tpu.iam import AuthError, INTERNAL
+        from lzy_tpu.__main__ import main
+
+        c = InProcessCluster(db_path=str(tmp_path / "m.db"), with_iam=True)
+        alice = c.iam.create_subject("alice")
+        bob = c.iam.create_subject("bob")
+        operator = c.iam.create_subject("ops", role=INTERNAL)
+        for user, token in (("alice", alice), ("bob", bob)):
+            lzy = c.lzy(user=user, token=token)
+            with lzy.workflow(f"wf-{user}"):
+                assert int(console_double(3)) == 6
+        server = c.serve()
+        try:
+            main(["--address", server.address, "--token", alice,
+                  "executions"])
+            out = capsys.readouterr().out
+            assert "wf-alice" in out and "wf-bob" not in out
+
+            main(["--address", server.address, "--token", operator,
+                  "executions"])
+            out = capsys.readouterr().out
+            assert "wf-alice" in out and "wf-bob" in out
+
+            with pytest.raises(AuthError, match="operator-only"):
+                main(["--address", server.address, "--token", alice, "vms"])
+
+            worker_tokens = [v.worker_token for v in c.allocator.vms()]
+            if worker_tokens:
+                with pytest.raises(AuthError, match="worker credentials"):
+                    main(["--address", server.address,
+                          "--token", worker_tokens[0], "executions"])
+        finally:
+            c.shutdown()

@@ -210,7 +210,10 @@ class TestCli:
         assert "FINISHED" in result.stdout
         result = self.run_cli("--db", db, "graphs")
         assert result.returncode == 0
-        assert "1/1" in result.stdout
+        import re
+
+        # a real row: the graph op DONE with 1/1 tasks (not just the header)
+        assert re.search(r"cli-wf\s+DONE\s+1\s+1", result.stdout), result.stdout
 
     def test_missing_db_errors(self):
         result = self.run_cli("executions")
@@ -231,7 +234,9 @@ class TestWorkerTokenRefresh:
 
         store = OperationStore(str(tmp_path / "m.db"))
         executor = OperationsExecutor(store, workers=1)
-        iam = IamService(store, max_token_age_s=1.0)
+        # half-life 2 s with ≥1 s slack on both sides: issued_at truncates
+        # to whole seconds, so a sub-second margin would be flaky
+        iam = IamService(store, max_token_age_s=4.0)
         svc = AllocatorService(
             store, executor, ThreadVmBackend(None, None),
             [VmSpec(label="cpu", cpu_count=1, ram_gb=1)], iam=iam,
@@ -241,7 +246,7 @@ class TestWorkerTokenRefresh:
                 gang_id="g", host_index=0, gang_size=1, worker_token=tok)
         svc._vms[vm.id] = vm
         assert svc.refresh_worker_token("vm-1") is None  # inside half-life
-        time.sleep(1.1)                                  # past 0.5 * 1.0s
+        time.sleep(3.1)                                  # past 0.5 * 4.0s
         fresh = svc.refresh_worker_token("vm-1")
         assert fresh and fresh != tok
         assert iam.authenticate(fresh).id == "vm/vm-1"
