@@ -282,12 +282,37 @@ class WorkerAgent:
             from lzy_tpu.utils.env import applied_env_vars
 
             with applied_env_vars(task.env_vars):
-                if task.container:
-                    result = self._run_in_container(
-                        task, func, args, kwargs, extra_paths=module_dirs
-                    )
-                else:
-                    result = func(*args, **kwargs)
+                from lzy_tpu.utils.trace import PROFILE_ENV, profile_enabled
+
+                profile_ctx = contextlib.nullcontext()
+                if profile_enabled(task.env_vars) and task.std_logs_uri:
+                    if task.container:
+                        # the op runs in a separate container process; a
+                        # host-side jax trace would capture nothing and
+                        # upload a blank profile
+                        _LOG.warning(
+                            "%s=1 ignored for containerized op %s: profile "
+                            "inside the image instead", PROFILE_ENV,
+                            task.name,
+                        )
+                    else:
+                        # op-level XLA profiling as a platform feature:
+                        # artifacts land next to the run's logs
+                        from lzy_tpu.utils.trace import profiled
+
+                        profile_ctx = profiled(
+                            upload_prefix=join_uri(
+                                task.std_logs_uri, "traces", task.id),
+                            storage=self._storage,
+                        )
+                with profile_ctx:
+                    if task.container:
+                        result = self._run_in_container(
+                            task, func, args, kwargs,
+                            extra_paths=module_dirs,
+                        )
+                    else:
+                        result = func(*args, **kwargs)
 
             if gang_rank != 0:
                 # SPMD convention (reference worker + jax multi-host alike):

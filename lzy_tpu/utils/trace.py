@@ -1,0 +1,96 @@
+"""JAX profiler integration (SURVEY §5.1 tracing).
+
+The reference relies on its Java services' logging/tracing; the TPU build's
+equivalent observability question is "where did the step time go on the
+chip" — answered by the XLA profiler. This module makes profiling a
+platform feature rather than a notebook trick:
+
+- :func:`profiled` — capture a trace around any code region, optionally
+  uploading the TensorBoard-ready artifacts to workflow storage, so traces
+  from remote workers land next to the run's logs;
+- :func:`annotate_step` — mark train-loop steps so the trace viewer groups
+  device work per step;
+- worker integration: set ``LZY_PROFILE=1`` on an op's env
+  (``op.with_env_vars({"LZY_PROFILE": "1"})``) and the worker wraps the op
+  body in a trace whose artifacts are uploaded under the execution's
+  ``traces/`` prefix — retrieve with any storage client and open in
+  TensorBoard/XProf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+from typing import Iterator, Optional
+
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+PROFILE_ENV = "LZY_PROFILE"
+
+
+def profile_enabled(env_vars) -> bool:
+    """True only for conventional truthy values — ``LZY_PROFILE=0``/"false"
+    must DISABLE profiling, not enable it via string truthiness."""
+    value = (env_vars or {}).get(PROFILE_ENV, "")
+    return str(value).strip().lower() in ("1", "true", "yes", "on")
+
+
+@contextlib.contextmanager
+def profiled(logdir: Optional[str] = None, *,
+             upload_prefix: Optional[str] = None,
+             storage=None) -> Iterator[str]:
+    """Capture a JAX/XLA profiler trace around the block.
+
+    Yields the local trace directory. With ``upload_prefix`` + ``storage``
+    (a StorageClient), every produced artifact is uploaded under that prefix
+    after capture — profiling must never fail the traced computation, so
+    capture/upload errors are logged and swallowed.
+    """
+    import jax
+
+    logdir = logdir or tempfile.mkdtemp(prefix="lzy_trace_")
+    started = False
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception as e:  # noqa: BLE001 — observability is best-effort
+        _LOG.warning("profiler start failed: %r", e)
+    try:
+        yield logdir
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001
+                _LOG.warning("profiler stop failed: %r", e)
+            if upload_prefix and storage is not None:
+                _upload_dir(storage, logdir, upload_prefix)
+
+
+def annotate_step(step: int, name: str = "train"):
+    """Step marker for the trace viewer's per-step grouping:
+    ``with annotate_step(i): state, _ = train_step(state, batch)``."""
+    import jax
+
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+
+def _upload_dir(storage, local_dir: str, prefix: str) -> int:
+    from lzy_tpu.storage.api import join_uri
+
+    n = 0
+    for root, _, files in os.walk(local_dir):
+        for fname in files:
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, local_dir)
+            try:
+                with open(path, "rb") as f:
+                    storage.write_bytes(join_uri(prefix, rel), f.read())
+                n += 1
+            except Exception as e:  # noqa: BLE001
+                _LOG.warning("trace upload of %s failed: %r", rel, e)
+    _LOG.info("uploaded %d trace artifacts to %s", n, prefix)
+    return n
