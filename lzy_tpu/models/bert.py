@@ -31,6 +31,7 @@ class BertConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False
+    use_flash_kernel: bool = False  # Pallas flash path with padding masks
 
     @property
     def head_dim(self) -> int:
@@ -74,11 +75,18 @@ class EncoderLayer(nn.Module):
         v = _dense(cfg, (h, d), "v_proj", ("embed", "heads", "head_dim"))(x)
         q, k, v = (jnp.transpose(a, (0, 2, 1, 3)) for a in (q, k, v))
 
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                       preferred_element_type=jnp.float32) * (d ** -0.5)
-        s = jnp.where(attn_mask[:, None, None, :], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1).astype(cfg.dtype)
-        attn = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        if cfg.use_flash_kernel and t % 128 == 0:
+            # Pallas flash path: the padding mask rides into the kernel as a
+            # KV bias, so the T×T score matrix never materializes
+            from lzy_tpu.ops.flash_attention import flash_attention
+
+            attn = flash_attention(q, k, v, causal=False, kv_mask=attn_mask)
+        else:
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                           preferred_element_type=jnp.float32) * (d ** -0.5)
+            s = jnp.where(attn_mask[:, None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(cfg.dtype)
+            attn = jnp.einsum("bhqk,bhkd->bhqd", p, v)
         attn = jnp.transpose(attn, (0, 2, 1, 3)).reshape(b, t, h * d)
         attn = nn.DenseGeneral(
             features=cfg.d_model, name="o_proj",

@@ -56,8 +56,11 @@ def _pick_block(t: int, requested: int) -> int:
 # -- forward --------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_q, block_kv, seq_len):
+def _fwd_kernel(*refs, scale, causal, masked, block_q, block_kv, seq_len):
+    if masked:
+        q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref), bias_ref = refs, None
     iq = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale            # [bq, d]
     q_start = iq * block_q
@@ -79,6 +82,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                                # [bq, bkv]
+        if bias_ref is not None:
+            # additive KV bias (0 keep / -inf drop), one lane per position
+            b_col = bias_ref[0, pl.ds(j * block_kv, block_kv), 0]
+            s = s + b_col[None, :]
         if causal:
             rows = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = j * block_kv + lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -103,21 +110,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0] = jnp.broadcast_to(lse[:, None], (block_q, d))
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_kv, interpret):
+def _fwd(q, k, v, bias, *, scale, causal, block_q, block_kv, interpret,
+         n_heads):
     bh, t, d = q.shape
     n_q = t // block_q
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
+        _fwd_kernel, scale=scale, causal=causal, masked=bias is not None,
         block_q=block_q, block_kv=block_kv, seq_len=t,
     )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+    ]
+    operands = [q, k, v]
+    if bias is not None:
+        # bias is per-BATCH [b, t, LANE]; grid dim 0 walks batch·heads
+        in_specs.append(pl.BlockSpec(
+            (1, t, _LANE), lambda b, i: (b // n_heads, 0, 0)))
+        operands.append(bias)
     o, lse_bcast = pl.pallas_call(
         kernel,
         grid=(bh, n_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -127,15 +142,20 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_kv, interpret):
             jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     return o, lse_bcast[:, :, 0]                          # [bh, t]
 
 
 # -- backward -------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   scale, causal, block_q, block_kv, seq_len):
+def _bwd_dq_kernel(*refs, scale, causal, masked, block_q, block_kv, seq_len):
+    if masked:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+         dq_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref) = refs
+        bias_ref = None
     iq = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
@@ -156,9 +176,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             q * scale, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if bias_ref is not None:
+            b_col = bias_ref[0, pl.ds(j * block_kv, block_kv), 0]
+            s = s + b_col[None, :]
         rows = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = j * block_kv + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         p = jnp.exp(s - lse)
+        # fully-masked rows store lse = -inf, which would cancel the -inf
+        # bias (s - (-inf) + (-inf) = s) and resurrect p; their softmax had
+        # no mass, so their gradient is exactly zero
+        p = jnp.where(lse > _NEG_INF / 2, p, 0.0)
         if causal:
             p = jnp.where(rows >= cols, p, 0.0)
         dp = jax.lax.dot_general(
@@ -175,9 +202,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q, block_kv,
+def _bwd_dkv_kernel(*refs, scale, causal, masked, block_q, block_kv,
                     seq_len):
+    if masked:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+         dk_ref, dv_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+         dv_ref) = refs
+        bias_ref = None
     jkv = pl.program_id(1)
     k_blk = k_ref[0].astype(jnp.float32)                  # [bkv, d]
     v_blk = v_ref[0].astype(jnp.float32)
@@ -198,9 +231,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_blk * scale, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                                 # [bq, bkv]
+        if bias_ref is not None:
+            # this kernel's whole KV block shares one bias slice
+            s = s + bias_ref[0, :, 0][None, :]
         rows = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = kv_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         p = jnp.exp(s - lse_blk)
+        # same empty-row guard as the dQ kernel (see comment there)
+        p = jnp.where(lse_blk > _NEG_INF / 2, p, 0.0)
         if causal:
             p = jnp.where(rows >= cols, p, 0.0)
         dv_new = dv + jax.lax.dot_general(
@@ -225,7 +263,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_kv, interpret):
+def _bwd(q, k, v, bias, o, lse, do, *, scale, causal, block_q, block_kv,
+         interpret, n_heads):
     bh, t, d = q.shape
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
@@ -234,40 +273,53 @@ def _bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_kv, interpret):
     # (same layout jax's reference TPU flash kernel uses for l/m residuals)
     lse_t = jnp.broadcast_to(lse[:, :, None], (bh, t, d))
     delta_t = jnp.broadcast_to(delta[:, :, None], (bh, t, d))
+    masked = bias is not None
 
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # q
+        pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),          # k
+        pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),          # v
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # do
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # lse
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # delta
+    ]
+    dq_operands = [q, k, v, do, lse_t, delta_t]
+    if masked:
+        dq_specs.append(pl.BlockSpec(
+            (1, t, _LANE), lambda b, i: (b // n_heads, 0, 0)))
+        dq_operands.append(bias)
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal,
+            _bwd_dq_kernel, scale=scale, causal=causal, masked=masked,
             block_q=block_q, block_kv=block_kv, seq_len=t,
         ),
         grid=(bh, t // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # q
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),          # k
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),          # v
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # do
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # lse
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # delta
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         interpret=interpret,
-    )(q, k, v, do, lse_t, delta_t)
+    )(*dq_operands)
 
+    dkv_specs = [
+        pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),          # q
+        pl.BlockSpec((1, block_kv, d), lambda b, j: (b, j, 0)),  # k
+        pl.BlockSpec((1, block_kv, d), lambda b, j: (b, j, 0)),  # v
+        pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),          # do
+        pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),          # lse
+        pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),          # delta
+    ]
+    dkv_operands = [q, k, v, do, lse_t, delta_t]
+    if masked:
+        dkv_specs.append(pl.BlockSpec(
+            (1, block_kv, _LANE), lambda b, j: (b // n_heads, j, 0)))
+        dkv_operands.append(bias)
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal,
+            _bwd_dkv_kernel, scale=scale, causal=causal, masked=masked,
             block_q=block_q, block_kv=block_kv, seq_len=t,
         ),
         grid=(bh, t // block_kv),
-        in_specs=[
-            pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),          # q
-            pl.BlockSpec((1, block_kv, d), lambda b, j: (b, j, 0)),  # k
-            pl.BlockSpec((1, block_kv, d), lambda b, j: (b, j, 0)),  # v
-            pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),          # do
-            pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),          # lse
-            pl.BlockSpec((1, t, d), lambda b, j: (b, 0, 0)),          # delta
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_kv, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_kv, d), lambda b, j: (b, j, 0)),
@@ -277,7 +329,7 @@ def _bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_kv, interpret):
             jax.ShapeDtypeStruct((bh, t, d), v.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse_t, delta_t)
+    )(*dkv_operands)
     return dq, dk, dv
 
 
@@ -285,25 +337,31 @@ def _bwd(q, k, v, o, lse, do, *, scale, causal, block_q, block_kv, interpret):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9)
 )
-def _flash(q, k, v, scale, causal, block_q, block_kv, interpret):
-    o, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
-                block_kv=block_kv, interpret=interpret)
+def _flash(q, k, v, bias, scale, causal, block_q, block_kv, interpret,
+           n_heads):
+    o, _ = _fwd(q, k, v, bias, scale=scale, causal=causal, block_q=block_q,
+                block_kv=block_kv, interpret=interpret, n_heads=n_heads)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_kv, interpret):
-    o, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q,
-                  block_kv=block_kv, interpret=interpret)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_kv, interpret,
+               n_heads):
+    o, lse = _fwd(q, k, v, bias, scale=scale, causal=causal, block_q=block_q,
+                  block_kv=block_kv, interpret=interpret, n_heads=n_heads)
+    return o, (q, k, v, bias, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_kv, interpret, res, do):
-    q, k, v, o, lse = res
-    dq, dk, dv = _bwd(q, k, v, o, lse, do, scale=scale, causal=causal,
-                      block_q=block_q, block_kv=block_kv, interpret=interpret)
-    return dq, dk, dv
+def _flash_bwd(scale, causal, block_q, block_kv, interpret, n_heads, res,
+               do):
+    q, k, v, bias, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, bias, o, lse, do, scale=scale, causal=causal,
+                      block_q=block_q, block_kv=block_kv,
+                      interpret=interpret, n_heads=n_heads)
+    # bias encodes a boolean mask; its cotangent is structurally zero
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -315,13 +373,20 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = True,
+    kv_mask: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     block_q: int = 512,
     block_kv: int = 512,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """q/k/v: [B, H, T, D] → [B, H, T, D]. T must be a multiple of 128 (TPU
-    lane tiling) and of the block sizes."""
+    lane tiling) and of the block sizes.
+
+    ``kv_mask``: optional [B, T] boolean — True = attend to that KV position
+    (padding masks for encoder models). Carried into the kernels as an
+    additive 0/-inf bias, one 128-lane slab per batch row; fully-masked
+    query rows produce zero output and zero gradients.
+    """
     b, h, t, d = q.shape
     scale = scale if scale is not None else d ** -0.5
     if t % _LANE:
@@ -330,7 +395,16 @@ def flash_attention(
     block_kv = _pick_block(t, block_kv)
     interpret = _interpret_default() if interpret is None else interpret
 
+    bias = None
+    if kv_mask is not None:
+        if kv_mask.shape != (b, t):
+            raise ValueError(
+                f"kv_mask shape {kv_mask.shape} != (batch, seq) = {(b, t)}"
+            )
+        bias = jnp.where(kv_mask, 0.0, _NEG_INF).astype(jnp.float32)
+        bias = jnp.broadcast_to(bias[:, :, None], (b, t, _LANE))
+
     flat = lambda x: x.reshape(b * h, t, d)  # noqa: E731
-    o = _flash(flat(q), flat(k), flat(v), scale, causal, block_q, block_kv,
-               interpret)
+    o = _flash(flat(q), flat(k), flat(v), bias, scale, causal, block_q,
+               block_kv, interpret, h)
     return o.reshape(b, h, t, d)
