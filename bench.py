@@ -217,6 +217,57 @@ def run() -> None:
     if extra:
         detail.update(extra)
         emit()
+    if platform in ("tpu", "axon"):
+        extra = seq4k_measurement(jax, cfg, mesh, n_params)
+        if extra:
+            detail.update(extra)
+            emit()
+
+
+def seq4k_measurement(jax, cfg, mesh, n_params, steps: int = 10):
+    """Best-effort long-context point (VERDICT r1 #9): MFU at seq 4096,
+    batch halved to keep HBM flat. Never risks the headline metric."""
+    try:
+        import dataclasses
+
+        import optax
+
+        from lzy_tpu.models import llama, unbox
+        from lzy_tpu.parallel import TrainState, make_train_step, mfu
+
+        _log("seq4k: building model...")
+        cfg4k = dataclasses.replace(cfg, max_seq_len=4096)
+        batch_size, seq_len = 4, 4096
+        boxed, axes = llama.init_params(cfg4k, jax.random.PRNGKey(0))
+        tx = optax.adamw(3e-4)
+        step, shard_state, _ = make_train_step(
+            llama.make_loss_fn(cfg4k), tx, mesh=mesh,
+            param_logical_axes=axes, batch_logical_axes=("batch", "seq"),
+        )
+        state = shard_state(TrainState.create(unbox(boxed), tx))
+        batch = {"tokens": jax.random.randint(
+            jax.random.PRNGKey(1), (batch_size, seq_len), 0, cfg4k.vocab_size
+        )}
+        _log("seq4k: compiling + warmup...")
+        for _ in range(2):
+            state, metrics = step(state, batch)
+        float(metrics["loss"])
+        _log(f"seq4k: timing {steps} steps...")
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, batch)
+        float(metrics["loss"])
+        dt = time.perf_counter() - t0
+        tokens_per_s = batch_size * seq_len * steps / dt
+        # same chip count as the headline metric, or the two aren't comparable
+        value = mfu(tokens_per_s, n_params, len(jax.devices()), chip="v5e")
+        _log(f"seq4k: {1000 * dt / steps:.1f} ms/step, mfu {value:.4f}")
+        return {"seq4k_mfu": round(value, 4),
+                "seq4k_step_time_ms": round(1000 * dt / steps, 2),
+                "seq4k_batch": batch_size}
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        _log(f"seq4k skipped: {type(e).__name__}: {e}")
+        return {}
 
 
 def step_breakdown(jax, loss_fn, state, batch, mesh, step_ms: float, n: int = 5):

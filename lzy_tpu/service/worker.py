@@ -471,7 +471,11 @@ class WorkerAgent:
                 ))
             except Exception:
                 _LOG.warning("p2p spill of %s failed; storage only", ref.id)
-        self._storage.write_bytes(ref.uri, data)
+        # multipart + retries for big values (multi-GB arrays), one retried
+        # write for small ones
+        from lzy_tpu.storage.transfer import upload_bytes
+
+        upload_bytes(self._storage, ref.uri, data)
         from lzy_tpu.utils import hashing
 
         scheme = serializer.data_scheme(value)

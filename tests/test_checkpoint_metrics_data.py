@@ -217,3 +217,58 @@ class TestDataPipeline:
         assert len(a) == 3
         for x, y in zip(a, b):
             np.testing.assert_array_equal(x["tokens"], y["tokens"])
+
+
+class TestShardedCheckpoint:
+    """Multi-host-correct checkpoints: every GLOBAL shard written exactly
+    once by its replica-0 holder, manifest published after a barrier,
+    restore reads only the shards the target sharding needs (with a
+    full-assembly fallback for resharded restores)."""
+
+    def make_mesh(self, shape, names):
+        import numpy as np
+        from jax.sharding import Mesh
+
+        return Mesh(np.array(jax.devices()).reshape(shape), names)
+
+    def test_round_trip_and_reshard(self):
+        import numpy as np
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from lzy_tpu.parallel.checkpoint import CheckpointManager
+        from lzy_tpu.storage.mem import MemStorageClient
+
+        mesh = self.make_mesh((4, 2), ("dp", "tp"))
+        sh = NamedSharding(mesh, P("dp", "tp"))
+        rep = NamedSharding(mesh, P())
+        state = {
+            "w": jax.device_put(jnp.arange(64.0).reshape(8, 8), sh),
+            "b": jax.device_put(jnp.float32(3.5), rep),
+        }
+        client = MemStorageClient()
+        mgr = CheckpointManager(client, "mem://ck", "m")
+        mgr.save_sharded(state, 7, metrics={"loss": 1.0})
+        assert mgr.latest_step() == 7
+        assert mgr.manifest(7)["sharded"] is True
+
+        # 8 distinct shards for w (4x2 partitioning), ONE object for the
+        # replicated scalar — replica dedup wrote each global shard once
+        shard_uris = list(client.list("mem://ck/lzy_checkpoints/m/"))
+        w_shards = [u for u in shard_uris if "/shards/" in u and "w" in u]
+        b_shards = [u for u in shard_uris if "/shards/" in u and "b" in u]
+        assert len(w_shards) == 8 and len(b_shards) == 1
+
+        out = mgr.restore_sharded({"w": sh, "b": rep})
+        np.testing.assert_array_equal(
+            np.asarray(out["w"]), np.arange(64.0).reshape(8, 8))
+        assert float(out["b"]) == 3.5 and out["w"].sharding == sh
+
+        # restore under a DIFFERENT layout exercises the assemble fallback
+        mesh2 = self.make_mesh((2, 4), ("dp", "tp"))
+        sh2 = NamedSharding(mesh2, P("tp", "dp"))
+        out2 = mgr.restore_sharded({"w": sh2,
+                                    "b": NamedSharding(mesh2, P())})
+        np.testing.assert_array_equal(
+            np.asarray(out2["w"]), np.arange(64.0).reshape(8, 8))
+        assert out2["w"].sharding == sh2
