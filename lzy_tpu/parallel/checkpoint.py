@@ -151,12 +151,10 @@ class CheckpointManager:
 
     @staticmethod
     def _shard_key(index, shape) -> str:
-        parts = []
-        for sl, dim in zip(index, shape):
-            start = 0 if sl.start is None else sl.start
-            stop = dim if sl.stop is None else sl.stop
-            parts.append(f"{start}_{stop}")
-        return "-".join(parts) or "scalar"
+        # one encode/decode scheme for checkpoints AND channel spills
+        from lzy_tpu.channels.sharded_spill import _shard_key
+
+        return _shard_key(index, shape)
 
     def save_sharded(self, state: Any, step: int, *,
                      metrics: Optional[Dict] = None) -> str:
@@ -187,7 +185,7 @@ class CheckpointManager:
                          "dtype": str(arr.dtype)}
             shards = getattr(arr, "addressable_shards", None)
             if not shards:
-                jobs.append((key, "full", np.asarray(arr)))
+                jobs.append((key, "full", arr))
                 continue
             for shard in shards:
                 if shard.replica_id != 0:
@@ -195,13 +193,16 @@ class CheckpointManager:
                 jobs.append((
                     key,
                     self._shard_key(shard.index, arr.shape),
-                    np.asarray(shard.data),
+                    shard.data,
                 ))
 
         def put(job):
             key, shard_key, data = job
             buf = io.BytesIO()
-            ser.serialize(data, buf)
+            # device→host copy happens HERE, bounded by the pool width —
+            # materializing every shard up front would peak host RAM at the
+            # full state size
+            ser.serialize(np.asarray(data), buf)
             upload_bytes(self._client,
                          join_uri(uri, "shards", key, shard_key),
                          buf.getvalue())
@@ -272,6 +273,7 @@ class CheckpointManager:
                 src.close()
 
         def assemble_full(key, shape, dtype):
+            from lzy_tpu.channels.sharded_spill import parse_shard_key
             from lzy_tpu.serialization.jax_ser import _resolve_dtype
 
             out = np.zeros(shape, dtype=_resolve_dtype(dtype))
@@ -281,11 +283,7 @@ class CheckpointManager:
                 data = read_shard(key, shard_key)
                 if shard_key in ("full", "scalar"):
                     return np.asarray(data)
-                idx = tuple(
-                    slice(int(a), int(b))
-                    for a, b in (p.split("_") for p in shard_key.split("-"))
-                )
-                out[idx] = data
+                out[parse_shard_key(shard_key)] = data
             return out
 
         def restore_leaf(path, sharding):
@@ -295,6 +293,7 @@ class CheckpointManager:
             dtype = info["dtype"]
             index_map = sharding.addressable_devices_indices_map(shape)
             arrays = []
+            shard_cache = {}   # replicated leaves: one download, N placements
             for device, index in index_map.items():
                 norm = tuple(
                     slice(0 if s.start is None else s.start,
@@ -303,15 +302,17 @@ class CheckpointManager:
                 ) if index else ()
                 shard_key = self._shard_key(norm, shape)
                 shard_uri = join_uri(uri, "shards", key, shard_key)
-                if not self._client.exists(shard_uri):
-                    # target sharding slices differently than the saved one:
-                    # assemble the full leaf and let device_put re-shard
-                    full = assemble_full(key, shape, dtype)
-                    return jax.device_put(full, sharding)
-                shard_shape = tuple(s.stop - s.start for s in norm)
-                data = np.asarray(read_shard(key, shard_key)).reshape(
-                    shard_shape)
-                arrays.append(jax.device_put(data, device))
+                if shard_key not in shard_cache:
+                    if not self._client.exists(shard_uri):
+                        # target sharding slices differently than the saved
+                        # one: assemble the full leaf and let device_put
+                        # re-shard
+                        full = assemble_full(key, shape, dtype)
+                        return jax.device_put(full, sharding)
+                    shard_shape = tuple(s.stop - s.start for s in norm)
+                    shard_cache[shard_key] = np.asarray(
+                        read_shard(key, shard_key)).reshape(shard_shape)
+                arrays.append(jax.device_put(shard_cache[shard_key], device))
             return jax.make_array_from_single_device_arrays(
                 shape, sharding, arrays)
 

@@ -93,10 +93,14 @@ def default_registry() -> SerializerRegistry:
     from lzy_tpu.serialization.file_ser import FileSerializer
     from lzy_tpu.serialization.jax_ser import JaxArraySerializer, ArrayPytreeSerializer
 
+    from lzy_tpu.channels.sharded_spill import ShardedArrayManifestSerializer
+
     reg = SerializerRegistry()
     reg.register(PrimitiveSerializer())
     reg.register(FileSerializer())
     reg.register(JaxArraySerializer())
     reg.register(ArrayPytreeSerializer())
+    # deserialize-only: global sharded-array manifests (gang spill protocol)
+    reg.register(ShardedArrayManifestSerializer())
     reg.register(CloudpickleSerializer())  # universal fallback, lowest priority
     return reg

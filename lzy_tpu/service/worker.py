@@ -314,11 +314,6 @@ class WorkerAgent:
                     else:
                         result = func(*args, **kwargs)
 
-            if gang_rank != 0:
-                # SPMD convention (reference worker + jax multi-host alike):
-                # every host computes, host 0 alone publishes the outputs
-                return
-
             n_out = len(task.outputs)
             outputs = (result if n_out > 1 and isinstance(result, tuple)
                        else (result,))
@@ -327,6 +322,26 @@ class WorkerAgent:
                     f"op {task.name}() returned {len(outputs)} values, "
                     f"declared {n_out}"
                 )
+
+            if gang_rank != 0:
+                # SPMD convention (reference worker + jax multi-host alike):
+                # every host computes, host 0 alone publishes — EXCEPT that
+                # global sharded outputs need every rank's shards (no single
+                # process holds them all), so non-zero ranks spill theirs
+                # and join the per-entry barrier rank 0 also passes
+                from lzy_tpu.channels.sharded_spill import (
+                    is_global_array,
+                    spill_with_vote,
+                )
+
+                for ref, value in zip(task.outputs, outputs):
+                    if is_global_array(value):
+                        # vote-based: a failed rank raises EVERYWHERE after
+                        # all converge instead of wedging the others in a
+                        # bare barrier
+                        spill_with_vote(self._storage, ref.uri, value)
+                return
+
             for ref, value in zip(task.outputs, outputs):
                 self._write_entry(ref, value)
                 self._channels.transfer_completed(ref.id)
@@ -449,6 +464,13 @@ class WorkerAgent:
     def _write_entry(self, ref, value: Any) -> None:
         import json
 
+        from lzy_tpu.channels.sharded_spill import is_global_array
+
+        if is_global_array(value):
+            # multi-host output: shard-parallel spill + manifest entry
+            # (rank>0 shards were spilled by their own processes)
+            return self._write_global_entry(ref, value)
+
         self._channels.device.offer(ref.id, value)
         serializer = self._serializers.find_by_instance(value)
         buf = io.BytesIO()
@@ -486,6 +508,33 @@ class WorkerAgent:
                 "data_format": scheme.data_format,
                 "schema_content": scheme.schema_content,
                 "meta": scheme.meta,
+            }).encode("utf-8"),
+        )
+
+    def _write_global_entry(self, ref, value: Any) -> None:
+        """Rank 0's half of the gang spill protocol: write own shards, wait
+        for every rank's shards to land, then publish the manifest as the
+        entry object — the channel completes only once the value is whole."""
+        import json
+
+        from lzy_tpu.channels.sharded_spill import (
+            MANIFEST_FORMAT,
+            build_manifest,
+            spill_with_vote,
+        )
+        from lzy_tpu.utils import hashing
+
+        spill_with_vote(self._storage, ref.uri, value)
+        manifest = build_manifest(value, ref.uri)
+        self._storage.write_bytes(ref.uri, manifest)
+        self._storage.write_bytes(
+            ref.uri + ".meta",
+            json.dumps({
+                "hash": hashing.hash_bytes(manifest),
+                "data_format": MANIFEST_FORMAT,
+                "schema_content": "jax.Array",
+                "meta": {"shape": list(value.shape),
+                         "dtype": str(value.dtype)},
             }).encode("utf-8"),
         )
 
