@@ -1,3 +1,4 @@
 from lzy_tpu.data.pipeline import DataPipeline, synthetic_lm_batches
+from lzy_tpu.data.resumable import ResumableSource, array_source
 
-__all__ = ["DataPipeline", "synthetic_lm_batches"]
+__all__ = ["DataPipeline", "ResumableSource", "array_source", "synthetic_lm_batches"]

@@ -33,11 +33,22 @@ class DataPipeline:
         self._source = source
         self._sharding = sharding
         self._prefetch = max(1, prefetch)
+        self._consumed_state: Optional[Any] = None
+
+    def data_state(self) -> Optional[Any]:
+        """Resume position for the batch the CONSUMER last received — NOT
+        the feeder's (which runs ``prefetch`` batches ahead; checkpointing
+        the raw ``source.state()`` under a pipeline would silently skip the
+        prefetched-but-untrained batches). Valid when ``source`` has a
+        ``state()`` (e.g. :class:`~lzy_tpu.data.ResumableSource`):
+        ``CheckpointManager.save(..., data_state=pipeline.data_state())``."""
+        return self._consumed_state
 
     def __iter__(self) -> Iterator[Any]:
         q: queue.Queue = queue.Queue(maxsize=self._prefetch)
         error: list = []
         stop = threading.Event()
+        snapshot = getattr(self._source, "state", None)
 
         def put_until_stopped(item) -> bool:
             while not stop.is_set():
@@ -51,8 +62,12 @@ class DataPipeline:
         def feed() -> None:
             try:
                 for host_batch in self._source:
+                    # the source mutates its position on the SAME thread that
+                    # pulls, so snapshotting here is tear-free and denotes
+                    # "resume after this batch"
+                    state = snapshot() if snapshot is not None else None
                     device_batch = jax.device_put(host_batch, self._sharding)
-                    if not put_until_stopped(device_batch):
+                    if not put_until_stopped((device_batch, state)):
                         return
             except BaseException as e:  # surfaced on the consumer side
                 error.append(e)
@@ -70,7 +85,9 @@ class DataPipeline:
                     if error:
                         raise error[0]
                     return
-                yield item
+                batch, state = item
+                self._consumed_state = state
+                yield batch
         finally:
             # consumer stopped early (break / exception): unblock the feeder
             # and drop prefetched device batches instead of leaking them

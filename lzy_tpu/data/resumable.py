@@ -1,0 +1,171 @@
+"""Checkpointable data sources: resume training mid-epoch, deterministically.
+
+Model checkpoints alone cannot resume a run — after a restart the input
+pipeline would replay from batch 0 (double-training early data, skipping the
+rest). A :class:`ResumableSource` is a deterministic batch stream whose
+position is a tiny dict: save ``source.state()`` next to the model state
+(``CheckpointManager.save*(..., data_state=...)``), and after a restart
+``ResumableSource(..., state=...)`` continues from the exact batch the
+checkpoint saw last. Shuffling is derived from ``seed + epoch`` so the
+order is reproducible from the state alone, on every host of a gang
+(hosts feeding disjoint batch shards slice by ``shard_index/shard_count``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+
+class ResumableSource:
+    """Deterministic, positionable stream of batches over an indexable
+    dataset.
+
+    ``batch_of(indices) -> host batch`` materializes one batch from example
+    indices (a numpy int array); the source owns epochs, shuffling, and the
+    position. Iteration is endless by default (``epochs=None``) — training
+    loops bound it by steps.
+    """
+
+    def __init__(
+        self,
+        n_examples: int,
+        batch_of: Callable[[np.ndarray], Any],
+        *,
+        batch_size: int,
+        seed: int = 0,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        epochs: Optional[int] = None,
+        shard_index: int = 0,          # this host's slice of each epoch
+        shard_count: int = 1,
+        state: Optional[Dict[str, int]] = None,
+    ):
+        if n_examples <= 0 or batch_size <= 0:
+            raise ValueError("n_examples and batch_size must be positive")
+        if not (0 <= shard_index < shard_count):
+            raise ValueError(f"bad shard {shard_index}/{shard_count}")
+        self._n = n_examples
+        self._batch_of = batch_of
+        self._batch_size = batch_size
+        self._seed = seed
+        self._shuffle = shuffle
+        self._drop_last = drop_last
+        self._epochs = epochs
+        self._shard_index = shard_index
+        self._shard_count = shard_count
+        self._epoch = 0
+        self._batch_in_epoch = 0
+        self._active_iter: Optional[object] = None
+        if self.batches_per_epoch() == 0:
+            raise ValueError(
+                f"no batches per epoch: {n_examples} examples / "
+                f"{shard_count} hosts < batch_size {batch_size} with "
+                f"drop_last={drop_last}"
+            )
+        if state is not None:
+            self.restore(state)
+
+    # -- position --------------------------------------------------------------
+
+    # every field that determines WHICH examples "batch k of epoch e" means;
+    # restore() refuses a state from a differently-configured source, since
+    # accepting it would silently skip or replay data
+    _CONFIG_FIELDS = ("seed", "n", "batch_size", "shard_index",
+                      "shard_count", "shuffle", "drop_last")
+
+    def _config(self) -> Dict[str, Any]:
+        return {"seed": self._seed, "n": self._n,
+                "batch_size": self._batch_size,
+                "shard_index": self._shard_index,
+                "shard_count": self._shard_count,
+                "shuffle": self._shuffle, "drop_last": self._drop_last}
+
+    def state(self) -> Dict[str, Any]:
+        """The complete resume position — JSON-safe, a few bytes."""
+        return {"epoch": self._epoch, "batch": self._batch_in_epoch,
+                **self._config()}
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        config = self._config()
+        mismatched = {
+            f: (state[f], config[f]) for f in self._CONFIG_FIELDS
+            if f in state and state[f] != config[f]
+        }
+        if mismatched:
+            raise ValueError(
+                f"checkpointed data state is from a differently-configured "
+                f"source (seed/sharding/batching changed): {mismatched}; "
+                f"resuming would silently change what data is trained on"
+            )
+        self._epoch = int(state["epoch"])
+        self._batch_in_epoch = int(state["batch"])
+
+    # -- epoch plan ------------------------------------------------------------
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        order = np.arange(self._n)
+        if self._shuffle:
+            order = np.random.default_rng(
+                self._seed + epoch).permutation(order)
+        # disjoint per-host slices of the SAME epoch permutation
+        return order[self._shard_index::self._shard_count]
+
+    def batches_per_epoch(self) -> int:
+        per_host = (self._n + self._shard_count - 1 - self._shard_index) \
+            // self._shard_count
+        if self._drop_last:
+            return per_host // self._batch_size
+        return (per_host + self._batch_size - 1) // self._batch_size
+
+    def __iter__(self) -> Iterator[Any]:
+        # one live iterator at a time: two would share the position counters
+        # but cache different epoch orders, silently corrupting both streams
+        token = object()
+        self._active_iter = token
+        try:
+            while self._epochs is None or self._epoch < self._epochs:
+                order = self._epoch_order(self._epoch)
+                n_batches = self.batches_per_epoch()
+                while self._batch_in_epoch < n_batches:
+                    if self._active_iter is not token:
+                        raise RuntimeError(
+                            "a newer iterator took over this "
+                            "ResumableSource; one live iterator at a time"
+                        )
+                    i = self._batch_in_epoch
+                    indices = order[i * self._batch_size:
+                                    (i + 1) * self._batch_size]
+                    # advance BEFORE yielding: state() taken while the
+                    # consumer holds this batch points at the NEXT one, so a
+                    # checkpoint written after training on the batch never
+                    # replays it. (Under a prefetching DataPipeline, use
+                    # pipeline.data_state() instead — it tracks the
+                    # CONSUMER's position, not the feeder's.)
+                    self._batch_in_epoch += 1
+                    yield self._batch_of(indices)
+                self._epoch += 1
+                self._batch_in_epoch = 0
+        finally:
+            if self._active_iter is token:
+                self._active_iter = None
+
+
+def array_source(arrays: Dict[str, np.ndarray], *, batch_size: int,
+                 **kwargs) -> ResumableSource:
+    """ResumableSource over in-memory arrays sharing a leading example dim:
+    ``array_source({"tokens": tok, "labels": lab}, batch_size=8)``."""
+    lengths = {k: len(v) for k, v in arrays.items()}
+    if len(set(lengths.values())) != 1:
+        raise ValueError(f"leading dims differ: {lengths}")
+    n = next(iter(lengths.values()))
+
+    def batch_of(indices: np.ndarray):
+        return {k: v[indices] for k, v in arrays.items()}
+
+    return ResumableSource(n, batch_of, batch_size=batch_size, **kwargs)

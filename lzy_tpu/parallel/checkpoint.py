@@ -38,7 +38,7 @@ class CheckpointManager:
     # -- save ------------------------------------------------------------------
 
     def save(self, state: Any, step: int, *, metrics: Optional[Dict] = None,
-             blocking: bool = True) -> str:
+             data_state: Optional[Dict] = None, blocking: bool = True) -> str:
         """Snapshot ``state`` (any array pytree, e.g. TrainState) at ``step``.
         With ``blocking=False`` the device→host transfer happens now but the
         upload runs on a background thread (one in flight at a time)."""
@@ -56,7 +56,8 @@ class CheckpointManager:
                 self._client, join_uri(uri, "state"), buf.getvalue(),
                 progress=log_progress(f"checkpoint step {step}"),
             )
-            manifest = {"step": step, "metrics": metrics or {}}
+            manifest = {"step": step, "metrics": metrics or {},
+                        "data_state": data_state}
             self._client.write_bytes(
                 join_uri(uri, "manifest.json"),
                 json.dumps(manifest).encode("utf-8"),
@@ -131,6 +132,14 @@ class CheckpointManager:
         uri = join_uri(self._base, f"step_{step:010d}", "manifest.json")
         return json.loads(self._client.read_bytes(uri).decode("utf-8"))
 
+    def data_state(self, step: Optional[int] = None) -> Optional[Dict]:
+        """The input-pipeline resume position saved with the checkpoint
+        (``ResumableSource.state()``); None for model-only checkpoints."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        return self.manifest(step).get("data_state")
+
     # -- sharded (multi-host) checkpoints --------------------------------------
     #
     # ``save``/``restore`` above gather the whole state to the host — right
@@ -157,7 +166,8 @@ class CheckpointManager:
         return _shard_key(index, shape)
 
     def save_sharded(self, state: Any, step: int, *,
-                     metrics: Optional[Dict] = None) -> str:
+                     metrics: Optional[Dict] = None,
+                     data_state: Optional[Dict] = None) -> str:
         import numpy as np
 
         from jax.experimental import multihost_utils
@@ -237,6 +247,7 @@ class CheckpointManager:
             self._client.write_bytes(
                 join_uri(uri, "manifest.json"),
                 json.dumps({"step": step, "metrics": metrics or {},
+                            "data_state": data_state,
                             "sharded": True}).encode(),
             )
             self._client.write_bytes(

@@ -272,3 +272,158 @@ class TestShardedCheckpoint:
         np.testing.assert_array_equal(
             np.asarray(out2["w"]), np.arange(64.0).reshape(8, 8))
         assert out2["w"].sharding == sh2
+
+
+class TestResumableSource:
+    def make(self, n=20, bs=4, **kw):
+        import numpy as np
+
+        from lzy_tpu.data import array_source
+
+        data = {"x": np.arange(n * 2).reshape(n, 2)}
+        return array_source(data, batch_size=bs, seed=7, **kw)
+
+    def test_resume_continues_exactly(self):
+        import numpy as np
+
+        src = self.make()
+        it = iter(src)
+        consumed = [next(it) for _ in range(7)]   # into epoch 2
+        resume_state = src.state()
+
+        fresh = self.make(state=resume_state)
+        a, b = next(iter(fresh)), next(it)
+        np.testing.assert_array_equal(a["x"], b["x"])
+        # and the one after that, across the epoch boundary too
+        it_fresh = iter(fresh)
+        for _ in range(3):
+            x, y = next(it_fresh), next(it)
+            np.testing.assert_array_equal(x["x"], y["x"])
+        assert consumed  # silence linters
+
+    def test_state_points_past_the_held_batch(self):
+        """A checkpoint written AFTER training on batch k must resume at
+        k+1 — never replay k."""
+        import numpy as np
+
+        src = self.make(shuffle=False)
+        it = iter(src)
+        first = next(it)
+        resumed = next(iter(self.make(shuffle=False, state=src.state())))
+        assert not np.array_equal(first["x"], resumed["x"])
+        np.testing.assert_array_equal(resumed["x"], next(iter(
+            self.make(shuffle=False, state={"epoch": 0, "batch": 1,
+                                            "seed": 7})))["x"])
+
+    def test_epochs_reshuffle_but_cover_everything(self):
+        import numpy as np
+
+        src = self.make(n=16, bs=4, epochs=2)
+        seen = [b["x"][:, 0] // 2 for b in src]
+        assert len(seen) == 8                      # 4 batches x 2 epochs
+        e0, e1 = np.sort(np.concatenate(seen[:4])), np.sort(
+            np.concatenate(seen[4:]))
+        np.testing.assert_array_equal(e0, np.arange(16))
+        np.testing.assert_array_equal(e1, np.arange(16))
+        assert not np.array_equal(np.concatenate(seen[:4]),
+                                  np.concatenate(seen[4:]))
+
+    def test_host_shards_are_disjoint_and_complete(self):
+        import numpy as np
+
+        parts = []
+        for rank in range(2):
+            src = self.make(n=16, bs=4, epochs=1, shard_index=rank,
+                            shard_count=2)
+            parts.append(np.concatenate(
+                [b["x"][:, 0] // 2 for b in src]))
+        allv = np.concatenate(parts)
+        assert len(allv) == 16 and len(set(allv.tolist())) == 16
+
+    def test_seed_mismatch_rejected(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="seed"):
+            self.make(state={"epoch": 0, "batch": 0, "seed": 99})
+
+    def test_data_state_travels_with_checkpoints(self):
+        from lzy_tpu.parallel import CheckpointManager
+        from lzy_tpu.storage.mem import MemStorageClient
+
+        src = self.make()
+        it = iter(src)
+        for _ in range(3):
+            next(it)
+        mgr = CheckpointManager(MemStorageClient(), "mem://dck", "m")
+        mgr.save({"w": jnp.ones(4)}, 3, data_state=src.state())
+        assert mgr.data_state() == src.state()
+        resumed = self.make(state=mgr.data_state())
+        import numpy as np
+
+        np.testing.assert_array_equal(next(iter(resumed))["x"],
+                                      next(it)["x"])
+
+
+class TestResumableSourceHardening:
+    def test_zero_batch_config_rejected(self):
+        import pytest as _pytest
+
+        from lzy_tpu.data import ResumableSource
+
+        with _pytest.raises(ValueError, match="no batches per epoch"):
+            ResumableSource(8, lambda idx: idx, batch_size=16,
+                            shard_index=0, shard_count=8)
+
+    def test_config_change_rejected_on_restore(self):
+        import numpy as np
+        import pytest as _pytest
+
+        from lzy_tpu.data import array_source
+
+        data = {"x": np.arange(40).reshape(20, 2)}
+        src = array_source(data, batch_size=4, seed=7)
+        state = src.state()
+        with _pytest.raises(ValueError, match="differently-configured"):
+            array_source(data, batch_size=8, seed=7, state=state)
+        with _pytest.raises(ValueError, match="differently-configured"):
+            array_source(data, batch_size=4, seed=7, shard_index=1,
+                         shard_count=2, state=state)
+
+    def test_concurrent_iterators_rejected(self):
+        import numpy as np
+        import pytest as _pytest
+
+        from lzy_tpu.data import array_source
+
+        src = array_source({"x": np.arange(16)}, batch_size=4)
+        a = iter(src)
+        next(a)
+        b = iter(src)       # takes over
+        next(b)
+        with _pytest.raises(RuntimeError, match="newer iterator"):
+            next(a)
+
+    def test_pipeline_tracks_consumer_not_feeder(self):
+        """With prefetch ahead, the checkpointable position must be the
+        batch the TRAIN LOOP saw last — not the feeder's lookahead."""
+        import numpy as np
+
+        from lzy_tpu.data import DataPipeline, array_source
+
+        n, bs = 32, 4
+        data = {"x": np.arange(n)}
+        src = array_source(data, batch_size=bs, shuffle=False)
+        sharding = jax.devices()[0]
+        pipe = DataPipeline(src, sharding, prefetch=4)
+        it = iter(pipe)
+        seen = [np.asarray(next(it)["x"]) for _ in range(2)]
+        import time as _t
+
+        _t.sleep(0.3)       # let the feeder run ahead
+        state = pipe.data_state()
+        assert state is not None and state["batch"] == 2   # consumer position
+        resumed = array_source(data, batch_size=bs, shuffle=False,
+                               state=state)
+        np.testing.assert_array_equal(next(iter(resumed))["x"],
+                                      np.arange(8, 12))
+        np.testing.assert_array_equal(seen[0], np.arange(0, 4))
