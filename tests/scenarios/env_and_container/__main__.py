@@ -1,0 +1,51 @@
+"""Worker-side environment realization + container-boundary execution
+(round-2 additions: execution-env parity)."""
+from tests.scenarios._base import make_lzy
+
+from lzy_tpu import op
+from lzy_tpu.env import DockerContainer, EnvBuildError, ManualPythonEnv
+
+
+@op
+def plain_add(a: int, b: int) -> int:
+    return a + b
+
+
+@op
+def boxed_mul(a: int, b: int) -> int:
+    return a * b
+
+
+def main():
+    import sys
+
+    from lzy_tpu.env import LocalProcessRuntime
+    from lzy_tpu.service import InProcessCluster
+
+    cluster = InProcessCluster(storage_uri="file:///tmp/lzy-scn-env",
+                               container_runtime=LocalProcessRuntime())
+    lzy = cluster.lzy()
+    try:
+        pyver = "%d.%d" % sys.version_info[:2]
+        # shared-interpreter workers VALIDATE the captured env and fail fast
+        # on a mismatch (the silent unpickle-time failure mode is gone)
+        bad_env = ManualPythonEnv(python_version=pyver,
+                                  packages={"lzy-no-such-pkg": "1.0"})
+        try:
+            with lzy.workflow("env-validate"):
+                int(plain_add.with_python_env(bad_env)(1, 2))
+        except Exception as e:
+            cause = e.__cause__ or e
+            print("env conflict detected:",
+                  isinstance(cause, EnvBuildError))
+
+        # containerized op runs through the exchange-dir boundary
+        with lzy.workflow("container"):
+            r = boxed_mul.with_container(DockerContainer(image="any:img"))(6, 7)
+            print("container result:", int(r))
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Checkpoint + resumable data: a 'crash' mid-training resumes at the exact
+next batch with the restored model state, matching an uninterrupted run
+bit for bit (round-2 additions)."""
+import numpy as np
+
+from lzy_tpu.data import array_source
+from lzy_tpu.parallel import CheckpointManager
+from lzy_tpu.storage.mem import MemStorageClient
+
+
+def main():
+    data = {"x": np.arange(64, dtype=np.float32)}
+    mgr = CheckpointManager(MemStorageClient(), "mem://scn-ckpt", "run")
+
+    # train 5 batches, checkpoint model + data position, then keep going —
+    # the uninterrupted run is the ground truth
+    src = array_source(data, batch_size=8, seed=3)
+    it = iter(src)
+    w = 0.0
+    for _ in range(5):
+        w += float(next(it)["x"].sum())
+    mgr.save({"w": np.float32(w)}, 5, data_state=src.state())
+    truth = w
+    for _ in range(3):
+        truth += float(next(it)["x"].sum())
+
+    # "crashed" process: restore model AND data position, train the same 3
+    restored = float(np.asarray(mgr.restore()["w"]))
+    resumed = array_source(data, batch_size=8, seed=3,
+                           state=mgr.data_state())
+    rit = iter(resumed)
+    w2 = restored
+    for _ in range(3):
+        w2 += float(next(rit)["x"].sum())
+
+    print("resume step:", mgr.latest_step())
+    print("resumed equals uninterrupted:", w2 == truth)
+
+
+if __name__ == "__main__":
+    main()
