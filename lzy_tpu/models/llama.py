@@ -46,7 +46,8 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     tie_embeddings: bool = False         # Llama-3 uses an untied lm_head
-    use_ring_attention: bool = False     # sequence parallelism over 'sp'
+    use_ring_attention: bool = False     # SP via ppermute ring over 'sp'
+    use_ulysses_attention: bool = False  # SP via all-to-all head resharding
     use_flash_kernel: bool = False       # Pallas kernel (TPU only)
     # Mixtral-style sparse MLP: >0 replaces dense MLPs with MoE (ep-shardable)
     n_experts: int = 0
@@ -149,6 +150,13 @@ class Attention(nn.Module):
             from lzy_tpu.parallel.ring import ring_attention
 
             out = ring_attention(q, k, v, mesh=mesh, causal=True)
+        elif cfg.use_ulysses_attention and mesh is not None:
+            # all-to-all SP: reshard seq→heads so each device sees the FULL
+            # sequence for its head slice (better when heads ≥ sp and the
+            # ring's ppermute latency dominates)
+            from lzy_tpu.parallel.ulysses import ulysses_attention
+
+            out = ulysses_attention(q, k, v, mesh=mesh, causal=True)
         elif cfg.use_flash_kernel and t % 128 == 0:
             # lane-aligned sequences take the Pallas kernel; tiny traces
             # (init, smoke shapes) fall through to the dense path

@@ -257,3 +257,41 @@ class TestSequenceParallelTraining:
         # the batch really trains with its sequence dim on the sp axis
         emb = state.params["embed_tokens"]
         assert "fsdp" in str(emb.sharding.spec)
+
+
+class TestUlyssesInModel:
+    def test_ulysses_path_matches_dense(self):
+        cfg_dense = LlamaConfig.tiny()
+        cfg_u = type(cfg_dense)(**{
+            **cfg_dense.__dict__, "use_ulysses_attention": True,
+        })
+        mesh = mesh_for(sp=4, fsdp=2)  # tiny() has 4 heads: heads % sp == 0
+        boxed, _ = llama.init_params(cfg_dense, jax.random.PRNGKey(0))
+        params = unbox(boxed)
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 64), 0,
+                                    cfg_dense.vocab_size)
+        dense_logits = llama.Llama(cfg_dense).apply({"params": params}, tokens)
+        u_logits = llama.Llama(cfg_u).apply({"params": params}, tokens, mesh)
+        np.testing.assert_allclose(
+            np.asarray(dense_logits), np.asarray(u_logits),
+            atol=0.1, rtol=0.05,  # bf16 compute tolerance
+        )
+
+    def test_train_step_through_ulysses(self):
+        cfg = LlamaConfig.tiny()
+        cfg = type(cfg)(**{**cfg.__dict__, "use_ulysses_attention": True})
+        mesh = mesh_for(sp=4, fsdp=2)
+        boxed, axes = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tx = optax.adam(1e-3)
+        step, shard_state, _ = make_train_step(
+            llama.make_loss_fn(cfg, mesh), tx, mesh=mesh,
+            param_logical_axes=axes, batch_logical_axes=("batch", "seq"),
+        )
+        state = shard_state(TrainState.create(unbox(boxed), tx))
+        batch = {"tokens": jax.random.randint(
+            jax.random.PRNGKey(1), (4, 64), 0, cfg.vocab_size)}
+        losses = []
+        for _ in range(4):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0]
