@@ -46,6 +46,9 @@ class DataPipeline:
 
     def __iter__(self) -> Iterator[Any]:
         q: queue.Queue = queue.Queue(maxsize=self._prefetch)
+        # bound NOW: at interpreter shutdown the module globals may already
+        # be gone when an abandoned generator's finally runs
+        empty = queue.Empty
         error: list = []
         stop = threading.Event()
         snapshot = getattr(self._source, "state", None)
@@ -95,7 +98,7 @@ class DataPipeline:
             while True:
                 try:
                     q.get_nowait()
-                except queue.Empty:
+                except empty:
                     break
             thread.join(timeout=5.0)
 
