@@ -213,17 +213,20 @@ class Attention(nn.Module):
             )
             index.value = i + 1
 
+        # GQA without jnp.repeat: grouping q as [B, 1, KV, G, D] lets the
+        # einsum broadcast the shared KV head instead of materializing a
+        # G-times larger cache copy every step — decode is HBM-bound, and
+        # the repeat was pure wasted bandwidth
         reps = h // kv_heads
-        keys = jnp.repeat(cache_k.value, reps, axis=2)    # [B, L, H, D]
-        vals = jnp.repeat(cache_v.value, reps, axis=2)
+        qg = q.reshape(b, 1, kv_heads, reps, d)
         s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, keys,
+            "bqkgd,blkd->bkgql", qg, cache_k.value,
             preferred_element_type=jnp.float32,
-        ) * (d ** -0.5)                                   # [B, H, 1, L]
-        visible = jnp.arange(L)[None, None, None, :] <= i
+        ) * (d ** -0.5)                                   # [B, KV, G, 1, L]
+        visible = jnp.arange(L)[None, None, None, None, :] <= i
         s = jnp.where(visible, s, -1e30)
         p = jax.nn.softmax(s, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", p, vals)      # [B, 1, H, D]
+        out = jnp.einsum("bkgql,blkd->bqkgd", p, cache_v.value)
         return self._o_proj(out.reshape(b, 1, h * d))
 
 
