@@ -89,6 +89,32 @@ def cmd_whiteboards(args) -> None:
     print(_table(rows, ["ID", "NAME", "TAGS", "CREATED"]))
 
 
+def cmd_auth(args) -> None:
+    """Mint/rotate/revoke IAM subjects against the deployment store (the
+    reference's `lzy auth` flow). Tokens print to stdout ONCE — they are
+    not recoverable from the store."""
+    from lzy_tpu.durable import OperationStore
+    from lzy_tpu.iam import IamService
+
+    if not args.db:
+        print("auth needs the deployment store: pass --db <path>",
+              file=sys.stderr)
+        sys.exit(2)
+    store = OperationStore(args.db)
+    try:
+        iam = IamService(store)
+        if args.auth_command == "create":
+            print(iam.create_subject(args.subject, role=args.role))
+        elif args.auth_command == "rotate":
+            # revokes every outstanding token for the subject
+            print(iam.rotate_subject(args.subject))
+        elif args.auth_command == "revoke":
+            iam.remove_subject(args.subject)
+            print(f"subject {args.subject} removed")
+    finally:
+        store.close()
+
+
 def cmd_serve_console(args) -> None:
     if not args.db:
         print("console serves a local store; pass --db <path>",
@@ -128,6 +154,14 @@ def main(argv=None) -> None:
     for name in ("executions", "graphs", "vms", "ops", "whiteboards",
                  "version"):
         sub.add_parser(name)
+    auth = sub.add_parser("auth", help="mint/rotate/revoke IAM subjects")
+    auth_sub = auth.add_subparsers(dest="auth_command", required=True)
+    for name in ("create", "rotate", "revoke"):
+        ap = auth_sub.add_parser(name)
+        ap.add_argument("subject")
+        if name == "create":
+            ap.add_argument("--role", default="OWNER",
+                            choices=["OWNER", "READER", "INTERNAL"])
     serve = sub.add_parser("serve-console",
                            help="serve the HTML/JSON status console")
     serve.add_argument("--port", type=int, default=8788)
@@ -143,6 +177,8 @@ def main(argv=None) -> None:
         print(__version__)
     elif args.command == "whiteboards":
         cmd_whiteboards(args)
+    elif args.command == "auth":
+        cmd_auth(args)
     elif args.command == "serve-console":
         cmd_serve_console(args)
     else:

@@ -263,3 +263,38 @@ class TestWorkerTokenRefresh:
         assert t.accepts("new") and t.accepts("old")     # one-rotation grace
         t.rotate("newer")
         assert not t.accepts("old")
+
+
+class TestAuthCli:
+    def test_create_rotate_revoke_flow(self, tmp_path):
+        import subprocess
+        import sys as _sys
+
+        db = str(tmp_path / "meta.db")
+
+        def cli(*args):
+            return subprocess.run(
+                [_sys.executable, "-m", "lzy_tpu", "--db", db, "auth", *args],
+                capture_output=True, text=True, cwd="/root/repo", timeout=60,
+            )
+
+        created = cli("create", "alice")
+        assert created.returncode == 0, created.stderr
+        token = created.stdout.strip()
+
+        from lzy_tpu.durable import OperationStore
+
+        store = OperationStore(db)
+        iam = IamService(store)
+        assert iam.authenticate(token).id == "alice"
+
+        rotated = cli("rotate", "alice")
+        new_token = rotated.stdout.strip()
+        with pytest.raises(AuthError, match="revoked"):
+            iam.authenticate(token)
+        assert iam.authenticate(new_token).id == "alice"
+
+        assert "removed" in cli("revoke", "alice").stdout
+        with pytest.raises(AuthError, match="unknown subject"):
+            iam.authenticate(new_token)
+        store.close()
