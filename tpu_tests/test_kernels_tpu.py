@@ -1,0 +1,80 @@
+"""On-hardware kernel tests: run MANUALLY on a TPU host —
+
+    python -m pytest tpu_tests/ -q
+
+Deliberately OUTSIDE tests/ (whose conftest forces the virtual CPU mesh):
+this tier compiles the Pallas kernels natively on the chip and checks them
+against the dense reference, the complement of the interpret-mode tests in
+tests/test_ops.py (SURVEY §4's hardware tier)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+if jax.devices()[0].platform != "tpu":
+    pytest.skip("needs a real TPU chip", allow_module_level=True)
+
+from lzy_tpu.ops import flash_attention  # noqa: E402
+
+
+def dense(q, k, v, causal, kv_mask=None):
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (d ** -0.5)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, -1e30)
+    if causal:
+        t = q.shape[2]
+        s = jnp.where(np.tril(np.ones((t, t), bool)), s, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1),
+                      v.astype(jnp.float32))
+
+
+def qkv(b=2, h=8, t=1024, d=128, dtype=jnp.bfloat16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, h, t, d), dtype) for k in ks)
+
+
+class TestNativeFlash:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_dense(self, causal):
+        q, k, v = qkv()
+        out = flash_attention(q, k, v, causal=causal, interpret=False)
+        ref = dense(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref), atol=2e-2, rtol=2e-2)
+
+    def test_gradients_match_dense(self):
+        q, k, v = qkv(t=512, dtype=jnp.float32)
+
+        g1 = jax.grad(lambda *a: jnp.sum(
+            flash_attention(*a, causal=True, interpret=False) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda *a: jnp.sum(dense(*a, True) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-3, rtol=5e-3)
+
+    def test_kv_mask_native(self):
+        q, k, v = qkv(t=512, dtype=jnp.float32)
+        mask = jnp.asarray(np.arange(512)[None, :] <
+                           np.array([[512], [384]]))
+        out = flash_attention(q, k, v, causal=False, kv_mask=mask,
+                              interpret=False)
+        ref = dense(q, k, v, False, kv_mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=5e-3, rtol=5e-3)
+
+    @pytest.mark.parametrize("blocks", [(256, 256), (512, 512),
+                                        (1024, 1024)])
+    def test_block_sizes_compile_and_agree(self, blocks):
+        bq, bkv = blocks
+        q, k, v = qkv(t=2048)
+        out = flash_attention(q, k, v, causal=True, block_q=bq,
+                              block_kv=bkv, interpret=False)
+        ref = flash_attention(q, k, v, causal=True, interpret=False)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   atol=2e-2, rtol=2e-2)
