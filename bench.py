@@ -65,17 +65,24 @@ def supervise() -> None:
             deadline = 300
             continue
         out = proc.stdout.decode("utf-8", "replace")
+        diagnosed = None
         for line in reversed(out.splitlines()):
             try:
                 obj = json.loads(line)
             except ValueError:
                 continue
             if isinstance(obj, dict) and obj.get("metric") == METRIC:
+                if obj.get("error"):
+                    # child self-diagnosed (e.g. backend init timeout):
+                    # keep the cause for the final report, but retry
+                    diagnosed = obj["error"]
+                    break
                 print(line, flush=True)
                 return
         errors.append(
-            f"attempt {attempt}: rc={proc.returncode} after "
-            f"{time.monotonic() - t0:.0f}s, no metric line in stdout"
+            f"attempt {attempt}: "
+            + (diagnosed or f"rc={proc.returncode} after "
+                            f"{time.monotonic() - t0:.0f}s, no metric line")
         )
         _log(errors[-1])
     print(
@@ -140,7 +147,15 @@ def init_devices(timeout_s: float = 240.0):
 
 def run() -> None:
     _log("initializing jax backend...")
-    devices = init_devices()
+    try:
+        devices = init_devices()
+    except Exception as e:
+        # self-diagnose on stdout so the supervisor's final JSON carries the
+        # actual cause, not just "no metric line"
+        print(json.dumps({"metric": METRIC, "value": 0.0,
+                          "unit": "mfu_fraction", "vs_baseline": 0.0,
+                          "error": f"{e}"}), flush=True)
+        raise
     import jax
 
     platform = devices[0].platform
