@@ -29,6 +29,22 @@ def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def _scan_metric(out: str):
+    """Last metric line from child stdout → (good_line, diagnosed_error).
+    An error-bearing line is a self-diagnosis (e.g. backend-init timeout),
+    never a result — both supervisor paths must treat it as retryable."""
+    for line in reversed(out.splitlines()):
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and obj.get("metric") == METRIC:
+            if obj.get("error"):
+                return None, obj["error"]
+            return line, None
+    return None, None
+
+
 def supervise() -> None:
     errors = []
     deadline = ATTEMPT_DEADLINE_S
@@ -43,21 +59,22 @@ def supervise() -> None:
             )
         except subprocess.TimeoutExpired as e:
             # the child may have printed the headline metric before hanging
-            # (e.g. in the optional breakdown pass) — salvage it
+            # (e.g. in the optional breakdown pass) — salvage it; an
+            # error-bearing line is NOT a result (a child can self-diagnose
+            # and then hang in backend teardown) and must still retry
             partial = (e.stdout or b"")
             if isinstance(partial, bytes):
                 partial = partial.decode("utf-8", "replace")
-            for line in reversed(partial.splitlines()):
-                try:
-                    obj = json.loads(line)
-                except ValueError:
-                    continue
-                if isinstance(obj, dict) and obj.get("metric") == METRIC:
-                    _log(f"attempt {attempt}: hung after printing the metric; "
-                         f"using it")
-                    print(line, flush=True)
-                    return
-            errors.append(f"attempt {attempt}: hung, killed after {deadline}s")
+            good, diagnosed = _scan_metric(partial)
+            if good is not None:
+                _log(f"attempt {attempt}: hung after printing the metric; "
+                     f"using it")
+                print(good, flush=True)
+                return
+            errors.append(
+                f"attempt {attempt}: "
+                + (diagnosed or f"hung, killed after {deadline}s")
+            )
             _log(errors[-1])
             # a full-deadline hang already burned ~9 min; cap the retry so
             # the TOTAL stays inside any plausible driver timeout and the
@@ -65,20 +82,10 @@ def supervise() -> None:
             deadline = 300
             continue
         out = proc.stdout.decode("utf-8", "replace")
-        diagnosed = None
-        for line in reversed(out.splitlines()):
-            try:
-                obj = json.loads(line)
-            except ValueError:
-                continue
-            if isinstance(obj, dict) and obj.get("metric") == METRIC:
-                if obj.get("error"):
-                    # child self-diagnosed (e.g. backend init timeout):
-                    # keep the cause for the final report, but retry
-                    diagnosed = obj["error"]
-                    break
-                print(line, flush=True)
-                return
+        good, diagnosed = _scan_metric(out)
+        if good is not None:
+            print(good, flush=True)
+            return
         errors.append(
             f"attempt {attempt}: "
             + (diagnosed or f"rc={proc.returncode} after "
@@ -233,41 +240,76 @@ def run() -> None:
     # backend a compile can hang — the supervisor salvages the last metric
     # line, so a measured MFU must already be on stdout before we risk it
     emit()
-    extra = step_breakdown(jax, loss_fn, state, batch, mesh, step_ms)
+    # the adam moments (~2x params) are dead weight from here on; freeing
+    # them is what lets the extra passes fit in HBM next to the live params
+    params = state.params
+    _free_buffers(state.opt_state)
+    state = None
+    extra = step_breakdown(jax, loss_fn, params, batch, step_ms)
     if extra:
         detail.update(extra)
         emit()
     if platform in ("tpu", "axon"):
+        # seq4k builds a whole second model+optimizer: evict the 2k one
+        # (buffers AND compiled executables) first or it cannot fit
+        _free_buffers(params, batch, metrics)
+        params = batch = metrics = None
+        jax.clear_caches()
         extra = seq4k_measurement(jax, cfg, mesh, n_params)
         if extra:
             detail.update(extra)
             emit()
 
 
+def _free_buffers(*trees) -> None:
+    """Eagerly release device buffers (GC alone is too late on a 16 GB chip)."""
+    import jax
+
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if hasattr(leaf, "delete"):
+                try:
+                    leaf.delete()
+                except Exception:  # noqa: BLE001 — already deleted/donated
+                    pass
+
+
 def seq4k_measurement(jax, cfg, mesh, n_params, steps: int = 10):
     """Best-effort long-context point (VERDICT r1 #9): MFU at seq 4096,
     batch halved to keep HBM flat. Never risks the headline metric."""
+    for remat in (False, True):
+        try:
+            return _seq4k_once(jax, cfg, mesh, n_params, steps, remat)
+        except Exception as e:  # noqa: BLE001 — diagnostics only
+            _log(f"seq4k (remat={remat}) skipped: {type(e).__name__}: {e}")
+            if "RESOURCE_EXHAUSTED" not in str(e):
+                return {}
+            jax.clear_caches()  # retry with remat trades FLOPs for memory
+    return {}
+
+
+def _seq4k_once(jax, cfg, mesh, n_params, steps: int, remat: bool):
+    import dataclasses
+
+    import optax
+
+    from lzy_tpu.models import llama, unbox
+    from lzy_tpu.parallel import TrainState, make_train_step, mfu
+
+    _log(f"seq4k: building model (remat={remat})...")
+    cfg4k = dataclasses.replace(cfg, max_seq_len=4096, remat=remat)
+    batch_size, seq_len = 4, 4096
+    boxed, axes = llama.init_params(cfg4k, jax.random.PRNGKey(0))
+    tx = optax.adamw(3e-4)
+    step, shard_state, _ = make_train_step(
+        llama.make_loss_fn(cfg4k), tx, mesh=mesh,
+        param_logical_axes=axes, batch_logical_axes=("batch", "seq"),
+    )
+    state = shard_state(TrainState.create(unbox(boxed), tx))
+    batch = {"tokens": jax.random.randint(
+        jax.random.PRNGKey(1), (batch_size, seq_len), 0, cfg4k.vocab_size
+    )}
     try:
-        import dataclasses
-
-        import optax
-
-        from lzy_tpu.models import llama, unbox
-        from lzy_tpu.parallel import TrainState, make_train_step, mfu
-
-        _log("seq4k: building model...")
-        cfg4k = dataclasses.replace(cfg, max_seq_len=4096)
-        batch_size, seq_len = 4, 4096
-        boxed, axes = llama.init_params(cfg4k, jax.random.PRNGKey(0))
-        tx = optax.adamw(3e-4)
-        step, shard_state, _ = make_train_step(
-            llama.make_loss_fn(cfg4k), tx, mesh=mesh,
-            param_logical_axes=axes, batch_logical_axes=("batch", "seq"),
-        )
-        state = shard_state(TrainState.create(unbox(boxed), tx))
-        batch = {"tokens": jax.random.randint(
-            jax.random.PRNGKey(1), (batch_size, seq_len), 0, cfg4k.vocab_size
-        )}
         _log("seq4k: compiling + warmup...")
         for _ in range(2):
             state, metrics = step(state, batch)
@@ -278,24 +320,28 @@ def seq4k_measurement(jax, cfg, mesh, n_params, steps: int = 10):
             state, metrics = step(state, batch)
         float(metrics["loss"])
         dt = time.perf_counter() - t0
-        tokens_per_s = batch_size * seq_len * steps / dt
-        # same chip count as the headline metric, or the two aren't comparable
-        value = mfu(tokens_per_s, n_params, len(jax.devices()), chip="v5e")
-        _log(f"seq4k: {1000 * dt / steps:.1f} ms/step, mfu {value:.4f}")
-        return {"seq4k_mfu": round(value, 4),
-                "seq4k_step_time_ms": round(1000 * dt / steps, 2),
-                "seq4k_batch": batch_size}
-    except Exception as e:  # noqa: BLE001 — diagnostics only
-        _log(f"seq4k skipped: {type(e).__name__}: {e}")
-        return {}
+    finally:
+        _free_buffers(state, batch)
+    tokens_per_s = batch_size * seq_len * steps / dt
+    # same chip count as the headline metric, or the two aren't comparable
+    value = mfu(tokens_per_s, n_params, len(jax.devices()), chip="v5e")
+    _log(f"seq4k: {1000 * dt / steps:.1f} ms/step, mfu {value:.4f}")
+    out = {"seq4k_mfu": round(value, 4),
+           "seq4k_step_time_ms": round(1000 * dt / steps, 2),
+           "seq4k_batch": batch_size}
+    if remat:
+        out["seq4k_remat"] = True
+    return out
 
 
-def step_breakdown(jax, loss_fn, state, batch, mesh, step_ms: float, n: int = 5):
+def step_breakdown(jax, loss_fn, params, batch, step_ms: float, n: int = 5):
     """Best-effort fwd/bwd/opt decomposition of the step time.
 
     Times a jitted forward (loss only) and a jitted value_and_grad; the
     optimizer share is the remainder of the full step. Two extra compiles —
     wrapped so a backend hiccup here never loses the headline metric.
+    Caller must have freed the optimizer moments: params + grads + the
+    bwd activations only fit in HBM without them.
     """
     try:
         _log("breakdown: timing fwd-only...")
@@ -310,9 +356,9 @@ def step_breakdown(jax, loss_fn, state, batch, mesh, step_ms: float, n: int = 5)
             float(jax.numpy.ravel(leaf)[0])
             return 1000 * (time.perf_counter() - t0) / n
 
-        fwd_ms = timed(jax.jit(loss_fn), state.params, batch)
+        fwd_ms = timed(jax.jit(loss_fn), params, batch)
         _log("breakdown: timing fwd+bwd...")
-        grad_ms = timed(jax.jit(jax.value_and_grad(loss_fn)), state.params, batch)
+        grad_ms = timed(jax.jit(jax.value_and_grad(loss_fn)), params, batch)
         return {
             "fwd_ms": round(fwd_ms, 2),
             "bwd_ms": round(max(grad_ms - fwd_ms, 0.0), 2),

@@ -10,6 +10,7 @@ the shared cache namespace ``ops/<name>/<version>/<input-hash>`` before executio
 from __future__ import annotations
 
 import sys
+import threading as _threading
 from typing import TYPE_CHECKING, Any, List, Optional
 
 from lzy_tpu.core.call import LzyCall
@@ -41,7 +42,11 @@ class RemoteCallError(WorkflowError):
 
 
 class LzyWorkflow:
-    _active: Optional["LzyWorkflow"] = None
+    # thread-local: a worker thread executing an op body may host its own
+    # (nested) workflow — the reference runs nested graphs from inside an op
+    # (pylzy/tests/scenarios/nested_workflows); only same-thread nesting is
+    # an error
+    _tls = _threading.local()
 
     def __init__(
         self,
@@ -101,15 +106,24 @@ class LzyWorkflow:
 
     @classmethod
     def get_active(cls) -> Optional["LzyWorkflow"]:
-        return cls._active
+        return getattr(cls._tls, "wf", None)
+
+    @classmethod
+    def clear_active(cls) -> None:
+        """Drop this thread's active-workflow slot without running ``__exit__``
+        — for callers that abandoned a workflow mid-flight (e.g. tests killing
+        the control plane under an entered workflow)."""
+        cls._tls.wf = None
 
     # -- lifecycle -------------------------------------------------------------
 
     def __enter__(self) -> "LzyWorkflow":
-        if LzyWorkflow._active is not None:
+        active = LzyWorkflow.get_active()
+        if active is not None:
             raise WorkflowError(
-                f"workflow {LzyWorkflow._active.name!r} is already active; "
-                "nested workflows must run in their own process"
+                f"workflow {active.name!r} is already active in this thread; "
+                "nested workflows must run from inside an op (their own "
+                "execution context)"
             )
         storage = self._lzy.storage_registry.default_client()
         config = self._lzy.storage_registry.default_config()
@@ -127,7 +141,7 @@ class LzyWorkflow:
         with logging_context(wf=self._name, exec=self._execution_id):
             self._lzy.runtime.start(self)
         self._started = True
-        LzyWorkflow._active = self
+        LzyWorkflow._tls.wf = self
         return self
 
     def __exit__(self, exc_type, exc_val, exc_tb) -> None:
@@ -140,7 +154,7 @@ class LzyWorkflow:
             failed = True  # the exit barrier itself failed → abort, not finish
             raise
         finally:
-            LzyWorkflow._active = None
+            LzyWorkflow._tls.wf = None
             self._started = False
             with logging_context(wf=self._name, exec=self._execution_id):
                 if failed:

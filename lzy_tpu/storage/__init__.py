@@ -1,7 +1,7 @@
 from lzy_tpu.storage.api import StorageClient, StorageConfig
 from lzy_tpu.storage.fs import FsStorageClient
 from lzy_tpu.storage.mem import MemStorageClient
-from lzy_tpu.storage.registry import StorageRegistry, DefaultStorageRegistry
+from lzy_tpu.storage.registry import StorageRegistry, DefaultStorageRegistry, client_for
 
 __all__ = [
     "StorageClient",
@@ -10,4 +10,5 @@ __all__ = [
     "MemStorageClient",
     "StorageRegistry",
     "DefaultStorageRegistry",
+    "client_for",
 ]

@@ -1,0 +1,33 @@
+"""Exceptions are never cached: a failed cacheable op re-executes on the next
+run (reference scenario pylzy/tests/scenarios/cached_exception — the op body
+prints twice)."""
+from tests.scenarios._base import make_lzy
+
+from lzy_tpu import op
+from lzy_tpu.core.workflow import RemoteCallError
+
+RUNS = []
+
+
+@op(cache=True, version="1.0")
+def raises(x: int) -> int:
+    RUNS.append(x)
+    raise ValueError("always fails")
+
+
+def main():
+    cluster, lzy = make_lzy()
+    try:
+        for _ in range(2):
+            try:
+                with lzy.workflow("cached-exc"):
+                    raises(5)
+            except RemoteCallError as e:
+                print(f"caught: {type(e.__cause__).__name__}")
+        print(f"executions: {len(RUNS)}")
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""A user-registered serializer carries a custom type through ops (reference
+scenario pylzy/tests/scenarios/custom_serializer)."""
+from typing import BinaryIO, Optional, Type
+
+from tests.scenarios._base import make_lzy
+
+from lzy_tpu import op
+from lzy_tpu.serialization import Serializer
+
+FORMATS_USED = []
+
+
+class Point:
+    def __init__(self, x: int, y: int):
+        self.x = x
+        self.y = y
+
+
+class PointSerializer(Serializer):
+    """Text format instead of pickle — proves the registry dispatched here."""
+
+    def format_name(self) -> str:
+        return "point-csv"
+
+    def supports_type(self, typ: Type) -> bool:
+        return typ is Point
+
+    def serialize(self, obj: Point, dest: BinaryIO) -> None:
+        FORMATS_USED.append(self.format_name())
+        dest.write(f"{obj.x},{obj.y}".encode())
+
+    def deserialize(self, src: BinaryIO, typ: Optional[Type] = None) -> Point:
+        x, y = src.read().decode().split(",")
+        return Point(int(x), int(y))
+
+
+@op
+def shift(p: Point) -> Point:
+    return Point(p.x + 10, p.y + 10)
+
+
+def main():
+    cluster, lzy = make_lzy()
+    lzy.serializer_registry.register(PointSerializer(), priority=0)
+    try:
+        with lzy.workflow("custom-ser"):
+            q = shift(Point(1, 2))
+            print(f"shifted: {q.x} {q.y}")
+        print(f"custom format used: {'point-csv' in FORMATS_USED}")
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

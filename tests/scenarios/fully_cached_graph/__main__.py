@@ -1,0 +1,36 @@
+"""A fully-cached multi-op graph skips every op on the second run (reference
+scenario pylzy/tests/scenarios/fully_cached_graph; server-side CheckCache drops
+satisfied ops before execution)."""
+from tests.scenarios._base import make_lzy
+
+from lzy_tpu import op
+
+RUNS = []
+
+
+@op(cache=True, version="1.0")
+def square(x: int) -> int:
+    RUNS.append(("square", x))
+    return x * x
+
+
+@op(cache=True, version="1.0")
+def add(a: int, b: int) -> int:
+    RUNS.append(("add", a, b))
+    return a + b
+
+
+def main():
+    cluster, lzy = make_lzy()
+    try:
+        for i in range(2):
+            with lzy.workflow("full-cache"):
+                total = add(square(3), square(4))
+                print(f"run {i}: {int(total)}")
+        print(f"executions: {len(RUNS)}")
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Large array values across ops (reference scenario large_input_output —
+a multi-million-row frame through one op; here a 64 MiB float32 array through
+the binary pytree format and the multipart-capable storage path)."""
+import numpy as np
+
+from tests.scenarios._base import make_lzy
+
+from lzy_tpu import op
+
+
+@op
+def normalize(a: np.ndarray) -> np.ndarray:
+    return (a - a.mean()) / (a.std() + 1e-8)
+
+
+def main():
+    cluster, lzy = make_lzy()
+    try:
+        rng = np.random.default_rng(42)
+        big = rng.standard_normal((4096, 4096), dtype=np.float32)  # 64 MiB
+        with lzy.workflow("large-io"):
+            out = normalize(big)
+            print(f"size_input: {big.nbytes}")
+            print(f"size_output: {out.nbytes}")
+            print(f"mean_is_zero: {bool(abs(float(out.mean())) < 1e-5)}")
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Nested workflows: an op hosts its own inner workflow (reference scenario
+pylzy/tests/scenarios/nested_workflows — the inner graph is launched from
+inside an op's execution context, not from the outer workflow's thread)."""
+from tests.scenarios._base import make_lzy
+
+from lzy_tpu import op
+
+CLUSTER = None
+
+
+@op
+def double(x: int) -> int:
+    return 2 * x
+
+
+@op
+def run_inner(x: int) -> int:
+    # runs on a worker thread: entering a workflow here is legal because the
+    # active-workflow slot is per execution context, exactly like the
+    # reference where the inner graph runs inside the op's own process
+    inner = CLUSTER.lzy()
+    with inner.workflow("inner"):
+        doubled = int(double(x))
+    return doubled + 1
+
+
+def main():
+    global CLUSTER
+    cluster, lzy = make_lzy()
+    CLUSTER = cluster
+    try:
+        with lzy.workflow("outer"):
+            r = run_inner(20)
+            print(f"outer got: {int(r)}")
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Two sequential executions of the same named workflow (reference scenario
+pylzy/tests/scenarios/two_execution_one_wf)."""
+from tests.scenarios._base import make_lzy
+
+from lzy_tpu import op
+
+
+@op
+def ret42() -> int:
+    return 42
+
+
+@op
+def ret13() -> int:
+    return 13
+
+
+def main():
+    cluster, lzy = make_lzy()
+    try:
+        with lzy.workflow("wf"):
+            print(int(ret42()))
+        with lzy.workflow("wf"):
+            print(int(ret13()))
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

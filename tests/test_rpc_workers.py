@@ -180,7 +180,7 @@ def test_worker_reregisters_with_rebooted_control_plane(tmp_path):
         # clear the active slot so later tests can open workflows
         from lzy_tpu.core.workflow import LzyWorkflow
 
-        LzyWorkflow._active = None
+        LzyWorkflow.clear_active()
 
 
 def test_auth_errors_cross_rpc(cluster):
@@ -286,7 +286,7 @@ def test_task_survives_control_plane_reboot_mid_execution(tmp_path):
             c2.shutdown()
         from lzy_tpu.core.workflow import LzyWorkflow
 
-        LzyWorkflow._active = None
+        LzyWorkflow.clear_active()
 
 
 def test_worker_plane_requires_worker_token(tmp_path):
