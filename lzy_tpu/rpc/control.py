@@ -250,6 +250,15 @@ class RpcWorkerClient:
         return self._client.call("Status", {
             "op_id": op_id, "token": _token_value(self._token)})
 
+    def add_mount(self, name: str, path: str, read_only: bool = False) -> None:
+        self._client.call("Mount", {
+            "name": name, "path": path, "read_only": read_only,
+            "token": _token_value(self._token)})
+
+    def remove_mount(self, name: str) -> None:
+        self._client.call("Unmount", {
+            "name": name, "token": _token_value(self._token)})
+
     def stop(self) -> None:
         try:
             self._client.call("Shutdown",

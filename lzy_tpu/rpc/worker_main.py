@@ -103,11 +103,24 @@ def main(argv=None) -> None:
         stop_event.set()
         return {}
 
+    def h_mount(p):
+        check_caller(p)
+        agent_box["agent"].add_mount(p["name"], p["path"],
+                                     p.get("read_only", False))
+        return {}
+
+    def h_unmount(p):
+        check_caller(p)
+        agent_box["agent"].remove_mount(p["name"])
+        return {}
+
     server = JsonRpcServer({
         "Init": h_init,
         "Execute": h_execute,
         "Status": h_status,
         "Shutdown": h_shutdown,
+        "Mount": h_mount,
+        "Unmount": h_unmount,
     }, port=args.port, advertise_host=args.advertise_host)
 
     allocator = RpcAllocatorClient(control, endpoint=server.address,

@@ -117,11 +117,13 @@ class AllocatorService:
         *,
         allocate_timeout_s: float = 120.0,
         iam=None,                          # Optional[IamService]
+        disks=None,                        # Optional[DiskService]
     ):
         self._store = store
         self._executor = executor
         self._backend = backend
         self._iam = iam
+        self._disks = disks
         self._pools: Dict[str, PoolSpec] = {p.label: p for p in pools}
         self._sessions: Dict[str, Session] = {}
         self._vms: Dict[str, Vm] = {}
@@ -130,6 +132,8 @@ class AllocatorService:
         self._allocate_timeout_s = allocate_timeout_s
         executor.register("allocate_gang", self._make_allocate_action)
         executor.register("delete_session", self._make_delete_session_action)
+        executor.register("mount_disk", self._make_mount_action)
+        executor.register("unmount_disk", self._make_unmount_action)
         self._restore()
 
     def _restore(self) -> None:
@@ -189,6 +193,41 @@ class AllocatorService:
              "gang_size": pool.hosts},
             deadline_s=self._allocate_timeout_s,
         )
+
+    def mount_disk(self, vm_id: str, disk_id: str, mount_name: str,
+                   *, read_only: bool = False) -> str:
+        """Dynamically bind a disk into a RUNNING VM's workers; returns the
+        operation id (``Allocator.Mount`` / ``MountDynamicDiskAction``
+        parity). Op bodies see the realized path under
+        ``current_mounts()[mount_name]``."""
+        from lzy_tpu.service.disks import validate_mount_name
+
+        if self._disks is None:
+            raise RuntimeError("no DiskService wired into this allocator")
+        validate_mount_name(mount_name)      # becomes paths/pod names/shell
+        self.vm(vm_id)                       # fail fast on unknown VM
+        self._disks.get(disk_id)             # and unknown disk
+        return self._executor.submit(
+            "mount_disk",
+            {"vm_id": vm_id, "disk_id": disk_id, "mount_name": mount_name,
+             "read_only": read_only},
+            # a VM that never registers must fail the mount, not spin forever
+            deadline_s=self._allocate_timeout_s,
+        )
+
+    def unmount_disk(self, vm_id: str, mount_name: str) -> str:
+        """Reverse of ``mount_disk`` (``Allocator.Unmount`` parity)."""
+        return self._executor.submit(
+            "unmount_disk", {"vm_id": vm_id, "mount_name": mount_name},
+        )
+
+    def vm_mounts(self, vm_id: str) -> Dict[str, Any]:
+        """Recorded mounts for a VM, keyed by mount name."""
+        out = {}
+        for key, doc in self._store.kv_list("vm_mounts").items():
+            if key.startswith(vm_id + "/"):
+                out[key.split("/", 1)[1]] = doc
+        return out
 
     def free(self, vm_ids: Sequence[str]) -> None:
         """Return a gang to the session cache (VM → IDLE, reused until the
@@ -321,6 +360,10 @@ class AllocatorService:
             with self._lock:
                 self._vms.pop(vm.id, None)
             self._store.kv_del("vms", vm.id)
+            # mounts die with the VM (the disks themselves survive)
+            for key in list(self._store.kv_list("vm_mounts")):
+                if key.startswith(vm.id + "/"):
+                    self._store.kv_del("vm_mounts", key)
             if self._iam is not None and vm.worker_token:
                 # the credential dies with the VM
                 self._iam.remove_subject(f"vm/{vm.id}")
@@ -351,6 +394,12 @@ class AllocatorService:
 
     def _make_delete_session_action(self, record, store, executor):
         return _DeleteSessionAction(record, store, executor, self)
+
+    def _make_mount_action(self, record, store, executor):
+        return _MountDiskAction(record, store, executor, self)
+
+    def _make_unmount_action(self, record, store, executor):
+        return _UnmountDiskAction(record, store, executor, self)
 
 
 class _AllocateGangAction(OperationRunner):
@@ -498,4 +547,105 @@ class _DeleteSessionAction(OperationRunner):
         with self.svc._lock:
             self.svc._sessions.pop(session_id, None)
         self.svc._store.kv_del("sessions", session_id)
+        return StepResult.finish(None)
+
+
+class _MountDiskAction(OperationRunner):
+    """Steps: resolve (realize the disk to a worker-visible path) → attach
+    (tell the VM's agent) → record. Counterpart of the reference's
+    ``MountDynamicDiskAction`` (``alloc/MountDynamicDiskAction.java``), minus
+    the cloud attach leg: local disks are directories, PVC disks are realized
+    by the backend's mount-holder pod (``KuberMountHolderManager`` parity)."""
+
+    kind = "mount_disk"
+
+    def __init__(self, record, store, executor, svc: AllocatorService):
+        super().__init__(record, store, executor)
+        self.svc = svc
+
+    def steps(self):
+        return [
+            ("resolve", self._resolve),
+            ("attach", self._attach),
+            ("record", self._record),
+        ]
+
+    def _mount(self):
+        from lzy_tpu.service.disks import DiskMount
+
+        return DiskMount(disk_id=self.state["disk_id"],
+                         mount_name=self.state["mount_name"],
+                         read_only=self.state.get("read_only", False))
+
+    def _resolve(self):
+        if self.state.get("path"):
+            return StepResult.ALREADY_DONE
+        self.hook("resolve")
+        vm = self.svc.vm(self.state["vm_id"])          # KeyError → op fails
+        disk = self.svc._disks.get(self.state["disk_id"])
+        path = self.svc._disks.manager.local_path(disk.id)
+        if path is None:
+            # PVC-backed: the backend realizes the claim next to the worker
+            # pod (mount-holder) and reports the worker-visible path
+            mount_fn = getattr(self.svc._backend, "mount", None)
+            if mount_fn is None:
+                raise RuntimeError(
+                    f"backend {type(self.svc._backend).__name__} cannot "
+                    f"realize PVC-backed disks; use a local disk manager or "
+                    f"the GKE backend"
+                )
+            path = mount_fn(vm, disk, self._mount())
+        self.state["path"] = path
+        return StepResult.CONTINUE
+
+    def _attach(self):
+        vm = self.svc.vm(self.state["vm_id"])
+        if vm.status not in (RUNNING, IDLE):
+            return StepResult.restart(0.2)   # agent still booting
+        try:
+            agent = self.svc.agent(self.state["vm_id"])
+        except KeyError:
+            return StepResult.restart(0.2)
+        agent.add_mount(self.state["mount_name"], self.state["path"],
+                        self.state.get("read_only", False))
+        return StepResult.CONTINUE
+
+    def _record(self):
+        self.svc._store.kv_put(
+            "vm_mounts",
+            f"{self.state['vm_id']}/{self.state['mount_name']}",
+            {"disk_id": self.state["disk_id"], "path": self.state["path"],
+             "read_only": self.state.get("read_only", False)},
+        )
+        return StepResult.finish({"path": self.state["path"]})
+
+
+class _UnmountDiskAction(OperationRunner):
+    kind = "unmount_disk"
+
+    def __init__(self, record, store, executor, svc: AllocatorService):
+        super().__init__(record, store, executor)
+        self.svc = svc
+
+    def steps(self):
+        return [("detach", self._detach), ("unrecord", self._unrecord)]
+
+    def _detach(self):
+        vm_id = self.state["vm_id"]
+        name = self.state["mount_name"]
+        try:
+            self.svc.agent(vm_id).remove_mount(name)
+        except KeyError:
+            pass                              # VM already gone
+        unmount_fn = getattr(self.svc._backend, "unmount", None)
+        if unmount_fn is not None:
+            try:
+                unmount_fn(self.svc.vm(vm_id), name)
+            except KeyError:
+                pass
+        return StepResult.CONTINUE
+
+    def _unrecord(self):
+        self.svc._store.kv_del(
+            "vm_mounts", f"{self.state['vm_id']}/{self.state['mount_name']}")
         return StepResult.finish(None)

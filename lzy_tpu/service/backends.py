@@ -194,9 +194,17 @@ class GkeTpuBackend(VmBackend):
         self._spill_dir = spill_dir
         self.allocator = None
 
+    # dynamic-mount path contract (KuberMountHolderManager parity)
+    HOST_MOUNT_BASE = "/var/lib/lzy-mounts"   # per-VM dir on the node
+    WORKER_MOUNT_DIR = "/mnt/lzy"             # where workers see the dir
+
     @staticmethod
     def pod_name(vm: Vm) -> str:
         return f"lzy-{vm.id}".lower().replace("_", "-")
+
+    @staticmethod
+    def holder_name(vm: Vm, mount_name: str) -> str:
+        return f"lzy-mnt-{vm.id}-{mount_name}".lower().replace("_", "-")
 
     def build_pod_manifest(self, vm: Vm, pool: PoolSpec) -> dict:
         from lzy_tpu.service.kube import GKE_TPU_ACCELERATOR
@@ -224,7 +232,23 @@ class GkeTpuBackend(VmBackend):
             "env": env,
             "ports": [{"containerPort": 18900, "name": "worker-api"}],
         }
-        spec: dict = {"containers": [container], "restartPolicy": "Never"}
+        # dynamic disk mounts surface under /mnt/lzy: a mount-holder pod
+        # binds each PVC into the per-VM host dir, and HostToContainer
+        # propagation makes it appear here without restarting the worker
+        container["volumeMounts"] = [{
+            "name": "lzy-dyn-mounts",
+            "mountPath": self.WORKER_MOUNT_DIR,
+            "mountPropagation": "HostToContainer",
+        }]
+        spec: dict = {
+            "containers": [container],
+            "restartPolicy": "Never",
+            "volumes": [{
+                "name": "lzy-dyn-mounts",
+                "hostPath": {"path": f"{self.HOST_MOUNT_BASE}/{vm.id}",
+                             "type": "DirectoryOrCreate"},
+            }],
+        }
         if self._service_account:
             spec["serviceAccountName"] = self._service_account
         if is_tpu:
@@ -283,8 +307,89 @@ class GkeTpuBackend(VmBackend):
         for manifest in self._api.list_pods(
             self._namespace, label_selector=f"lzy/vm-id={vm.id}"
         ):
-            return manifest.get("status", {}).get("phase")
+            # mount-holder pods share the vm-id label; only the worker pod's
+            # phase may drive the recreate decision
+            if manifest.get("metadata", {}).get("name") == self.pod_name(vm):
+                return manifest.get("status", {}).get("phase")
         return None
+
+    def mount(self, vm: Vm, disk, mount) -> str:
+        """Realize a PVC-backed disk next to a RUNNING worker pod via a
+        mount-holder pod (``KuberMountHolderManager`` parity): k8s cannot
+        attach a volume to a live pod, so the holder mounts the claim and
+        bind-mounts it into the per-VM host dir; Bidirectional propagation
+        makes it visible inside the worker under ``WORKER_MOUNT_DIR``.
+        Returns the worker-visible path. Idempotent per mount name."""
+        from lzy_tpu.service.disks import PvcDiskManager, validate_mount_name
+        from lzy_tpu.service.kube import KubeConflict
+
+        # re-validated here: the name is embedded in a privileged shell line
+        validate_mount_name(mount.mount_name)
+        name = self.holder_name(vm, mount.mount_name)
+        host_dir = f"{self.HOST_MOUNT_BASE}/{vm.id}"
+        manifest = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "labels": {
+                    "lzy/vm-id": vm.id,
+                    "lzy/mount-name": mount.mount_name,
+                    "lzy/role": "mount-holder",
+                    "app.kubernetes.io/managed-by": "lzy-tpu",
+                },
+            },
+            "spec": {
+                "restartPolicy": "Never",
+                # land on the worker's node or the bind-mount is invisible
+                "affinity": {"podAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [{
+                        "labelSelector": {
+                            "matchLabels": {"lzy/vm-id": vm.id}},
+                        "topologyKey": "kubernetes.io/hostname",
+                    }],
+                }},
+                "containers": [{
+                    "name": "holder",
+                    "image": self._image,
+                    "command": ["sh", "-c"],
+                    "args": [
+                        f"mkdir -p /host/{mount.mount_name} && "
+                        f"mount --bind "
+                        f"{'-o ro ' if mount.read_only else ''}"
+                        f"/disk /host/{mount.mount_name} && "
+                        f"sleep infinity"
+                    ],
+                    "securityContext": {"privileged": True},
+                    "volumeMounts": [
+                        {"name": "disk", "mountPath": "/disk"},
+                        {"name": "host", "mountPath": "/host",
+                         "mountPropagation": "Bidirectional"},
+                    ],
+                }],
+                "volumes": [
+                    {"name": "disk", "persistentVolumeClaim": {
+                        "claimName": PvcDiskManager.claim_name(disk.id),
+                        "readOnly": mount.read_only}},
+                    {"name": "host", "hostPath": {
+                        "path": host_dir, "type": "DirectoryOrCreate"}},
+                ],
+            },
+        }
+        try:
+            self._api.create_pod(self._namespace, manifest)
+        except KubeConflict:
+            pass  # durable-op resume
+        return f"{self.WORKER_MOUNT_DIR}/{mount.mount_name}"
+
+    def unmount(self, vm: Vm, mount_name: str) -> None:
+        from lzy_tpu.service.kube import KubeNotFound
+
+        try:
+            self._api.delete_pod(self._namespace,
+                                 self.holder_name(vm, mount_name))
+        except KubeNotFound:
+            pass
 
     def destroy(self, vm: Vm) -> None:
         from lzy_tpu.service.kube import KubeNotFound
@@ -293,6 +398,16 @@ class GkeTpuBackend(VmBackend):
             self._api.delete_pod(self._namespace, self.pod_name(vm))
         except KubeNotFound:
             pass
+        # mount-holder pods die with the VM
+        for manifest in self._api.list_pods(
+            self._namespace,
+            label_selector=f"lzy/vm-id={vm.id},lzy/role=mount-holder",
+        ):
+            try:
+                self._api.delete_pod(self._namespace,
+                                     manifest["metadata"]["name"])
+            except KubeNotFound:
+                pass
 
     def reconcile_orphans(self, live_vm_ids) -> List[str]:
         """Delete managed pods whose VM record no longer exists (crash between

@@ -1,0 +1,429 @@
+"""Disks and volumes: durable create/clone/delete + dynamic mounts.
+
+Counterpart of the reference allocator's disk subsystem — the ``DiskManager``
+interface with operation-shaped create/clone/delete
+(``lzy/allocator/src/main/java/ai/lzy/allocator/disk/DiskManager.java:10-34``,
+``DiskSpec.java``, ``DiskType.java``), the YC implementation's durable disk
+actions (``disk/impl/yc/Yc*DiskAction.java``), volumes realized in Kubernetes
+by ``KuberVolumeManager`` (``volume/KuberVolumeManager.java``), and dynamic
+mounts onto running VMs (``alloc/MountDynamicDiskAction.java``,
+``KuberMountHolderManager.java``).
+
+TPU-first redesign: a disk is durable scratch/dataset space for data-plane ops
+(tokenized corpora, checkpoint staging) — device state itself never lives on
+disks (jax.Array channels and orbax-style checkpoints own that). Two managers
+behind one interface:
+
+- ``LocalDiskManager``: directory-backed disks for thread/process workers;
+  clone is a file-level copy. This is also the test double, the
+  ``MockDiskManager`` role.
+- ``PvcDiskManager``: GKE PersistentVolumeClaims; ``DiskType`` maps to a GKE
+  storage class, clone uses the CSI ``dataSource`` PVC-clone path, and worker
+  pods receive the claim as a pod volume (no per-cloud disk API calls — the
+  CSI driver owns attachment, which is the idiomatic GKE shape for the
+  reference's YC disk+attach flow).
+
+Create/clone/delete run as durable operations (crash-safe, idempotent,
+resume-on-boot) exactly like gang allocation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import re
+import shutil
+import time
+from typing import Dict, List, Optional
+
+from lzy_tpu.durable.runner import OperationRunner, OperationsExecutor, StepResult
+from lzy_tpu.durable.store import FAILED, OperationStore
+from lzy_tpu.utils.ids import gen_id
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+_KV_NS = "disks"
+
+
+class DiskType(enum.Enum):
+    """Reference ``DiskType`` {HDD, SSD, NR_SSD} re-based on GKE storage
+    classes (``DiskType.java:8-11``)."""
+
+    HDD = "hdd"
+    SSD = "ssd"
+    BALANCED = "balanced"
+
+    @property
+    def storage_class(self) -> str:
+        return _STORAGE_CLASSES[self]
+
+
+_STORAGE_CLASSES = {
+    DiskType.HDD: "standard-rwo",
+    DiskType.SSD: "premium-rwo",
+    DiskType.BALANCED: "balanced-rwo",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class DiskSpec:
+    """``DiskSpec.java:9-14`` — name/type/size/zone."""
+
+    name: str
+    type: DiskType = DiskType.SSD
+    size_gb: int = 10
+    zone: str = ""
+
+    def to_doc(self) -> dict:
+        return {"name": self.name, "type": self.type.value,
+                "size_gb": self.size_gb, "zone": self.zone}
+
+    @staticmethod
+    def from_doc(doc: dict) -> "DiskSpec":
+        return DiskSpec(name=doc["name"], type=DiskType(doc["type"]),
+                        size_gb=doc["size_gb"], zone=doc.get("zone", ""))
+
+
+@dataclasses.dataclass(frozen=True)
+class DiskMeta:
+    """``DiskMeta.java`` — ownership for IAM scoping."""
+
+    user: str = ""
+
+    def to_doc(self) -> dict:
+        return {"user": self.user}
+
+    @staticmethod
+    def from_doc(doc: dict) -> "DiskMeta":
+        return DiskMeta(user=doc.get("user", ""))
+
+
+@dataclasses.dataclass(frozen=True)
+class Disk:
+    id: str
+    spec: DiskSpec
+    meta: DiskMeta
+    created_ts: float = 0.0
+
+    def to_doc(self) -> dict:
+        return {"id": self.id, "spec": self.spec.to_doc(),
+                "meta": self.meta.to_doc(), "created_ts": self.created_ts}
+
+    @staticmethod
+    def from_doc(doc: dict) -> "Disk":
+        return Disk(id=doc["id"], spec=DiskSpec.from_doc(doc["spec"]),
+                    meta=DiskMeta.from_doc(doc["meta"]),
+                    created_ts=doc.get("created_ts", 0.0))
+
+
+_MOUNT_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9-]{0,62}$")
+
+
+def validate_mount_name(name: str) -> str:
+    """Mount names become filesystem paths, pod names, k8s labels, and (for
+    PVC disks) part of a privileged shell command — anything outside
+    ``[a-z0-9-]`` is rejected outright."""
+    if not _MOUNT_NAME_RE.match(name or ""):
+        raise ValueError(
+            f"invalid mount name {name!r}: must match {_MOUNT_NAME_RE.pattern}"
+        )
+    return name
+
+
+@dataclasses.dataclass(frozen=True)
+class DiskMount:
+    """A disk bound into a running VM (``MountDynamicDiskAction`` parity).
+    ``mount_name`` is the op-visible key; workers expose the realized path via
+    ``lzy_tpu.service.worker.current_mounts()``."""
+
+    disk_id: str
+    mount_name: str
+    read_only: bool = False
+
+    def __post_init__(self):
+        validate_mount_name(self.mount_name)
+
+
+class DiskManager:
+    """Backend interface (``DiskManager.java:10``). Implementations must be
+    idempotent per disk id: durable actions re-run steps after a crash."""
+
+    def create(self, disk_id: str, spec: DiskSpec, meta: DiskMeta) -> None:
+        raise NotImplementedError
+
+    def clone(self, src: Disk, disk_id: str, spec: DiskSpec,
+              meta: DiskMeta) -> None:
+        raise NotImplementedError
+
+    def delete(self, disk_id: str) -> None:
+        """Absent disks are not an error (idempotent resume)."""
+        raise NotImplementedError
+
+    def exists(self, disk_id: str) -> bool:
+        raise NotImplementedError
+
+    def local_path(self, disk_id: str) -> Optional[str]:
+        """Filesystem path for locally-realized disks; None for PVC-backed
+        disks (those reach workers as pod volumes, not host paths)."""
+        return None
+
+
+class LocalDiskManager(DiskManager):
+    """Directory-per-disk under ``root``; doubles as the reference's
+    ``MockDiskManager`` for tests."""
+
+    def __init__(self, root: str):
+        self._root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _dir(self, disk_id: str) -> str:
+        return os.path.join(self._root, disk_id)
+
+    def create(self, disk_id: str, spec: DiskSpec, meta: DiskMeta) -> None:
+        d = self._dir(disk_id)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, ".disk.json"), "w") as f:
+            json.dump({"spec": spec.to_doc(), "meta": meta.to_doc()}, f)
+
+    def clone(self, src: Disk, disk_id: str, spec: DiskSpec,
+              meta: DiskMeta) -> None:
+        dest = self._dir(disk_id)
+        if os.path.exists(dest):  # idempotent resume
+            return
+        shutil.copytree(self._dir(src.id), dest)
+        with open(os.path.join(dest, ".disk.json"), "w") as f:
+            json.dump({"spec": spec.to_doc(), "meta": meta.to_doc()}, f)
+
+    def delete(self, disk_id: str) -> None:
+        shutil.rmtree(self._dir(disk_id), ignore_errors=True)
+
+    def exists(self, disk_id: str) -> bool:
+        return os.path.isdir(self._dir(disk_id))
+
+    def local_path(self, disk_id: str) -> Optional[str]:
+        return self._dir(disk_id)
+
+
+class PvcDiskManager(DiskManager):
+    """One PersistentVolumeClaim per disk (``KuberVolumeManager`` +
+    ``YcDiskManager`` collapsed: GKE's CSI driver provisions/attaches, so the
+    separate cloud-disk API leg disappears)."""
+
+    def __init__(self, api, namespace: str = "lzy-tpu"):
+        self._api = api
+        self._namespace = namespace
+
+    @staticmethod
+    def claim_name(disk_id: str) -> str:
+        return f"lzy-disk-{disk_id}".lower().replace("_", "-")
+
+    def _manifest(self, disk_id: str, spec: DiskSpec,
+                  source_disk_id: Optional[str] = None) -> dict:
+        manifest = {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": {
+                "name": self.claim_name(disk_id),
+                "labels": {"app": "lzy-tpu", "lzy-disk-id": disk_id,
+                           "lzy-disk-name": spec.name},
+            },
+            "spec": {
+                "accessModes": ["ReadWriteOnce"],
+                "storageClassName": spec.type.storage_class,
+                "resources": {"requests": {
+                    "storage": f"{spec.size_gb}Gi"}},
+            },
+        }
+        if source_disk_id is not None:
+            # CSI volume cloning: the idiomatic k8s form of the reference's
+            # YC snapshot+restore clone chain
+            manifest["spec"]["dataSource"] = {
+                "kind": "PersistentVolumeClaim",
+                "name": self.claim_name(source_disk_id),
+            }
+        return manifest
+
+    def create(self, disk_id: str, spec: DiskSpec, meta: DiskMeta) -> None:
+        from lzy_tpu.service.kube import KubeConflict
+
+        try:
+            self._api.create_pvc(self._namespace,
+                                 self._manifest(disk_id, spec))
+        except KubeConflict:
+            pass  # durable resume re-ran the step
+
+    def clone(self, src: Disk, disk_id: str, spec: DiskSpec,
+              meta: DiskMeta) -> None:
+        from lzy_tpu.service.kube import KubeConflict
+
+        try:
+            self._api.create_pvc(
+                self._namespace,
+                self._manifest(disk_id, spec, source_disk_id=src.id))
+        except KubeConflict:
+            pass
+
+    def delete(self, disk_id: str) -> None:
+        from lzy_tpu.service.kube import KubeNotFound
+
+        try:
+            self._api.delete_pvc(self._namespace, self.claim_name(disk_id))
+        except KubeNotFound:
+            pass
+
+    def exists(self, disk_id: str) -> bool:
+        claims = self._api.list_pvcs(self._namespace,
+                                     label_selector=f"lzy-disk-id={disk_id}")
+        return bool(claims)
+
+
+class DiskService:
+    """Create/clone/delete as durable operations + the disk registry
+    (``DiskService`` gRPC facade + DAO in the reference)."""
+
+    def __init__(self, store: OperationStore, executor: OperationsExecutor,
+                 manager: DiskManager):
+        self._store = store
+        self._executor = executor
+        self.manager = manager
+        executor.register("create_disk", self._make_action(_CreateDiskAction))
+        executor.register("clone_disk", self._make_action(_CloneDiskAction))
+        executor.register("delete_disk", self._make_action(_DeleteDiskAction))
+
+    def _make_action(self, cls):
+        def make(record, store, executor):
+            return cls(record, store, executor, self)
+        return make
+
+    # -- registry ---------------------------------------------------------------
+
+    def get(self, disk_id: str) -> Disk:
+        doc = self._store.kv_get(_KV_NS, disk_id)
+        if doc is None:
+            raise KeyError(f"unknown disk {disk_id!r}")
+        return Disk.from_doc(doc)
+
+    def list(self, user: Optional[str] = None) -> List[Disk]:
+        disks = [Disk.from_doc(d) for d in self._store.kv_list(_KV_NS).values()]
+        if user is not None:
+            disks = [d for d in disks if d.meta.user == user]
+        return sorted(disks, key=lambda d: d.created_ts)
+
+    # -- operations -------------------------------------------------------------
+
+    def create_disk(self, spec: DiskSpec, meta: DiskMeta = DiskMeta(),
+                    *, idempotency_key: Optional[str] = None) -> str:
+        """Starts a durable create; returns the operation id; op result is the
+        disk doc."""
+        return self._executor.submit(
+            "create_disk",
+            {"disk_id": gen_id("disk"), "spec": spec.to_doc(),
+             "meta": meta.to_doc()},
+            idempotency_key=idempotency_key,
+        )
+
+    def clone_disk(self, src_disk_id: str, spec: DiskSpec,
+                   meta: DiskMeta = DiskMeta(),
+                   *, idempotency_key: Optional[str] = None) -> str:
+        self.get(src_disk_id)  # fail fast on unknown source
+        return self._executor.submit(
+            "clone_disk",
+            {"disk_id": gen_id("disk"), "src_disk_id": src_disk_id,
+             "spec": spec.to_doc(), "meta": meta.to_doc()},
+            idempotency_key=idempotency_key,
+        )
+
+    def delete_disk(self, disk_id: str,
+                    *, idempotency_key: Optional[str] = None) -> str:
+        return self._executor.submit(
+            "delete_disk", {"disk_id": disk_id},
+            idempotency_key=idempotency_key,
+        )
+
+    def await_disk(self, op_id: str, timeout_s: float = 30.0) -> Disk:
+        record = self._executor.await_op(op_id, timeout_s=timeout_s)
+        if record.status == FAILED:
+            raise RuntimeError(f"disk operation failed: {record.error}")
+        return Disk.from_doc(record.result)
+
+    # internal: used by actions
+    def _register(self, disk: Disk) -> None:
+        self._store.kv_put(_KV_NS, disk.id, disk.to_doc())
+
+    def _unregister(self, disk_id: str) -> None:
+        self._store.kv_del(_KV_NS, disk_id)
+
+
+class _CreateDiskAction(OperationRunner):
+    """create → register. A crash between the two resumes and re-runs both
+    (manager.create is idempotent per disk id)."""
+
+    kind = "create_disk"
+
+    def __init__(self, record, store, executor, svc: DiskService):
+        super().__init__(record, store, executor)
+        self.svc = svc
+
+    def steps(self):
+        return [("create", self._create), ("register", self._register)]
+
+    def _disk(self) -> Disk:
+        return Disk(
+            id=self.state["disk_id"],
+            spec=DiskSpec.from_doc(self.state["spec"]),
+            meta=DiskMeta.from_doc(self.state["meta"]),
+            created_ts=self.state.setdefault("created_ts", time.time()),
+        )
+
+    def _create(self):
+        self.hook("create")
+        disk = self._disk()
+        self.svc.manager.create(disk.id, disk.spec, disk.meta)
+        return StepResult.CONTINUE
+
+    def _register(self):
+        self.hook("register")
+        disk = self._disk()
+        self.svc._register(disk)
+        return StepResult.finish(disk.to_doc())
+
+    def on_failed(self, error):
+        # compensate: never leave an unregistered backend volume behind
+        self.svc.manager.delete(self.state["disk_id"])
+
+
+class _CloneDiskAction(_CreateDiskAction):
+    kind = "clone_disk"
+
+    def _create(self):
+        self.hook("clone")
+        disk = self._disk()
+        src = self.svc.get(self.state["src_disk_id"])
+        self.svc.manager.clone(src, disk.id, disk.spec, disk.meta)
+        return StepResult.CONTINUE
+
+
+class _DeleteDiskAction(OperationRunner):
+    """unregister → delete: after the registry forgets the disk no new mounts
+    can race the backend deletion."""
+
+    kind = "delete_disk"
+
+    def __init__(self, record, store, executor, svc: DiskService):
+        super().__init__(record, store, executor)
+        self.svc = svc
+
+    def steps(self):
+        return [("unregister", self._unregister), ("delete", self._delete)]
+
+    def _unregister(self):
+        self.svc._unregister(self.state["disk_id"])
+        return StepResult.CONTINUE
+
+    def _delete(self):
+        self.hook("delete")
+        self.svc.manager.delete(self.state["disk_id"])
+        return StepResult.finish({"disk_id": self.state["disk_id"]})

@@ -87,9 +87,19 @@ class InProcessCluster:
             from lzy_tpu.iam import IamService
 
             self.iam = IamService(self.store)
+        # disk subsystem: local directory-backed disks next to the metadata
+        # store (the PVC manager replaces this in a GKE deployment)
+        import tempfile
+
+        from lzy_tpu.service.disks import DiskService, LocalDiskManager
+
+        self.disks = DiskService(
+            self.store, self.executor,
+            LocalDiskManager(tempfile.mkdtemp(prefix="lzy-disks-")),
+        )
         self.allocator = AllocatorService(
             self.store, self.executor, self.backend, pools or DEFAULT_POOLS,
-            iam=self.iam,
+            iam=self.iam, disks=self.disks,
         )
         self.backend.allocator = self.allocator
         self.graph_executor = GraphExecutor(

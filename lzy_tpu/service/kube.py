@@ -58,6 +58,21 @@ class KubeApi(abc.ABC):
                   label_selector: str = "") -> List[dict]:
         """Returns pod manifests (dicts with metadata/spec/status)."""
 
+    # -- PersistentVolumeClaims (disk subsystem; KuberVolumeManager parity) -----
+
+    @abc.abstractmethod
+    def create_pvc(self, namespace: str, manifest: dict) -> None:
+        """Raises KubeConflict if a claim with that name exists."""
+
+    @abc.abstractmethod
+    def delete_pvc(self, namespace: str, name: str) -> None:
+        """Raises KubeNotFound if absent."""
+
+    @abc.abstractmethod
+    def list_pvcs(self, namespace: str,
+                  label_selector: str = "") -> List[dict]:
+        """Returns claim manifests."""
+
 
 class KubernetesKubeApi(KubeApi):
     """Real cluster API via the ``kubernetes`` python client (not bundled in
@@ -100,6 +115,32 @@ class KubernetesKubeApi(KubeApi):
         return [self._core.api_client.sanitize_for_serialization(p)
                 for p in ret.items]
 
+    def create_pvc(self, namespace: str, manifest: dict) -> None:
+        try:
+            self._core.create_namespaced_persistent_volume_claim(
+                namespace, manifest)
+        except self._exc as e:
+            if e.status == 409:
+                raise KubeConflict(manifest["metadata"]["name"]) from e
+            raise
+
+    def delete_pvc(self, namespace: str, name: str) -> None:
+        try:
+            self._core.delete_namespaced_persistent_volume_claim(
+                name, namespace)
+        except self._exc as e:
+            if e.status == 404:
+                raise KubeNotFound(name) from e
+            raise
+
+    def list_pvcs(self, namespace: str,
+                  label_selector: str = "") -> List[dict]:
+        ret = self._core.list_namespaced_persistent_volume_claim(
+            namespace, label_selector=label_selector
+        )
+        return [self._core.api_client.sanitize_for_serialization(p)
+                for p in ret.items]
+
 
 class FakeKubeApi(KubeApi):
     """In-memory cluster for tests and dry runs: stores manifests, enforces
@@ -107,8 +148,22 @@ class FakeKubeApi(KubeApi):
 
     def __init__(self):
         self.pods: Dict[str, Dict[str, dict]] = {}   # ns -> name -> manifest
+        self.pvcs: Dict[str, Dict[str, dict]] = {}   # ns -> name -> manifest
         self.create_calls = 0
         self.delete_calls = 0
+
+    @staticmethod
+    def _select(store: Dict[str, dict], label_selector: str) -> List[dict]:
+        wanted = dict(
+            part.split("=", 1)
+            for part in label_selector.split(",") if "=" in part
+        )
+        out = []
+        for manifest in store.values():
+            labels = manifest.get("metadata", {}).get("labels", {})
+            if all(labels.get(k) == v for k, v in wanted.items()):
+                out.append(manifest)
+        return out
 
     def create_pod(self, namespace: str, manifest: dict) -> None:
         self.create_calls += 1
@@ -127,13 +182,21 @@ class FakeKubeApi(KubeApi):
 
     def list_pods(self, namespace: str,
                   label_selector: str = "") -> List[dict]:
-        wanted = dict(
-            part.split("=", 1)
-            for part in label_selector.split(",") if "=" in part
-        )
-        out = []
-        for manifest in self.pods.get(namespace, {}).values():
-            labels = manifest.get("metadata", {}).get("labels", {})
-            if all(labels.get(k) == v for k, v in wanted.items()):
-                out.append(manifest)
-        return out
+        return self._select(self.pods.get(namespace, {}), label_selector)
+
+    def create_pvc(self, namespace: str, manifest: dict) -> None:
+        ns = self.pvcs.setdefault(namespace, {})
+        name = manifest["metadata"]["name"]
+        if name in ns:
+            raise KubeConflict(name)
+        ns[name] = manifest
+
+    def delete_pvc(self, namespace: str, name: str) -> None:
+        ns = self.pvcs.get(namespace, {})
+        if name not in ns:
+            raise KubeNotFound(name)
+        del ns[name]
+
+    def list_pvcs(self, namespace: str,
+                  label_selector: str = "") -> List[dict]:
+        return self._select(self.pvcs.get(namespace, {}), label_selector)

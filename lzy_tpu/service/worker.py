@@ -51,6 +51,20 @@ def current_gang() -> Optional[Dict[str, Any]]:
     return _GANG.get()
 
 
+# mounts visible to op bodies: {mount_name: {"path": str, "read_only": bool}}
+# (the realized form of dynamic disk mounts, MountDynamicDiskAction parity)
+_MOUNTS: contextvars.ContextVar[Optional[Dict[str, Any]]] = contextvars.ContextVar(
+    "lzy_mounts", default=None
+)
+
+
+def current_mounts() -> Dict[str, Any]:
+    """Disk mounts available to the currently-executing op, keyed by the
+    mount name given to ``AllocatorService.mount_disk``. Empty outside a
+    mounted worker."""
+    return dict(_MOUNTS.get() or {})
+
+
 class _StdRouter(io.TextIOBase):
     """Thread-safe stdout/stderr tee: lines from a task thread go to that
     task's log buffer (and the real stream); other threads pass through.
@@ -125,6 +139,7 @@ class WorkerAgent:
         self._container_runtime = container_runtime
         self._env_realizer = None          # built lazily (isolated mode only)
         self._env_lock = threading.RLock()
+        self._mounts: Dict[str, Dict[str, Any]] = {}   # name -> {path, read_only}
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_period_s,),
             name=f"hb-{vm_id}", daemon=True,
@@ -141,6 +156,18 @@ class WorkerAgent:
         if self._slot_server is not None:
             self._slot_server.stop()
             self._slot_server = None
+
+    # -- dynamic disk mounts (MountDynamicDiskAction parity) -------------------
+
+    def add_mount(self, name: str, path: str, read_only: bool = False) -> None:
+        """Bind a realized disk path into this worker; later-started op bodies
+        see it via ``current_mounts()``. Idempotent per mount name."""
+        with self._lock:
+            self._mounts[name] = {"path": path, "read_only": read_only}
+
+    def remove_mount(self, name: str) -> None:
+        with self._lock:
+            self._mounts.pop(name, None)
 
     def _heartbeat_loop(self, period_s: float) -> None:
         failures = 0
@@ -197,6 +224,9 @@ class WorkerAgent:
         log_buf = io.StringIO()
         token_route = _StdRouter._route.set(log_buf)
         token_gang = _GANG.set({"rank": gang_rank, "size": task.gang_size, **gang})
+        with self._lock:
+            mounts_snapshot = dict(self._mounts)
+        token_mounts = _MOUNTS.set(mounts_snapshot)
         try:
             with logging_context(task=task.id, vm=self.vm_id, rank=str(gang_rank)):
                 self._execute_task(task, gang_rank)
@@ -218,6 +248,7 @@ class WorkerAgent:
                     status="FAILED", error=repr(e), exception_uri=exception_uri
                 )
         finally:
+            _MOUNTS.reset(token_mounts)
             _GANG.reset(token_gang)
             _StdRouter._route.reset(token_route)
             # every rank's output reaches the log plane (isolated gang ranks

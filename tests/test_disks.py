@@ -1,0 +1,262 @@
+"""Disk/volume subsystem: durable create/clone/delete, PVC realization, and
+dynamic mounts onto running workers (reference: allocator DiskService +
+Yc*DiskAction durable ops, KuberVolumeManager PVCs, MountDynamicDiskAction +
+KuberMountHolderManager)."""
+
+import time
+
+import pytest
+
+from lzy_tpu import op
+from lzy_tpu.durable import InjectedFailures, OperationStore, OperationsExecutor
+from lzy_tpu.service import InProcessCluster
+from lzy_tpu.service.disks import (
+    Disk,
+    DiskMeta,
+    DiskMount,
+    DiskService,
+    DiskSpec,
+    DiskType,
+    LocalDiskManager,
+    PvcDiskManager,
+)
+from lzy_tpu.service.kube import FakeKubeApi
+
+
+@pytest.fixture()
+def svc(tmp_path):
+    store = OperationStore(str(tmp_path / "meta.db"))
+    executor = OperationsExecutor(store, workers=2)
+    service = DiskService(store, executor,
+                          LocalDiskManager(str(tmp_path / "disks")))
+    yield service
+    InjectedFailures.clear()
+    executor.shutdown()
+    store.close()
+
+
+class TestDiskService:
+    def test_create_get_list_delete(self, svc):
+        d = svc.await_disk(svc.create_disk(
+            DiskSpec(name="scratch", type=DiskType.SSD, size_gb=5),
+            DiskMeta(user="alice")))
+        assert svc.get(d.id).spec.name == "scratch"
+        assert svc.manager.exists(d.id)
+        assert [x.id for x in svc.list(user="alice")] == [d.id]
+        assert svc.list(user="bob") == []
+
+        svc._executor.await_op(svc.delete_disk(d.id))
+        with pytest.raises(KeyError):
+            svc.get(d.id)
+        assert not svc.manager.exists(d.id)
+
+    def test_clone_copies_content(self, svc):
+        src = svc.await_disk(svc.create_disk(DiskSpec(name="base")))
+        path = svc.manager.local_path(src.id)
+        with open(f"{path}/corpus.txt", "w") as f:
+            f.write("tokenized data")
+
+        clone = svc.await_disk(svc.clone_disk(
+            src.id, DiskSpec(name="base-copy"), DiskMeta(user="bob")))
+        assert clone.id != src.id
+        with open(f"{svc.manager.local_path(clone.id)}/corpus.txt") as f:
+            assert f.read() == "tokenized data"
+        # and the source is untouched
+        assert svc.get(src.id).spec.name == "base"
+
+    def test_clone_unknown_source_fails_fast(self, svc):
+        with pytest.raises(KeyError):
+            svc.clone_disk("disk-nope", DiskSpec(name="x"))
+
+    def test_create_survives_crash_between_steps(self, svc):
+        """Crash after the backend create but before registration; resume
+        completes registration without creating a second volume."""
+        InjectedFailures.arm("create_disk.register")  # after create persisted
+        op_id = svc.create_disk(DiskSpec(name="crashy"))
+        time.sleep(0.5)
+        with pytest.raises(TimeoutError):
+            svc._executor.await_op(op_id, timeout_s=0.5)  # parked RUNNING
+        assert svc._executor.restore() >= 1
+        disk = svc.await_disk(op_id)
+        assert svc.get(disk.id).spec.name == "crashy"
+        assert svc.manager.exists(disk.id)
+
+    def test_failed_create_compensates(self, svc, monkeypatch):
+        """A terminally-failing create must not leave an unregistered backend
+        volume behind."""
+        created = {}
+        real_create = svc.manager.create
+
+        def failing_create(disk_id, spec, meta):
+            real_create(disk_id, spec, meta)
+            created["id"] = disk_id
+            raise RuntimeError("provisioner quota exceeded")
+
+        monkeypatch.setattr(svc.manager, "create", failing_create)
+        op_id = svc.create_disk(DiskSpec(name="doomed"))
+        record = svc._executor.await_op(op_id)
+        assert record.status == "FAILED"
+        assert not svc.manager.exists(created["id"])
+
+
+class TestPvcManager:
+    def test_create_maps_type_to_storage_class(self):
+        api = FakeKubeApi()
+        mgr = PvcDiskManager(api, namespace="ns")
+        mgr.create("disk-1", DiskSpec(name="d", type=DiskType.HDD, size_gb=20),
+                   DiskMeta())
+        (pvc,) = api.list_pvcs("ns")
+        assert pvc["spec"]["storageClassName"] == "standard-rwo"
+        assert pvc["spec"]["resources"]["requests"]["storage"] == "20Gi"
+        assert mgr.exists("disk-1")
+        # idempotent resume: second create is a tolerated conflict
+        mgr.create("disk-1", DiskSpec(name="d", type=DiskType.HDD, size_gb=20),
+                   DiskMeta())
+        assert len(api.list_pvcs("ns")) == 1
+
+    def test_clone_uses_csi_datasource(self):
+        api = FakeKubeApi()
+        mgr = PvcDiskManager(api, namespace="ns")
+        spec = DiskSpec(name="d", type=DiskType.SSD, size_gb=8)
+        mgr.create("disk-src", spec, DiskMeta())
+        src = Disk(id="disk-src", spec=spec, meta=DiskMeta())
+        mgr.clone(src, "disk-dst", spec, DiskMeta())
+        (clone,) = api.list_pvcs("ns", label_selector="lzy-disk-id=disk-dst")
+        assert clone["spec"]["dataSource"] == {
+            "kind": "PersistentVolumeClaim",
+            "name": PvcDiskManager.claim_name("disk-src"),
+        }
+
+    def test_delete_tolerates_absent(self):
+        mgr = PvcDiskManager(FakeKubeApi(), namespace="ns")
+        mgr.delete("disk-ghost")  # no raise
+        assert not mgr.exists("disk-ghost")
+
+    def test_pvc_disks_have_no_local_path(self):
+        assert PvcDiskManager(FakeKubeApi()).local_path("disk-1") is None
+
+
+@op
+def read_mounted(mount_name: str, filename: str) -> str:
+    from lzy_tpu.service.worker import current_mounts
+
+    mounts = current_mounts()
+    if mount_name not in mounts:
+        return "<not mounted>"
+    with open(f"{mounts[mount_name]['path']}/{filename}") as f:
+        return f.read()
+
+
+class TestDynamicMounts:
+    @pytest.fixture()
+    def cluster(self):
+        c = InProcessCluster(storage_uri="mem://disk-mounts")
+        yield c
+        c.shutdown()
+
+    def test_mount_then_op_reads_unmount_then_not(self, cluster):
+        lzy = cluster.lzy()
+        disk = cluster.disks.await_disk(
+            cluster.disks.create_disk(DiskSpec(name="data")))
+        with open(f"{cluster.disks.manager.local_path(disk.id)}/f.txt",
+                  "w") as f:
+            f.write("mounted bytes")
+
+        with lzy.workflow("mnt-wf"):
+            # first barrier allocates the VM; before the mount the op must
+            # not see the disk
+            assert str(read_mounted("data", "f.txt")) == "<not mounted>"
+            (vm,) = cluster.allocator.vms()
+            cluster.executor.await_op(
+                cluster.allocator.mount_disk(vm.id, disk.id, "data"))
+            assert cluster.allocator.vm_mounts(vm.id)["data"]["disk_id"] == disk.id
+            assert str(read_mounted("data", "f.txt")) == "mounted bytes"
+
+            cluster.executor.await_op(
+                cluster.allocator.unmount_disk(vm.id, "data"))
+            assert cluster.allocator.vm_mounts(vm.id) == {}
+            assert str(read_mounted("data", "f.txt")) == "<not mounted>"
+
+    def test_mount_unknown_disk_or_vm_fails_fast(self, cluster):
+        with pytest.raises(KeyError):
+            cluster.allocator.mount_disk("vm-ghost", "disk-ghost", "x")
+
+
+class TestGkeMounts:
+    def _backend(self):
+        from lzy_tpu.service.backends import GkeTpuBackend
+
+        api = FakeKubeApi()
+        backend = GkeTpuBackend(control_address="cp:18700",
+                                storage_uri="s3://bucket/root",
+                                image="gcr.io/p/lzy-worker:1", api=api)
+        return api, backend
+
+    def _vm(self):
+        from lzy_tpu.service.allocator import RUNNING, Vm
+
+        return Vm(id="vm-1", session_id="s", pool_label="tpu-v5e-8",
+                  status=RUNNING, gang_id="g", host_index=0, gang_size=1)
+
+    def test_worker_pod_exposes_dynamic_mount_dir(self):
+        from lzy_tpu.service.harness import DEFAULT_POOLS
+
+        api, backend = self._backend()
+        pool = next(p for p in DEFAULT_POOLS if p.label == "tpu-v5e-8")
+        manifest = backend.build_pod_manifest(self._vm(), pool)
+        (vol,) = [v for v in manifest["spec"]["volumes"]
+                  if v["name"] == "lzy-dyn-mounts"]
+        assert vol["hostPath"]["path"].endswith("/vm-1")
+        (vm_mount,) = manifest["spec"]["containers"][0]["volumeMounts"]
+        assert vm_mount["mountPath"] == backend.WORKER_MOUNT_DIR
+        assert vm_mount["mountPropagation"] == "HostToContainer"
+
+    def test_mount_creates_holder_pod_and_unmount_removes(self):
+        api, backend = self._backend()
+        vm = self._vm()
+        disk = Disk(id="disk-9", spec=DiskSpec(name="d"), meta=DiskMeta())
+        path = backend.mount(vm, disk, DiskMount("disk-9", "corpus"))
+        assert path == f"{backend.WORKER_MOUNT_DIR}/corpus"
+        (holder,) = api.list_pods(backend._namespace,
+                                  label_selector="lzy/role=mount-holder")
+        claim_vols = [v for v in holder["spec"]["volumes"]
+                      if "persistentVolumeClaim" in v]
+        assert claim_vols[0]["persistentVolumeClaim"]["claimName"] == \
+            PvcDiskManager.claim_name("disk-9")
+        # scheduled next to the worker pod
+        affinity = holder["spec"]["affinity"]["podAffinity"]
+        rule = affinity["requiredDuringSchedulingIgnoredDuringExecution"][0]
+        assert rule["labelSelector"]["matchLabels"] == {"lzy/vm-id": "vm-1"}
+        # idempotent re-mount (durable resume)
+        backend.mount(vm, disk, DiskMount("disk-9", "corpus"))
+        assert len(api.list_pods(backend._namespace,
+                                 label_selector="lzy/role=mount-holder")) == 1
+
+        backend.unmount(vm, "corpus")
+        assert api.list_pods(backend._namespace,
+                             label_selector="lzy/role=mount-holder") == []
+
+    def test_destroy_reaps_holder_pods(self):
+        from lzy_tpu.service.harness import DEFAULT_POOLS
+
+        api, backend = self._backend()
+        vm = self._vm()
+        pool = next(p for p in DEFAULT_POOLS if p.label == "tpu-v5e-8")
+        backend.launch(vm, pool)
+        disk = Disk(id="disk-9", spec=DiskSpec(name="d"), meta=DiskMeta())
+        backend.mount(vm, disk, DiskMount("disk-9", "corpus"))
+        backend.destroy(vm)
+        assert api.list_pods(backend._namespace) == []
+
+
+class TestMountNameValidation:
+    def test_hostile_names_rejected(self, tmp_path):
+        from lzy_tpu.service.disks import validate_mount_name
+
+        for bad in ("x; touch /pwned", "a/b", "UPPER", "under_score", "",
+                    "-leading", "a" * 64):
+            with pytest.raises(ValueError):
+                validate_mount_name(bad)
+        with pytest.raises(ValueError):
+            DiskMount("disk-1", "bad name")
+        assert validate_mount_name("data-v2") == "data-v2"
