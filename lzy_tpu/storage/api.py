@@ -23,6 +23,36 @@ class StorageConfig:
     endpoint: Optional[str] = None
     access_key: Optional[str] = None
     secret_key: Optional[str] = None
+    # Azure Blob credentials (azure:// URIs): a connection string, or a
+    # SAS endpoint+signature pair (reference Storage.azure_blob_storage(_sas),
+    # pylzy/lzy/storage/api.py:47-55)
+    connection_string: Optional[str] = None
+    sas_signature: Optional[str] = None
+
+
+class CountingReader:
+    """Wraps a readable to count bytes as they stream (one pass, no extra
+    round trip to learn the size afterwards)."""
+
+    def __init__(self, inner: BinaryIO):
+        self._inner = inner
+        self.count = 0
+
+    def read(self, n: int = -1) -> bytes:
+        data = self._inner.read(n)
+        self.count += len(data)
+        return data
+
+
+class CountingWriter:
+    def __init__(self, inner: BinaryIO):
+        self._inner = inner
+        self.count = 0
+
+    def write(self, data: bytes) -> int:
+        n = self._inner.write(data)
+        self.count += len(data)
+        return n if n is not None else len(data)
 
 
 class StorageClient(abc.ABC):

@@ -1,0 +1,118 @@
+"""Azure Blob storage backend (gated).
+
+Counterpart of the reference's async Azure client
+(``pylzy/lzy/storage/async_/azure.py``) and its credential forms
+(``pylzy/lzy/storage/api.py:47-55``: connection string, or SAS
+endpoint+signature). The azure SDK is not a baked-in dependency of this image,
+so — like ``s3://`` — the client resolves it lazily and raises a clear
+ImportError at construction when absent.
+
+URIs: ``azure://<container>/<blob path>``. Synchronous like every client here
+(the transfer engine in ``storage/transfer.py`` parallelizes with threads);
+ranged reads use the blob range API so the parallel download path works
+unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import BinaryIO, Iterator
+from urllib.parse import urlparse
+
+from lzy_tpu.storage.api import StorageClient, StorageConfig
+
+
+class AzureStorageClient(StorageClient):
+    scheme = "azure"
+
+    def __init__(self, config: StorageConfig):
+        try:
+            from azure.storage.blob import BlobServiceClient  # type: ignore
+        except ImportError as e:
+            raise ImportError(
+                "azure:// storage requires the azure-storage-blob package, "
+                "which is not installed in this environment; use file:// or "
+                "mem:// storage instead"
+            ) from e
+        self._sas_credentialed = False
+        if config.connection_string:
+            self._svc = BlobServiceClient.from_connection_string(
+                config.connection_string)
+        elif config.endpoint and config.sas_signature:
+            self._svc = BlobServiceClient(
+                account_url=config.endpoint, credential=config.sas_signature)
+            self._sas_credentialed = True
+        else:
+            raise ValueError(
+                "azure:// storage needs connection_string or "
+                "endpoint+sas_signature in StorageConfig"
+            )
+
+    def _blob(self, uri: str):
+        p = urlparse(uri)
+        return self._svc.get_blob_client(container=p.netloc,
+                                         blob=p.path.lstrip("/"))
+
+    def write(self, uri: str, src: BinaryIO) -> int:
+        from lzy_tpu.storage.api import CountingReader
+
+        counted = CountingReader(src)
+        self._blob(uri).upload_blob(counted, overwrite=True)
+        return counted.count
+
+    def read(self, uri: str, dest: BinaryIO) -> int:
+        stream = self._blob(uri).download_blob()
+        n = 0
+        for chunk in stream.chunks():
+            dest.write(chunk)
+            n += len(chunk)
+        return n
+
+    def read_range(self, uri: str, offset: int, length: int = -1) -> bytes:
+        kwargs = {"offset": offset}
+        if length >= 0:
+            kwargs["length"] = length
+        return self._blob(uri).download_blob(**kwargs).readall()
+
+    def exists(self, uri: str) -> bool:
+        return bool(self._blob(uri).exists())
+
+    def size(self, uri: str) -> int:
+        return int(self._blob(uri).get_blob_properties().size)
+
+    def delete(self, uri: str) -> None:
+        blob = self._blob(uri)
+        if blob.exists():
+            blob.delete_blob()
+
+    def list(self, prefix: str) -> Iterator[str]:
+        p = urlparse(prefix)
+        container = self._svc.get_container_client(p.netloc)
+        for item in container.list_blobs(
+                name_starts_with=p.path.lstrip("/")):
+            yield f"azure://{p.netloc}/{item.name}"
+
+    def sign_uri(self, uri: str) -> str:
+        """Presigned read URL (reference ``sign_storage_uri``,
+        ``async_/azure.py:86-104``)."""
+        blob = self._blob(uri)
+        if self._sas_credentialed:
+            # the client itself is SAS-authenticated: blob.url already
+            # carries the signature, a second one would malform the URL
+            return blob.url
+        from datetime import datetime, timedelta, timezone
+
+        from azure.storage.blob import (  # type: ignore
+            BlobSasPermissions,
+            generate_blob_sas,
+        )
+
+        p = urlparse(uri)
+        sas = generate_blob_sas(
+            account_name=self._svc.account_name,
+            container_name=p.netloc,
+            blob_name=p.path.lstrip("/"),
+            account_key=self._svc.credential.account_key,
+            permission=BlobSasPermissions(read=True),
+            expiry=datetime.now(timezone.utc) + timedelta(hours=1),
+        )
+        return f"{blob.url}?{sas}"

@@ -2,8 +2,9 @@
 
 Counterpart of ``DefaultStorageRegistry`` (``pylzy/lzy/storage/registry.py:8-60``).
 A workflow resolves its storage by name ("default" unless overridden); clients are
-constructed from the URI scheme. S3 (``s3://``) is gated: the boto stack is not a
-baked-in dependency, so it resolves lazily and raises a clear error if unavailable.
+constructed from the URI scheme. S3 (``s3://``) and Azure Blob (``azure://``) are
+gated: their SDKs are not baked-in dependencies, so they resolve lazily and raise
+a clear error if unavailable.
 """
 
 from __future__ import annotations
@@ -29,6 +30,10 @@ def client_for(config: StorageConfig) -> StorageClient:
         from lzy_tpu.storage.s3 import S3StorageClient
 
         return S3StorageClient(config)
+    if scheme == "azure":
+        from lzy_tpu.storage.azure import AzureStorageClient
+
+        return AzureStorageClient(config)
     raise ValueError(f"unsupported storage scheme {scheme!r} in {config.uri!r}")
 
 

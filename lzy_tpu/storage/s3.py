@@ -11,29 +11,12 @@ from __future__ import annotations
 from typing import BinaryIO, Iterator
 from urllib.parse import urlparse
 
-from lzy_tpu.storage.api import StorageClient, StorageConfig
-
-
-class _CountingReader:
-    def __init__(self, inner: BinaryIO):
-        self._inner = inner
-        self.count = 0
-
-    def read(self, n: int = -1) -> bytes:
-        data = self._inner.read(n)
-        self.count += len(data)
-        return data
-
-
-class _CountingWriter:
-    def __init__(self, inner: BinaryIO):
-        self._inner = inner
-        self.count = 0
-
-    def write(self, data: bytes) -> int:
-        n = self._inner.write(data)
-        self.count += len(data)
-        return n if n is not None else len(data)
+from lzy_tpu.storage.api import (
+    CountingReader as _CountingReader,
+    CountingWriter as _CountingWriter,
+    StorageClient,
+    StorageConfig,
+)
 
 
 class S3StorageClient(StorageClient):

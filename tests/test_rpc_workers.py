@@ -533,3 +533,32 @@ def test_multihost_pretrain_op_with_sharded_checkpoint(tmp_path):
         assert len(shard_objs) >= 16    # many leaves x fsdp shards
     finally:
         c.shutdown()
+
+
+def test_local_module_ships_to_process_worker(cluster, remote_lzy, tmp_path):
+    """The reference's `import` scenario, across a REAL process boundary: the
+    op imports a module that exists only on the client machine; the worker
+    gets it via content-hashed archive sync (module upload → unpack →
+    sys.path), not via a shared pythonpath."""
+    import sys as _sys
+
+    from lzy_tpu.env.python_env import ManualPythonEnv
+
+    mod = tmp_path / "shipped_dynamic.py"
+    mod.write_text("MAGIC = 'shipped-ok'\n")
+    assert str(tmp_path) not in _sys.path  # truly client-local
+
+    @op
+    def use_shipped() -> str:
+        import shipped_dynamic
+
+        return shipped_dynamic.MAGIC
+
+    penv = ManualPythonEnv(
+        python_version="%d.%d" % _sys.version_info[:2],
+        packages={},
+        local_module_paths=[str(mod)],
+    )
+    with remote_lzy.workflow("module-ship"):
+        r = use_shipped.with_python_env(penv)()
+        assert str(r) == "shipped-ok"

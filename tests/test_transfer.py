@@ -172,3 +172,27 @@ class TestGatedS3:
         # client constructs and exposes the multipart capability
         client = client_for(StorageConfig(uri="s3://bucket/prefix"))
         assert callable(getattr(client, "multipart_upload", None))
+
+
+class TestGatedAzure:
+    def test_azure_gated_with_clear_error(self):
+        """Without the azure SDK the client must fail at construction with an
+        actionable message, never at first use."""
+        # syntactically valid: the SDK parses eagerly (no network at init)
+        cs = ("DefaultEndpointsProtocol=https;AccountName=a;"
+              "AccountKey=aGV5;EndpointSuffix=core.windows.net")
+        try:
+            import azure.storage.blob  # noqa: F401
+        except ImportError:
+            with pytest.raises(ImportError, match="azure-storage-blob"):
+                client_for(StorageConfig(uri="azure://container/prefix",
+                                         connection_string=cs))
+        else:
+            client = client_for(StorageConfig(uri="azure://container/prefix",
+                                              connection_string=cs))
+            assert client.scheme == "azure"
+
+    def test_azure_requires_credentials(self):
+        pytest.importorskip("azure.storage.blob")
+        with pytest.raises(ValueError, match="connection_string"):
+            client_for(StorageConfig(uri="azure://container/prefix"))
