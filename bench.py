@@ -250,10 +250,17 @@ def run() -> None:
         detail.update(extra)
         emit()
     if platform in ("tpu", "axon"):
-        # seq4k builds a whole second model+optimizer: evict the 2k one
-        # (buffers AND compiled executables) first or it cannot fit
+        # each extra pass builds a whole second model+optimizer: evict the
+        # previous one (buffers AND compiled executables) first or OOM
         _free_buffers(params, batch, metrics)
         params = batch = metrics = None
+        jax.clear_caches()
+        extra = variant_measurement(
+            jax, cfg, mesh, n_params, "fused_ce", {"fused_ce": True},
+            batch_size=8, seq_len=2048)
+        if extra:
+            detail.update(extra)
+            emit()
         jax.clear_caches()
         extra = seq4k_measurement(jax, cfg, mesh, n_params)
         if extra:
@@ -274,64 +281,78 @@ def _free_buffers(*trees) -> None:
                     pass
 
 
+def variant_measurement(jax, cfg, mesh, n_params, tag: str, overrides: dict,
+                        *, batch_size: int, seq_len: int, steps: int = 10,
+                        _raise: bool = False):
+    """Best-effort MFU for a config variant (e.g. the logits-free fused CE
+    loss, or the seq-4k point) — the evidence for flipping defaults. MFU is
+    computed against the HEADLINE model's param count so variants are
+    comparable. With ``_raise`` failures propagate (for callers with their
+    own retry policy); otherwise they are logged and swallowed."""
+    try:
+        import dataclasses
+
+        import optax
+
+        from lzy_tpu.models import llama, unbox
+        from lzy_tpu.parallel import TrainState, make_train_step, mfu
+
+        _log(f"{tag}: building model...")
+        vcfg = dataclasses.replace(cfg, **overrides)
+        boxed, axes = llama.init_params(vcfg, jax.random.PRNGKey(0))
+        tx = optax.adamw(3e-4)
+        step, shard_state, _ = make_train_step(
+            llama.make_loss_fn(vcfg), tx, mesh=mesh,
+            param_logical_axes=axes, batch_logical_axes=("batch", "seq"),
+        )
+        state = shard_state(TrainState.create(unbox(boxed), tx))
+        batch = {"tokens": jax.random.randint(
+            jax.random.PRNGKey(1), (batch_size, seq_len), 0, vcfg.vocab_size
+        )}
+        try:
+            _log(f"{tag}: compiling + warmup...")
+            for _ in range(2):
+                state, metrics = step(state, batch)
+            float(metrics["loss"])
+            _log(f"{tag}: timing {steps} steps...")
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = step(state, batch)
+            float(metrics["loss"])
+            dt = time.perf_counter() - t0
+        finally:
+            _free_buffers(state, batch)
+        tokens_per_s = batch_size * seq_len * steps / dt
+        value = mfu(tokens_per_s, n_params, len(jax.devices()), chip="v5e")
+        _log(f"{tag}: {1000 * dt / steps:.1f} ms/step, mfu {value:.4f}")
+        return {f"{tag}_mfu": round(value, 4),
+                f"{tag}_step_time_ms": round(1000 * dt / steps, 2)}
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        if _raise:
+            raise
+        _log(f"{tag} skipped: {type(e).__name__}: {e}")
+        return {}
+
+
 def seq4k_measurement(jax, cfg, mesh, n_params, steps: int = 10):
     """Best-effort long-context point (VERDICT r1 #9): MFU at seq 4096,
     batch halved to keep HBM flat. Never risks the headline metric."""
     for remat in (False, True):
         try:
-            return _seq4k_once(jax, cfg, mesh, n_params, steps, remat)
+            out = variant_measurement(
+                jax, cfg, mesh, n_params, "seq4k",
+                {"max_seq_len": 4096, "remat": remat},
+                batch_size=4, seq_len=4096, steps=steps, _raise=True)
+            out["seq4k_batch"] = 4
+            if remat:
+                out["seq4k_remat"] = True
+            return out
         except Exception as e:  # noqa: BLE001 — diagnostics only
             _log(f"seq4k (remat={remat}) skipped: {type(e).__name__}: {e}")
             if "RESOURCE_EXHAUSTED" not in str(e):
                 return {}
             jax.clear_caches()  # retry with remat trades FLOPs for memory
     return {}
-
-
-def _seq4k_once(jax, cfg, mesh, n_params, steps: int, remat: bool):
-    import dataclasses
-
-    import optax
-
-    from lzy_tpu.models import llama, unbox
-    from lzy_tpu.parallel import TrainState, make_train_step, mfu
-
-    _log(f"seq4k: building model (remat={remat})...")
-    cfg4k = dataclasses.replace(cfg, max_seq_len=4096, remat=remat)
-    batch_size, seq_len = 4, 4096
-    boxed, axes = llama.init_params(cfg4k, jax.random.PRNGKey(0))
-    tx = optax.adamw(3e-4)
-    step, shard_state, _ = make_train_step(
-        llama.make_loss_fn(cfg4k), tx, mesh=mesh,
-        param_logical_axes=axes, batch_logical_axes=("batch", "seq"),
-    )
-    state = shard_state(TrainState.create(unbox(boxed), tx))
-    batch = {"tokens": jax.random.randint(
-        jax.random.PRNGKey(1), (batch_size, seq_len), 0, cfg4k.vocab_size
-    )}
-    try:
-        _log("seq4k: compiling + warmup...")
-        for _ in range(2):
-            state, metrics = step(state, batch)
-        float(metrics["loss"])
-        _log(f"seq4k: timing {steps} steps...")
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = step(state, batch)
-        float(metrics["loss"])
-        dt = time.perf_counter() - t0
-    finally:
-        _free_buffers(state, batch)
-    tokens_per_s = batch_size * seq_len * steps / dt
-    # same chip count as the headline metric, or the two aren't comparable
-    value = mfu(tokens_per_s, n_params, len(jax.devices()), chip="v5e")
-    _log(f"seq4k: {1000 * dt / steps:.1f} ms/step, mfu {value:.4f}")
-    out = {"seq4k_mfu": round(value, 4),
-           "seq4k_step_time_ms": round(1000 * dt / steps, 2),
-           "seq4k_batch": batch_size}
-    if remat:
-        out["seq4k_remat"] = True
-    return out
 
 
 def step_breakdown(jax, loss_fn, params, batch, step_ms: float, n: int = 5):

@@ -54,6 +54,9 @@ class LlamaConfig:
     moe_top_k: int = 2
     # autoregressive decoding with a KV cache (see generate())
     decode: bool = False
+    # logits-free loss: the model returns (features, head) and the loss uses
+    # chunked_cross_entropy — saves the [B,T,V] activation (ops/chunked_ce.py)
+    fused_ce: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -313,6 +316,11 @@ class Llama(nn.Module):
                 ),
                 (cfg.vocab_size, cfg.d_model), cfg.param_dtype,
             )
+        if cfg.fused_ce and not cfg.decode:
+            # the loss computes chunked CE straight from features + head and
+            # never materializes [B,T,V] logits (decode always needs real
+            # logits for sampling, whatever the training config said)
+            return x.astype(cfg.dtype), head.astype(cfg.dtype)
         # bf16 operands on the MXU, f32 accumulation — an f32×f32 head matmul
         # would run ~4x slower for no useful precision (loss is f32 anyway)
         return jnp.einsum(
@@ -350,9 +358,16 @@ def make_loss_fn(cfg: LlamaConfig, mesh=None):
             logits = model.apply({"params": params}, tokens, mesh)
             aux = 0.0
         mask = batch.get("mask")
+        shifted_mask = mask[:, 1:] if mask is not None else None
+        if cfg.fused_ce:
+            features, head = logits
+            from lzy_tpu.ops.chunked_ce import chunked_cross_entropy
+
+            return chunked_cross_entropy(
+                features[:, :-1], head, tokens[:, 1:], mask=shifted_mask,
+            ) + aux
         return cross_entropy_loss(
-            logits[:, :-1], tokens[:, 1:],
-            mask[:, 1:] if mask is not None else None,
+            logits[:, :-1], tokens[:, 1:], shifted_mask,
         ) + aux
 
     return loss_fn
