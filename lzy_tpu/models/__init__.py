@@ -27,5 +27,6 @@ __all__ = [
 
 from lzy_tpu.models.generate import generate  # noqa: E402
 from lzy_tpu.models.moe import MoeConfig, MoeMlp  # noqa: E402
+from lzy_tpu.models.t5 import T5, T5Config, t5_generate  # noqa: E402
 
-__all__ += ["generate", "MoeConfig", "MoeMlp"]
+__all__ += ["generate", "MoeConfig", "MoeMlp", "T5", "T5Config", "t5_generate"]

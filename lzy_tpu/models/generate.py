@@ -18,6 +18,27 @@ import jax.numpy as jnp
 from lzy_tpu.models.llama import Llama, LlamaConfig
 
 
+def sample_token(logits: jax.Array, temperature: float,
+                 rng: jax.Array):
+    """Shared greedy/temperature sampling for every model family's decode
+    loop; logits [B, V] → ([B] int32, rng)."""
+    rng, sub = jax.random.split(rng)
+    if temperature <= 0.0:
+        nxt = jnp.argmax(logits, axis=-1)
+    else:
+        nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+    return nxt.astype(jnp.int32), rng
+
+
+def init_cache(init_fn):
+    """Materialize a model's zeroed KV cache from an abstract init:
+    ``init_fn`` is a zero-arg lambda running ``model.init(...)``; eval_shape
+    keeps it abstract so no second weight copy ever exists."""
+    cache_shapes = jax.eval_shape(init_fn)["cache"]
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+
+
 def generate(
     cfg: LlamaConfig,
     params: Any,
@@ -44,13 +65,8 @@ def generate(
     model = Llama(dcfg)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-    # cache shapes without materializing a second copy of the weights
-    # (init RUNS the module; eval_shape keeps it abstract)
-    cache_shapes = jax.eval_shape(
+    cache = init_cache(
         lambda: model.init(jax.random.PRNGKey(0), jnp.zeros((b, 1), jnp.int32))
-    )["cache"]
-    cache = jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
     )
 
     # params are an ARGUMENT (not a closure constant): no baked-in weight copy
@@ -60,13 +76,8 @@ def generate(
         logits, updated = model.apply(
             {"params": params, "cache": cache}, token, mutable=["cache"]
         )
-        logits = logits[:, -1]                          # [B, V]
-        rng, sub = jax.random.split(rng)
-        if temperature <= 0.0:
-            nxt = jnp.argmax(logits, axis=-1)
-        else:
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
-        return updated["cache"], nxt.astype(jnp.int32), rng
+        nxt, rng = sample_token(logits[:, -1], temperature, rng)
+        return updated["cache"], nxt, rng
 
     # prefill: feed prompt tokens through the cache one position at a time
     nxt = None

@@ -1,0 +1,356 @@
+"""T5-style encoder-decoder: bidirectional encoder, causal decoder with
+cross-attention, teacher-forced seq2seq loss, and cached greedy/sampled
+generation.
+
+No reference counterpart (lzy ships no models; SURVEY.md §2.4) — this widens
+the TPU build's model families (decoder LM, encoder MLM, MoE, conv, and now
+seq2seq). House style matches ``llama.py``/``bert.py``: logical-axis
+partitioning on every param (so the same mesh rules shard it), RMSNorm +
+RoPE (T5.1.1 modernized — RoPE replaces T5's learned relative bias, which
+keeps decode caches position-independent), bf16 operands with f32 matmul
+accumulation, optional remat, and the Pallas flash kernel for the encoder's
+self-attention when shapes allow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from lzy_tpu.models.common import cross_entropy_loss
+from lzy_tpu.models.llama import RMSNorm, _rope
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32_128
+    d_model: int = 768
+    n_enc_layers: int = 12
+    n_dec_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 2048
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-6
+    max_seq_len: int = 512
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    use_flash_kernel: bool = False
+    decode: bool = False
+    bos_token: int = 0               # decoder start token (T5 uses pad=0)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def base() -> "T5Config":
+        return T5Config()
+
+    @staticmethod
+    def tiny(vocab_size: int = 512) -> "T5Config":
+        return T5Config(vocab_size=vocab_size, d_model=64, n_enc_layers=2,
+                        n_dec_layers=2, n_heads=4, d_ff=128, max_seq_len=64,
+                        remat=False)
+
+
+def _proj(cfg, features, name, axes):
+    return nn.DenseGeneral(
+        features=features, axis=-1, use_bias=False, name=name,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), axes
+        ),
+    )
+
+
+def _attend(q, k, v, mask, dtype):
+    """Dense attention with f32 scores; mask True = visible ([B,1,Q,K] or
+    broadcastable)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * (d ** -0.5)
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+class SelfAttention(nn.Module):
+    """Encoder (bidirectional) or decoder (causal + KV cache) self-attention
+    with RoPE."""
+
+    cfg: T5Config
+    causal: bool
+
+    @nn.compact
+    def __call__(self, x, pad_mask=None):
+        cfg = self.cfg
+        b, t, _ = x.shape
+        h, d = cfg.n_heads, cfg.head_dim
+        q = _proj(cfg, (h, d), "q_proj", ("embed", "heads", "head_dim"))(x)
+        k = _proj(cfg, (h, d), "k_proj", ("embed", "heads", "head_dim"))(x)
+        v = _proj(cfg, (h, d), "v_proj", ("embed", "heads", "head_dim"))(x)
+
+        if cfg.decode and self.causal:
+            out = self._decode_step(q, k, v, b)
+        else:
+            positions = jnp.arange(t)[None, :]
+            q = _rope(q, positions, cfg.rope_theta)
+            k = _rope(k, positions, cfg.rope_theta)
+            aligned = cfg.use_flash_kernel and t % 128 == 0
+            if self.causal:
+                # causal training path, llama.py discipline: flash when
+                # lane-aligned, else chunked online-softmax — never the
+                # T×T score matrix
+                qt, kt, vt = (jnp.transpose(a, (0, 2, 1, 3))
+                              for a in (q, k, v))
+                if aligned:
+                    from lzy_tpu.ops.flash_attention import flash_attention
+
+                    out = flash_attention(qt, kt, vt, causal=True)
+                else:
+                    from lzy_tpu.ops.attention import chunked_attention
+
+                    out = chunked_attention(qt, kt, vt, causal=True)
+                out = jnp.transpose(out, (0, 2, 1, 3))
+            elif aligned:
+                from lzy_tpu.ops.flash_attention import flash_attention
+
+                qt, kt, vt = (jnp.transpose(a, (0, 2, 1, 3))
+                              for a in (q, k, v))
+                out = jnp.transpose(
+                    flash_attention(qt, kt, vt, causal=False,
+                                    kv_mask=pad_mask),
+                    (0, 2, 1, 3))
+            else:
+                mask = (pad_mask[:, None, None, :]
+                        if pad_mask is not None else None)
+                out = _attend(q, k, v, mask, cfg.dtype)
+        return _proj(cfg, cfg.d_model, "o_proj",
+                     ("heads_merged", "embed"))(out.reshape(b, -1, h * d))
+
+    def _decode_step(self, q, k, v, b):
+        cfg = self.cfg
+        h, d, L = cfg.n_heads, cfg.head_dim, cfg.max_seq_len
+        cache_k = self.variable("cache", "k", jnp.zeros, (b, L, h, d),
+                                cfg.dtype)
+        cache_v = self.variable("cache", "v", jnp.zeros, (b, L, h, d),
+                                cfg.dtype)
+        index = self.variable("cache", "index",
+                              lambda: jnp.zeros((), jnp.int32))
+        i = index.value
+        pos = jnp.full((b, 1), i, jnp.int32)
+        q = _rope(q, pos, cfg.rope_theta)
+        k = _rope(k, pos, cfg.rope_theta)
+        if not self.is_initializing():
+            cache_k.value = jax.lax.dynamic_update_slice(
+                cache_k.value, k.astype(cfg.dtype), (0, i, 0, 0))
+            cache_v.value = jax.lax.dynamic_update_slice(
+                cache_v.value, v.astype(cfg.dtype), (0, i, 0, 0))
+            index.value = i + 1
+        visible = (jnp.arange(L) <= i)[None, None, None, :]
+        return _attend(q, cache_k.value, cache_v.value, visible, cfg.dtype)
+
+
+class CrossAttention(nn.Module):
+    """Decoder queries over encoder output. K/V are position-free (no RoPE on
+    the cross path — encoder positions already live in ``enc_out``), so the
+    projections are recomputed per call; a per-generation K/V cache is a
+    future optimization, not a correctness matter."""
+
+    cfg: T5Config
+
+    @nn.compact
+    def __call__(self, x, enc_out, enc_mask=None):
+        cfg = self.cfg
+        b = x.shape[0]
+        h, d = cfg.n_heads, cfg.head_dim
+        q = _proj(cfg, (h, d), "q_proj", ("embed", "heads", "head_dim"))(x)
+        k = _proj(cfg, (h, d), "k_proj", ("embed", "heads", "head_dim"))(enc_out)
+        v = _proj(cfg, (h, d), "v_proj", ("embed", "heads", "head_dim"))(enc_out)
+        mask = enc_mask[:, None, None, :] if enc_mask is not None else None
+        out = _attend(q, k, v, mask, cfg.dtype)
+        return _proj(cfg, cfg.d_model, "o_proj",
+                     ("heads_merged", "embed"))(out.reshape(b, -1, h * d))
+
+
+class Mlp(nn.Module):
+    cfg: T5Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        gate = _proj(cfg, cfg.d_ff, "gate", ("embed", "mlp"))(x)
+        up = _proj(cfg, cfg.d_ff, "up", ("embed", "mlp"))(x)
+        return _proj(cfg, cfg.d_model, "down", ("mlp", "embed"))(
+            nn.gelu(gate) * up)
+
+
+class EncoderLayer(nn.Module):
+    cfg: T5Config
+
+    @nn.compact
+    def __call__(self, x, pad_mask):
+        cfg = self.cfg
+        x = x + SelfAttention(cfg, causal=False, name="self_attn")(
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attn_norm")(x),
+            pad_mask)
+        return x + Mlp(cfg, name="mlp")(
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="mlp_norm")(x))
+
+
+class DecoderLayer(nn.Module):
+    cfg: T5Config
+
+    @nn.compact
+    def __call__(self, x, enc_out, enc_mask):
+        cfg = self.cfg
+        x = x + SelfAttention(cfg, causal=True, name="self_attn")(
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attn_norm")(x))
+        x = x + CrossAttention(cfg, name="cross_attn")(
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="cross_norm")(x),
+            enc_out, enc_mask)
+        return x + Mlp(cfg, name="mlp")(
+            RMSNorm(cfg.norm_eps, cfg.param_dtype, name="mlp_norm")(x))
+
+
+class T5(nn.Module):
+    cfg: T5Config
+
+    def setup(self):
+        cfg = self.cfg
+        self.emb = self.param(
+            "embedding",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            (cfg.vocab_size, cfg.d_model), cfg.param_dtype,
+        )
+        enc_layer, dec_layer = EncoderLayer, DecoderLayer
+        if cfg.remat and not cfg.decode:
+            enc_layer = nn.remat(
+                EncoderLayer,
+                policy=jax.checkpoint_policies.nothing_saveable)
+            dec_layer = nn.remat(
+                DecoderLayer,
+                policy=jax.checkpoint_policies.nothing_saveable)
+        self.enc_layers = [enc_layer(cfg, name=f"enc_{i}")
+                           for i in range(cfg.n_enc_layers)]
+        self.dec_layers = [dec_layer(cfg, name=f"dec_{i}")
+                           for i in range(cfg.n_dec_layers)]
+        self.enc_norm = RMSNorm(cfg.norm_eps, cfg.param_dtype,
+                                name="enc_norm")
+        self.dec_norm = RMSNorm(cfg.norm_eps, cfg.param_dtype,
+                                name="dec_norm")
+
+    def encode(self, enc_tokens, enc_mask=None):
+        cfg = self.cfg
+        x = jnp.take(self.emb, enc_tokens, axis=0).astype(cfg.dtype)
+        for layer in self.enc_layers:
+            x = layer(x, enc_mask)
+        return self.enc_norm(x)
+
+    def decode(self, dec_tokens, enc_out, enc_mask=None):
+        cfg = self.cfg
+        x = jnp.take(self.emb, dec_tokens, axis=0).astype(cfg.dtype)
+        for layer in self.dec_layers:
+            x = layer(x, enc_out, enc_mask)
+        x = self.dec_norm(x)
+        # tied head (T5.1.1 unties it; tying keeps the family compact)
+        return jnp.einsum(
+            "bte,ve->btv", x.astype(cfg.dtype), self.emb.astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+    def __call__(self, enc_tokens, dec_tokens, enc_mask=None):
+        return self.decode(dec_tokens, self.encode(enc_tokens, enc_mask),
+                           enc_mask)
+
+
+def init_params(cfg: T5Config, rng: jax.Array, seq_len: int = 8):
+    from lzy_tpu.models.common import param_logical_axes
+
+    model = T5(cfg)
+    tok = jnp.zeros((1, seq_len), jnp.int32)
+    boxed = model.init(rng, tok, tok)["params"]
+    return boxed, param_logical_axes(boxed)
+
+
+def make_loss_fn(cfg: T5Config):
+    """Teacher-forced seq2seq loss: decoder input is [BOS, y_0..y_{T-2}],
+    target is y; ``dec_mask`` weights the loss (padding excluded)."""
+    model = T5(cfg)
+
+    def loss_fn(params, batch):
+        enc_tokens = batch["enc_tokens"]
+        targets = batch["dec_tokens"]
+        enc_mask = batch.get("enc_mask")
+        dec_in = jnp.concatenate(
+            [jnp.full_like(targets[:, :1], cfg.bos_token),
+             targets[:, :-1]], axis=1)
+        logits = model.apply({"params": params}, enc_tokens, dec_in, enc_mask)
+        return cross_entropy_loss(logits, targets, batch.get("dec_mask"))
+
+    return loss_fn
+
+
+def t5_generate(
+    cfg: T5Config,
+    params: Any,
+    enc_tokens: jax.Array,
+    *,
+    max_new_tokens: int,
+    enc_mask: Optional[jax.Array] = None,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    eos_token: Optional[int] = None,
+) -> jax.Array:
+    """Encode once, then autoregressively decode with a per-layer KV cache
+    (the cross path reads the fixed ``enc_out``). Returns [B, max_new_tokens]."""
+    b, _ = enc_tokens.shape
+    if max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"max_new_tokens ({max_new_tokens}) exceeds max_seq_len "
+            f"({cfg.max_seq_len})")
+    dcfg = dataclasses.replace(cfg, decode=True, remat=False,
+                               use_flash_kernel=False)
+    model = T5(dcfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    enc_out = T5(cfg).apply({"params": params}, enc_tokens, enc_mask,
+                            method=T5.encode)
+
+    from lzy_tpu.models.generate import init_cache, sample_token
+
+    cache = init_cache(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((b, 1), jnp.int32),
+                           jnp.zeros(enc_out.shape, enc_out.dtype),
+                           method=T5.decode))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(cache, params, token, rng):
+        logits, updated = model.apply(
+            {"params": params, "cache": cache}, token, enc_out, enc_mask,
+            mutable=["cache"], method=T5.decode,
+        )
+        nxt, rng = sample_token(logits[:, -1], temperature, rng)
+        return updated["cache"], nxt, rng
+
+    cur = jnp.full((b, 1), cfg.bos_token, jnp.int32)
+    out = []
+    done = jnp.zeros((b,), bool)
+    for _ in range(max_new_tokens):
+        cache, nxt, rng = step(cache, params, cur, rng)
+        if eos_token is not None:
+            nxt = jnp.where(done, eos_token, nxt)
+            done = done | (nxt == eos_token)
+        out.append(nxt[:, None])
+        cur = nxt[:, None]
+    return jnp.concatenate(out, axis=1)
