@@ -9,7 +9,8 @@ remote control plane over gRPC (``--address``, with ``--token`` when the
 deployment runs IAM) — so operators do not need filesystem access to the
 control plane host.
 
-Commands: executions, graphs, vms, ops, whiteboards, serve-console, version.
+Commands: executions, graphs, vms, ops, disks, whiteboards, serve-console,
+version.
 """
 
 from __future__ import annotations
@@ -25,9 +26,10 @@ _HEADERS = {
     "graphs": ["GRAPH-OP", "WORKFLOW", "STATUS", "DONE", "TOTAL", "FAILED"],
     "vms": ["VM", "POOL", "STATUS", "GANG", "HOST", "GANG-SIZE", "HEARTBEAT"],
     "operations": ["OPERATION", "KIND", "STATUS", "STEP"],
+    "disks": ["DISK", "NAME", "TYPE", "SIZE-GB", "USER", "CREATED"],
 }
 _VIEW_OF_COMMAND = {"executions": "executions", "graphs": "graphs",
-                    "vms": "vms", "ops": "operations"}
+                    "vms": "vms", "ops": "operations", "disks": "disks"}
 
 
 def _table(rows, headers) -> str:
@@ -151,8 +153,8 @@ def main(argv=None) -> None:
     parser.add_argument("--storage", default=os.environ.get("LZY_TPU_STORAGE"),
                         help="storage uri (whiteboards command)")
     sub = parser.add_subparsers(dest="command", required=True)
-    for name in ("executions", "graphs", "vms", "ops", "whiteboards",
-                 "version"):
+    for name in ("executions", "graphs", "vms", "ops", "disks",
+                 "whiteboards", "version"):
         sub.add_parser(name)
     auth = sub.add_parser("auth", help="mint/rotate/revoke IAM subjects")
     auth_sub = auth.add_subparsers(dest="auth_command", required=True)

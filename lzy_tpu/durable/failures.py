@@ -49,6 +49,12 @@ class InjectedFailures:
             cls._hooks.clear()
 
     @classmethod
+    def armed(cls) -> list:
+        """Currently-armed hook points (debug surface)."""
+        with cls._lock:
+            return sorted(cls._hooks)
+
+    @classmethod
     def hit(cls, point: str) -> None:
         """Call at a hook point; raises InjectedCrash if armed."""
         with cls._lock:

@@ -133,12 +133,15 @@ class OperationsExecutor:
         return record.id
 
     def restore(self) -> int:
-        """Re-enqueue all RUNNING ops (service-boot recovery). Returns count."""
-        records = self._store.running_ops()
-        for r in records:
-            if r.kind in self._factories:
-                self._enqueue(r.id, 0.0)
-        return len(records)
+        """Re-enqueue all RUNNING ops (service-boot recovery). Returns the
+        number actually re-enqueued — ops already queued or being driven are
+        skipped and NOT counted (an operator kicking recovery on a live plane
+        must see how many parked ops the kick really woke)."""
+        resumed = 0
+        for r in self._store.running_ops():
+            if r.kind in self._factories and self._enqueue(r.id, 0.0):
+                resumed += 1
+        return resumed
 
     def await_op(self, op_id: str, timeout_s: float = 30.0) -> OpRecord:
         deadline = time.time() + timeout_s
@@ -165,18 +168,21 @@ class OperationsExecutor:
 
     # -- internals -------------------------------------------------------------
 
-    def _enqueue(self, op_id: str, delay_s: float, *, requeue: bool = False) -> None:
+    def _enqueue(self, op_id: str, delay_s: float, *,
+                 requeue: bool = False) -> bool:
         """``requeue`` is set only by the op's own driving thread (RESTART);
         external enqueues (submit with a duplicate idempotency key, restore)
         are dropped while the op is queued or being driven, so one op is never
-        driven by two threads concurrently."""
+        driven by two threads concurrently. Returns whether the op was
+        actually enqueued."""
         with self._cv:
             if not requeue and op_id in self._inflight:
-                return
+                return False
             self._inflight.add(op_id)
             self._queue.append((time.time() + delay_s, op_id))
             self._queue.sort()
             self._cv.notify()
+            return True
 
     def _pop(self) -> Optional[str]:
         with self._cv:

@@ -61,9 +61,12 @@ def _token_value(token) -> Optional[str]:
 
 
 class ControlPlaneServer:
-    def __init__(self, cluster, port: int = 0):
+    def __init__(self, cluster, port: int = 0, *, debug: bool = False):
         """``cluster``: an InProcessCluster (or any object with
-        workflow_service/channels/allocator attributes)."""
+        workflow_service/channels/allocator attributes). ``debug`` exposes
+        the fault-injection surface (reference ``InjectedFailuresController``
+        + ``DebugActionsController`` REST endpoints) — NEVER enable it on a
+        production plane; with IAM it additionally requires INTERNAL role."""
         svc = cluster.workflow_service
         channels = cluster.channels
         allocator = cluster.allocator
@@ -214,6 +217,34 @@ class ControlPlaneServer:
             # status surface (CLI --address / console over RPC)
             "GetStatus": h_get_status,
         }
+        if debug:
+            def _dbg(fn):
+                def handler(p):
+                    if iam is not None:
+                        from lzy_tpu.iam import AuthError, INTERNAL
+
+                        subject = iam.authenticate(p.get("token"))
+                        if subject.role != INTERNAL:
+                            raise AuthError(
+                                "debug surface is operator-only (INTERNAL)")
+                    return fn(p)
+                return handler
+
+            from lzy_tpu.durable import InjectedFailures
+
+            handlers.update({
+                # runtime fault injection (InjectedFailuresController parity)
+                "DebugArmFailure": _dbg(lambda p: InjectedFailures.arm(
+                    p["point"], n_hits=int(p.get("n_hits", 1))) or {}),
+                "DebugDisarmFailure": _dbg(lambda p: InjectedFailures.disarm(
+                    p["point"]) or {}),
+                "DebugListFailures": _dbg(lambda p: {
+                    "points": InjectedFailures.armed()}),
+                # kick boot-time recovery (DebugActionsController parity):
+                # re-enqueue RUNNING durable ops parked by an injected crash
+                "DebugResumeOps": _dbg(lambda p: {
+                    "resumed": cluster.resume_pending_operations()}),
+            })
         self._server = JsonRpcServer(handlers, port=port)
         self.address = self._server.address
         self.port = self._server.port
@@ -419,6 +450,24 @@ class RpcWorkflowClient:
             "execution_id": execution_id, "offsets": offsets or {},
             "token": token,
         })["logs"]
+
+    # -- debug surface (only served when the plane enables debug=True) ---------
+
+    def arm_failure(self, point: str, n_hits: int = 1, *, token=None):
+        self._client.call("DebugArmFailure", {
+            "point": point, "n_hits": n_hits, "token": token})
+
+    def disarm_failure(self, point: str, *, token=None):
+        self._client.call("DebugDisarmFailure", {
+            "point": point, "token": token})
+
+    def list_failures(self, *, token=None):
+        return self._client.call("DebugListFailures",
+                                 {"token": token})["points"]
+
+    def resume_ops(self, *, token=None) -> int:
+        return self._client.call("DebugResumeOps",
+                                 {"token": token})["resumed"]
 
     def close(self) -> None:
         self._client.close()

@@ -234,9 +234,23 @@ REQUESTS: Dict[str, Schema] = {
     "Status": Schema("StatusRequest", {
         "op_id": f(str, required=True), **_TOKEN}),
     "Shutdown": Schema("ShutdownRequest", {**_TOKEN}),
+    "Mount": Schema("MountRequest", {
+        "name": f(str, required=True),
+        "path": f(str, required=True),
+        "read_only": f(bool), **_TOKEN}),
+    "Unmount": Schema("UnmountRequest", {
+        "name": f(str, required=True), **_TOKEN}),
     # status surface
     "GetStatus": Schema("GetStatusRequest", {
         "view": f(str, required=True), **_TOKEN}),
+    # debug surface (served only by debug_rpc=True planes)
+    "DebugArmFailure": Schema("DebugArmFailureRequest", {
+        "point": f(str, required=True),
+        "n_hits": f(int), **_TOKEN}),
+    "DebugDisarmFailure": Schema("DebugDisarmFailureRequest", {
+        "point": f(str, required=True), **_TOKEN}),
+    "DebugListFailures": Schema("DebugListFailuresRequest", {**_TOKEN}),
+    "DebugResumeOps": Schema("DebugResumeOpsRequest", {**_TOKEN}),
 }
 
 def validate_request(method: str, payload: dict) -> None:

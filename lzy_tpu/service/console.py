@@ -133,7 +133,7 @@ class StatusConsole:
 
     def _render_home(self) -> str:
         sections = []
-        for view in ("executions", "graphs", "vms", "operations"):
+        for view in ("executions", "graphs", "vms", "operations", "disks"):
             rows = status_views.collect(self._store, view)
             sections.append(f"<h2>{view} ({len(rows)})</h2>"
                             + _render_table(view, rows))

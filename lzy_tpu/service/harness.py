@@ -53,6 +53,7 @@ class InProcessCluster:
         worker_mode: str = "thread",      # "thread" | "process"
         worker_pythonpath: Optional[str] = None,
         rpc_port: int = 0,                # fixed port lets workers reconnect
+        debug_rpc: bool = False,          # expose fault-injection over RPC
     ):
         self._rpc_port = rpc_port
         self.storage_uri = storage_uri
@@ -110,10 +111,12 @@ class InProcessCluster:
             self.store, self.executor, self.allocator, self.channels,
             self.graph_executor, self.storage_client, iam=self.iam,
         )
+        self._debug_rpc = debug_rpc
         if worker_mode == "process":
             from lzy_tpu.rpc import ControlPlaneServer
 
-            self.rpc_server = ControlPlaneServer(self, port=rpc_port)
+            self.rpc_server = ControlPlaneServer(self, port=rpc_port,
+                                                 debug=debug_rpc)
 
     def serve(self, port: int = 0):
         """Expose the control plane over gRPC (for remote SDK clients); with
@@ -129,7 +132,8 @@ class InProcessCluster:
             return self.rpc_server
         from lzy_tpu.rpc import ControlPlaneServer
 
-        self.rpc_server = ControlPlaneServer(self, port=port)
+        self.rpc_server = ControlPlaneServer(self, port=port,
+                                             debug=self._debug_rpc)
         return self.rpc_server
 
     @property

@@ -22,6 +22,7 @@ COLUMNS = {
     "vms": ["id", "pool_label", "status", "gang_id", "host_index",
             "gang_size", "heartbeat_ts"],
     "operations": ["id", "kind", "status", "step"],
+    "disks": ["id", "name", "type", "size_gb", "user", "created_ts"],
 }
 
 
@@ -99,15 +100,31 @@ def operations(store) -> List[Dict[str, Any]]:
             for r in store.running_ops()]
 
 
+def disks(store) -> List[Dict[str, Any]]:
+    rows = []
+    for disk_id, doc in sorted(store.kv_list("disks").items()):
+        spec = doc.get("spec", {})
+        rows.append({
+            "id": disk_id,
+            "name": spec.get("name"),
+            "type": spec.get("type"),
+            "size_gb": spec.get("size_gb"),
+            "user": doc.get("meta", {}).get("user"),
+            "created_ts": doc.get("created_ts"),
+        })
+    return rows
+
+
 VIEWS = {
     "executions": executions,
     "graphs": graphs,
     "vms": vms,
     "operations": operations,
+    "disks": disks,
 }
 
-# views that can be scoped to one user; the rest (vms, operations) expose
-# deployment-wide infrastructure and are operator-only under IAM
+# views that can be scoped to one user; the rest (vms, operations, disks)
+# expose deployment-wide infrastructure and are operator-only under IAM
 USER_SCOPED_VIEWS = ("executions", "graphs")
 
 

@@ -157,3 +157,26 @@ class TestRemoteCli:
                           "--token", worker_tokens[0], "executions"])
         finally:
             c.shutdown()
+
+
+def test_disks_view_lists_created_disks(tmp_path, capsys):
+    from lzy_tpu.durable import OperationStore, OperationsExecutor
+    from lzy_tpu.service.disks import DiskService, DiskSpec, LocalDiskManager
+    from lzy_tpu.service.status import collect
+
+    store = OperationStore(str(tmp_path / "m.db"))
+    executor = OperationsExecutor(store, workers=1)
+    svc = DiskService(store, executor, LocalDiskManager(str(tmp_path / "d")))
+    try:
+        d = svc.await_disk(svc.create_disk(DiskSpec(name="corpus", size_gb=7)))
+        (row,) = collect(store, "disks")
+        assert row["id"] == d.id and row["size_gb"] == 7
+
+        import lzy_tpu.__main__ as cli
+
+        cli.main(["--db", str(tmp_path / "m.db"), "disks"])
+        out = capsys.readouterr().out
+        assert "corpus" in out and "DISK" in out
+    finally:
+        executor.shutdown()
+        store.close()

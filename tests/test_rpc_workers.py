@@ -562,3 +562,60 @@ def test_local_module_ships_to_process_worker(cluster, remote_lzy, tmp_path):
     with remote_lzy.workflow("module-ship"):
         r = use_shipped.with_python_env(penv)()
         assert str(r) == "shipped-ok"
+
+
+def test_debug_surface_gated_and_drives_crash_resume(tmp_path):
+    """InjectedFailuresController/DebugActionsController parity over RPC:
+    disabled planes reject the debug methods outright; an enabled plane can
+    arm a crash point, watch the graph park, and kick durable-op recovery."""
+    import threading
+
+    storage = f"file://{tmp_path}/storage"
+
+    # 1) default plane: debug surface absent
+    c_prod = InProcessCluster(db_path=str(tmp_path / "prod.db"),
+                              storage_uri=storage, worker_mode="process",
+                              worker_pythonpath=TESTS_DIR, poll_period_s=0.1)
+    client = RpcWorkflowClient(c_prod.rpc_server.address)
+    try:
+        with pytest.raises(Exception, match="[Mm]ethod not found"):
+            client.arm_failure("exec_graph.schedule")
+    finally:
+        client.close()
+        c_prod.shutdown()
+
+    # 2) debug plane: arm → run → parked → resume over RPC → completes
+    c = InProcessCluster(db_path=str(tmp_path / "dbg.db"),
+                         storage_uri=storage, worker_mode="process",
+                         worker_pythonpath=TESTS_DIR, poll_period_s=0.1,
+                         debug_rpc=True)
+    client = RpcWorkflowClient(c.rpc_server.address)
+    lzy = c.lzy()
+    done = {}
+    try:
+        client.arm_failure("exec_graph.schedule")
+        assert client.list_failures() == ["exec_graph.schedule"]
+
+        def run():
+            with lzy.workflow("dbg-wf"):
+                done["result"] = int(proc_square(9))
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        # deterministic sync: the armed point disarms itself when it fires,
+        # so an empty list means the crash happened and the op is parked
+        deadline = time.time() + 30
+        while client.list_failures() and time.time() < deadline:
+            time.sleep(0.1)
+        assert client.list_failures() == []   # crash fired
+        time.sleep(0.3)                        # let the crashed driver unwind
+        assert "result" not in done            # parked by the injected crash
+        assert client.resume_ops() >= 1
+        t.join(timeout=60)
+        assert done.get("result") == 81
+    finally:
+        client.close()
+        c.shutdown()
+        from lzy_tpu.durable import InjectedFailures
+
+        InjectedFailures.clear()
