@@ -54,6 +54,8 @@ class InProcessCluster:
         worker_pythonpath: Optional[str] = None,
         rpc_port: int = 0,                # fixed port lets workers reconnect
         debug_rpc: bool = False,          # expose fault-injection over RPC
+        gc_period_s: Optional[float] = None,   # background GC timer
+        execution_ttl_s: float = 86_400.0,     # stale-execution reap age
     ):
         self._rpc_port = rpc_port
         self.storage_uri = storage_uri
@@ -117,6 +119,28 @@ class InProcessCluster:
 
             self.rpc_server = ControlPlaneServer(self, port=rpc_port,
                                                  debug=debug_rpc)
+        # background GC (the reference runs GarbageCollector timers inside
+        # each service; here one timer covers allocator + executions)
+        self._gc_stop = None
+        self._gc_thread = None
+        if gc_period_s is not None:
+            import threading
+
+            self._gc_stop = threading.Event()
+
+            def gc_loop():
+                while not self._gc_stop.wait(gc_period_s):
+                    try:
+                        self.allocator.gc_tick()
+                        self.workflow_service.gc_tick(ttl_s=execution_ttl_s)
+                    except Exception:  # noqa: BLE001 — GC must never die
+                        import logging
+
+                        logging.getLogger(__name__).exception("gc tick failed")
+
+            self._gc_thread = threading.Thread(target=gc_loop,
+                                               name="cluster-gc", daemon=True)
+            self._gc_thread.start()
 
     def serve(self, port: int = 0):
         """Expose the control plane over gRPC (for remote SDK clients); with
@@ -163,6 +187,11 @@ class InProcessCluster:
         return self.executor.restore()
 
     def shutdown(self) -> None:
+        if self._gc_stop is not None:
+            # stop AND join: an in-flight tick must not race VM destruction
+            # below or outlive the store it reads
+            self._gc_stop.set()
+            self._gc_thread.join(timeout=10.0)
         for vm in list(self.allocator.vms()):
             try:
                 self.backend.destroy(vm)

@@ -1,0 +1,41 @@
+"""Transparent remote fitting of a third-party estimator (reference scenarios
+catboost_integration_cpu/gpu: `fit(provisioning=...)` spawns a one-op
+workflow; here via the generic remote_fit + @extend injections)."""
+from tests.scenarios._base import make_lzy
+
+from lzy_tpu.injections import extend, remote_fit
+
+
+class TinyRegressor:
+    """Stand-in for catboost/sklearn: mean predictor with sklearn's fit(X,y)
+    shape."""
+
+    def __init__(self):
+        self.mean_ = None
+
+    def fit(self, X, y):  # noqa: N803 — sklearn convention
+        self.mean_ = sum(y) / len(y)
+        return self
+
+    def predict(self, X):  # noqa: N803
+        return [self.mean_] * len(X)
+
+
+@extend(TinyRegressor)
+def describe(self) -> str:
+    return f"mean={self.mean_:.1f}"
+
+
+def main():
+    cluster, lzy = make_lzy()
+    try:
+        fitted = remote_fit(TinyRegressor(), [[1], [2], [3]], [10, 20, 30],
+                            lzy=lzy)
+        print(f"prediction: {fitted.predict([[4]])[0]:.1f}")
+        print(f"extended: {fitted.describe()}")
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

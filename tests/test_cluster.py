@@ -351,3 +351,39 @@ def test_cpu_provisioning_picks_cpu_pool(cluster):
         # before workflow teardown reaps the session's VMs
         pools = {v.pool_label for v in cluster.allocator.vms()}
         assert pools == {"cpu-small"}
+
+
+def test_background_gc_reaps_idle_vms_and_stale_executions():
+    """GarbageCollector-timer parity: a cluster with gc_period_s reaps
+    idle-expired VMs and abandoned executions without manual gc_tick calls."""
+    cluster = InProcessCluster(
+        storage_uri="mem://gc-timer",
+        gc_period_s=0.2,
+        execution_ttl_s=1.0,
+    )
+    lzy = cluster.lzy()
+    try:
+        # a workflow left ACTIVE (no finish) with a short-idle session VM
+        with lzy.workflow("gc-wf"):
+            assert int(inc(1)) == 2
+        # shrink the session idle timeout so the cached VM expires fast
+        for session in cluster.allocator._sessions.values():
+            session.idle_timeout_s = 0.3
+        from lzy_tpu import __version__
+
+        exec_id = cluster.workflow_service.start_workflow(
+            "gc-user", "abandoned", "mem://gc-timer",
+            client_version=__version__)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            vms_gone = cluster.allocator.vms() == []
+            doc = cluster.store.kv_get("executions", exec_id)
+            exec_reaped = doc is not None and doc.get("status") != "ACTIVE"
+            if vms_gone and exec_reaped:
+                break
+            time.sleep(0.1)
+        assert cluster.allocator.vms() == []
+        doc = cluster.store.kv_get("executions", exec_id)
+        assert doc.get("status") != "ACTIVE"
+    finally:
+        cluster.shutdown()
