@@ -1,4 +1,5 @@
-from lzy_tpu.parallel.mesh import AXES, MeshSpec, dp_mesh, fsdp_mesh, mesh_for
+from lzy_tpu.parallel.mesh import (AXES, MeshSpec, dp_mesh, fsdp_mesh,
+                                   hybrid_mesh, mesh_for)
 from lzy_tpu.parallel.sharding import (
     DEFAULT_RULES,
     infer_param_logical_axes,
@@ -23,6 +24,7 @@ __all__ = [
     "dp_mesh",
     "fsdp_mesh",
     "mesh_for",
+    "hybrid_mesh",
     "DEFAULT_RULES",
     "infer_param_logical_axes",
     "named_sharding",

@@ -78,3 +78,74 @@ def mesh_for(n_devices: Optional[int] = None, **axis_sizes: int) -> Mesh:
     """``mesh_for(tp=4, fsdp=-1)`` over the first n (default: all) devices."""
     devices = jax.devices()[: n_devices] if n_devices else jax.devices()
     return MeshSpec(**axis_sizes).build(devices)
+
+
+def _group_by_slice(devices: Sequence[jax.Device],
+                    n_slices: int) -> list:
+    """Split devices into slice groups. Real multi-slice TPU devices carry
+    ``slice_index``; anything else (CPU meshes in tests, single-slice dry
+    runs) is chunked evenly into virtual slices — same construction, so the
+    DCN layout logic is testable without multi-slice hardware."""
+    ids = [getattr(d, "slice_index", None) for d in devices]
+    if all(i is not None for i in ids) and len(set(ids)) > 1:
+        by_id: Dict[int, list] = {}
+        for d, i in zip(devices, ids):
+            by_id.setdefault(i, []).append(d)
+        if len(by_id) != n_slices:
+            raise ValueError(
+                f"devices span {len(by_id)} slices but the dcn axes need "
+                f"{n_slices}"
+            )
+        groups = [by_id[i] for i in sorted(by_id)]
+    else:
+        if len(devices) % n_slices:
+            raise ValueError(
+                f"{len(devices)} devices not divisible into {n_slices} "
+                f"virtual slices"
+            )
+        per = len(devices) // n_slices
+        groups = [list(devices[i * per:(i + 1) * per])
+                  for i in range(n_slices)]
+    if len({len(g) for g in groups}) != 1:
+        raise ValueError("slices are unevenly sized")
+    return groups
+
+
+def hybrid_mesh(*, dcn_dp: int = 1, dcn_pp: int = 1,
+                devices: Optional[Sequence[jax.Device]] = None,
+                **ici_axes: int) -> Mesh:
+    """Multi-slice mesh: ``dcn_*`` axes run ACROSS slices (data-center
+    network), ``ici_axes`` within each slice (chip interconnect) — the
+    scaling-book recipe where only the bandwidth-tolerant axes (data and
+    pipeline) ever cross the DCN boundary.
+
+    ``hybrid_mesh(dcn_dp=2, fsdp=-1)`` on 2 slices of 8 chips builds the
+    canonical 6-axis mesh with ``dp=2`` spanning slices and ``fsdp=8``
+    inside each: every fsdp all-gather rides ICI, only the dp gradient
+    psum crosses DCN. The dcn axes merge slice-major into the canonical
+    ``dp``/``pp`` axes, so all existing sharding rules apply unchanged."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if dcn_dp < 1 or dcn_pp < 1:
+        # no -1 wildcard here: silently treating it as single-slice would
+        # let fsdp/tp collectives span the DCN boundary — the exact
+        # misconfiguration this function exists to prevent
+        raise ValueError(
+            f"dcn axes must be >= 1 (got dcn_dp={dcn_dp}, dcn_pp={dcn_pp}); "
+            f"-1 is not supported on dcn axes"
+        )
+    n_slices = dcn_dp * dcn_pp
+    if n_slices == 1:
+        return MeshSpec(**ici_axes).build(devices)
+    for axis in ("dp", "pp"):
+        if ici_axes.get(axis, 1) == -1:
+            raise ValueError(f"ici {axis} may not be -1 under a dcn_{axis}")
+    groups = _group_by_slice(devices, n_slices)
+    ici = MeshSpec(**ici_axes).resolve(len(groups[0]))
+    # [dcn_pp, dcn_dp, pp, dp, fsdp, ep, tp, sp] with one slice per (i, j)
+    big = np.empty((dcn_pp, dcn_dp) + ici.shape, dtype=object)
+    for s, (i, j) in enumerate(np.ndindex(dcn_pp, dcn_dp)):
+        big[i, j] = np.asarray(groups[s]).reshape(ici.shape)
+    # merge dcn-major into the canonical axes: pp = dcn_pp x ici.pp, etc.
+    merged = big.transpose(0, 2, 1, 3, 4, 5, 6, 7).reshape(
+        (dcn_pp * ici.pp, dcn_dp * ici.dp) + ici.shape[2:])
+    return Mesh(merged, AXES)

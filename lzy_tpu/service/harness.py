@@ -192,6 +192,12 @@ class InProcessCluster:
             # below or outlive the store it reads
             self._gc_stop.set()
             self._gc_thread.join(timeout=10.0)
+            if self._gc_thread.is_alive():
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "gc thread still running after 10s; teardown may race it"
+                )
         for vm in list(self.allocator.vms()):
             try:
                 self.backend.destroy(vm)
