@@ -18,15 +18,32 @@ import jax.numpy as jnp
 from lzy_tpu.models.llama import Llama, LlamaConfig
 
 
-def sample_token(logits: jax.Array, temperature: float,
-                 rng: jax.Array):
-    """Shared greedy/temperature sampling for every model family's decode
-    loop; logits [B, V] → ([B] int32, rng)."""
+def sample_token(logits: jax.Array, temperature: float, rng: jax.Array,
+                 *, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None):
+    """Shared sampling for every model family's decode loop; logits [B, V] →
+    ([B] int32, rng). ``temperature<=0`` is greedy; ``top_k`` keeps the k
+    highest logits (``<=0`` disables the filter, the common sentinel
+    convention); ``top_p`` keeps the smallest nucleus whose probability
+    mass reaches p (both filters compose: k first, then p)."""
     rng, sub = jax.random.split(rng)
     if temperature <= 0.0:
-        nxt = jnp.argmax(logits, axis=-1)
-    else:
-        nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
+    logits = logits / temperature
+    if top_k is not None and 0 < top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # the cutoff logit: smallest prefix with mass >= p always keeps the
+        # top token (cum >= p is first true AT the token that crosses p)
+        crossed = cum >= top_p
+        idx = jnp.argmax(crossed, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, idx[..., None], axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    nxt = jax.random.categorical(sub, logits, axis=-1)
     return nxt.astype(jnp.int32), rng
 
 
@@ -46,6 +63,8 @@ def generate(
     *,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
     eos_token: Optional[int] = None,
 ) -> jax.Array:
@@ -76,7 +95,8 @@ def generate(
         logits, updated = model.apply(
             {"params": params, "cache": cache}, token, mutable=["cache"]
         )
-        nxt, rng = sample_token(logits[:, -1], temperature, rng)
+        nxt, rng = sample_token(logits[:, -1], temperature, rng,
+                                top_k=top_k, top_p=top_p)
         return updated["cache"], nxt, rng
 
     # prefill: feed prompt tokens through the cache one position at a time

@@ -308,6 +308,8 @@ def t5_generate(
     max_new_tokens: int,
     enc_mask: Optional[jax.Array] = None,
     temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
     eos_token: Optional[int] = None,
 ) -> jax.Array:
@@ -340,7 +342,8 @@ def t5_generate(
             {"params": params, "cache": cache}, token, enc_out, enc_mask,
             mutable=["cache"], method=T5.decode,
         )
-        nxt, rng = sample_token(logits[:, -1], temperature, rng)
+        nxt, rng = sample_token(logits[:, -1], temperature, rng,
+                                top_k=top_k, top_p=top_p)
         return updated["cache"], nxt, rng
 
     cur = jnp.full((b, 1), cfg.bos_token, jnp.int32)

@@ -27,10 +27,22 @@ _LOG = get_logger(__name__)
 
 class CheckpointManager:
     def __init__(self, client: StorageClient, root_uri: str, name: str,
-                 *, keep: int = 3):
+                 *, keep: int = 3, keep_best: int = 0,
+                 best_metric: str = "loss", best_mode: str = "min"):
+        """``keep``: most-recent checkpoints retained. ``keep_best``:
+        additionally retain the k best by ``best_metric`` from each save's
+        ``metrics`` dict (``best_mode`` "min" or "max") — a long run keeps
+        its lowest-eval-loss snapshot even after it ages out of the
+        recency window."""
+        if best_mode not in ("min", "max"):
+            raise ValueError(f"best_mode must be 'min' or 'max', got "
+                             f"{best_mode!r}")
         self._client = client
         self._base = join_uri(root_uri, "lzy_checkpoints", name)
         self._keep = keep
+        self._keep_best = keep_best
+        self._best_metric = best_metric
+        self._best_mode = best_mode
         self._pending: Optional[threading.Thread] = None
         self._pending_error: list = []
         self._ser = ArrayPytreeSerializer()
@@ -337,10 +349,43 @@ class CheckpointManager:
 
     # -- retention -------------------------------------------------------------
 
+    def _best_steps(self, steps: List[int]) -> set:
+        """The keep_best steps by manifest metric. Steps whose manifests lack
+        the metric (or carry NaN / non-numeric values) never count as 'best';
+        steps whose manifest CANNOT BE READ are protected outright — deleting
+        a checkpoint because of a transient storage error is irreversible."""
+        import math
+
+        if not self._keep_best:
+            return set()
+        scored = []
+        unreadable = set()
+        for step in steps:
+            try:
+                value = self.manifest(step).get("metrics", {}).get(
+                    self._best_metric)
+            except Exception:  # noqa: BLE001 — storage blip: fail SAFE
+                unreadable.add(step)
+                continue
+            try:
+                value = float(value)
+            except (TypeError, ValueError):
+                continue               # non-numeric metric: recency-only
+            if math.isnan(value):
+                continue               # a diverged save must not hold a slot
+            scored.append((value, step))
+        scored.sort(reverse=(self._best_mode == "max"))
+        return unreadable | {step for _, step in scored[: self._keep_best]}
+
     def _gc(self) -> None:
         steps = self.steps()
-        for old in steps[: max(0, len(steps) - self._keep)]:
+        protected = set(steps[max(0, len(steps) - self._keep):])
+        protected |= self._best_steps(steps)
+        for old in steps:
+            if old in protected:
+                continue
             prefix = join_uri(self._base, f"step_{old:010d}")
             for uri in list(self._client.list(prefix)):
                 self._client.delete(uri)
-            _LOG.info("checkpoint step %d reaped (keep=%d)", old, self._keep)
+            _LOG.info("checkpoint step %d reaped (keep=%d, keep_best=%d)",
+                      old, self._keep, self._keep_best)

@@ -427,3 +427,62 @@ class TestResumableSourceHardening:
         np.testing.assert_array_equal(next(iter(resumed))["x"],
                                       np.arange(8, 12))
         np.testing.assert_array_equal(seen[0], np.arange(0, 4))
+
+
+class TestKeepBest:
+    def test_best_checkpoint_survives_recency_gc(self, tmp_path):
+        """keep=1 recency window + keep_best=1: the lowest-loss step stays
+        even after newer (worse) saves age it out of the window."""
+        from lzy_tpu.parallel.checkpoint import CheckpointManager
+        from lzy_tpu.storage import StorageConfig, client_for
+
+        client = client_for(StorageConfig(uri=f"file://{tmp_path}/s"))
+        mgr = CheckpointManager(client, f"file://{tmp_path}/s", "run",
+                                keep=1, keep_best=1, best_metric="loss")
+        state = {"w": jnp.ones((4,))}
+        losses = {10: 3.0, 20: 1.0, 30: 2.5, 40: 2.0}
+        for step, loss in sorted(losses.items()):
+            mgr.save(state, step, metrics={"loss": loss})
+        # recency keeps 40; best keeps 20 (loss 1.0); the rest are reaped
+        assert mgr.steps() == [20, 40]
+        assert mgr.manifest(20)["metrics"]["loss"] == 1.0
+        # and the best one restores
+        restored = mgr.restore(step=20)
+        assert jnp.allclose(restored["w"], state["w"])
+
+    def test_best_mode_max(self, tmp_path):
+        from lzy_tpu.parallel.checkpoint import CheckpointManager
+        from lzy_tpu.storage import StorageConfig, client_for
+
+        client = client_for(StorageConfig(uri=f"file://{tmp_path}/s"))
+        mgr = CheckpointManager(client, f"file://{tmp_path}/s", "run",
+                                keep=1, keep_best=1, best_metric="acc",
+                                best_mode="max")
+        for step, acc in ((1, 0.5), (2, 0.9), (3, 0.6), (4, 0.7)):
+            mgr.save({"w": jnp.zeros(2)}, step, metrics={"acc": acc})
+        assert mgr.steps() == [2, 4]
+
+    def test_metricless_saves_never_count_as_best(self, tmp_path):
+        from lzy_tpu.parallel.checkpoint import CheckpointManager
+        from lzy_tpu.storage import StorageConfig, client_for
+
+        client = client_for(StorageConfig(uri=f"file://{tmp_path}/s"))
+        mgr = CheckpointManager(client, f"file://{tmp_path}/s", "run",
+                                keep=1, keep_best=2)
+        mgr.save({"w": jnp.zeros(2)}, 1)                       # no metrics
+        mgr.save({"w": jnp.zeros(2)}, 2, metrics={"loss": 0.5})
+        mgr.save({"w": jnp.zeros(2)}, 3)                       # no metrics
+        assert mgr.steps() == [2, 3]       # 3 by recency, 2 by best
+
+    def test_nan_and_junk_metrics_never_hold_best_slots(self, tmp_path):
+        from lzy_tpu.parallel.checkpoint import CheckpointManager
+        from lzy_tpu.storage import StorageConfig, client_for
+
+        client = client_for(StorageConfig(uri=f"file://{tmp_path}/s"))
+        mgr = CheckpointManager(client, f"file://{tmp_path}/s", "run",
+                                keep=1, keep_best=1)
+        mgr.save({"w": jnp.zeros(2)}, 1, metrics={"loss": 0.4})  # true best
+        mgr.save({"w": jnp.zeros(2)}, 2, metrics={"loss": float("nan")})
+        mgr.save({"w": jnp.zeros(2)}, 3, metrics={"loss": [0.1]})  # junk
+        mgr.save({"w": jnp.zeros(2)}, 4, metrics={"loss": 2.0})
+        assert mgr.steps() == [1, 4]   # best=1 survives; nan/junk reaped

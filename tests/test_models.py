@@ -414,3 +414,60 @@ class TestT5:
             state, metrics = step(state, batch)
             losses.append(float(metrics["loss"]))
         assert losses[-1] < losses[0]
+
+
+class TestSampling:
+    def _logits(self):
+        # token 3 dominant, then 1, then 0; tokens 2,4 negligible
+        return jnp.array([[1.0, 2.0, -5.0, 4.0, -6.0]])
+
+    def test_top_k_restricts_support(self):
+        from lzy_tpu.models.generate import sample_token
+
+        seen = set()
+        rng = jax.random.PRNGKey(0)
+        for _ in range(40):
+            tok, rng = sample_token(self._logits(), 1.0, rng, top_k=2)
+            seen.add(int(tok[0]))
+        assert seen <= {1, 3}
+        assert 3 in seen
+
+    def test_top_p_keeps_nucleus_only(self):
+        from lzy_tpu.models.generate import sample_token
+
+        # softmax of [1,2,-5,4,-6] ≈ [.045,.122,.0001,.832,...]: p=.9 keeps
+        # {3,1}; p tiny keeps only the argmax
+        seen = set()
+        rng = jax.random.PRNGKey(1)
+        for _ in range(40):
+            tok, rng = sample_token(self._logits(), 1.0, rng, top_p=0.9)
+            seen.add(int(tok[0]))
+        assert seen <= {1, 3}
+        tok, _ = sample_token(self._logits(), 1.0, jax.random.PRNGKey(2),
+                              top_p=0.01)
+        assert int(tok[0]) == 3
+
+    def test_greedy_ignores_filters(self):
+        from lzy_tpu.models.generate import sample_token
+
+        tok, _ = sample_token(self._logits(), 0.0, jax.random.PRNGKey(0),
+                              top_k=1, top_p=0.1)
+        assert int(tok[0]) == 3
+
+    def test_generate_accepts_sampling_filters(self):
+        from lzy_tpu.models import generate, llama, unbox
+
+        cfg = llama.LlamaConfig.tiny(vocab_size=64)
+        boxed, _ = llama.init_params(cfg, jax.random.PRNGKey(0))
+        params = unbox(boxed)
+        out = generate(cfg, params, jnp.array([[3, 5]], jnp.int32),
+                       max_new_tokens=3, temperature=0.8, top_k=10,
+                       top_p=0.95)
+        assert out.shape == (1, 5)
+
+    def test_top_k_zero_is_disabled_not_a_crash(self):
+        from lzy_tpu.models.generate import sample_token
+
+        tok, _ = sample_token(self._logits(), 1.0, jax.random.PRNGKey(0),
+                              top_k=0)
+        assert 0 <= int(tok[0]) < 5
