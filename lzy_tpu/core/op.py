@@ -72,10 +72,17 @@ class LzyOp(WithEnvironmentMixin):
 
     def __reduce__(self):
         """Pickle by module reference when this op is a module-level attribute
-        (the common case) — the remote worker then resolves the very same
-        object instead of receiving a closure copy. Matters for in-process
-        workers (shared state stays shared) and keeps payloads tiny for real
-        remote ones. Falls back to by-value for notebook/local defs."""
+        of an importable module (the common case) — the remote worker then
+        resolves the very same object instead of receiving a closure copy.
+        Matters for in-process workers (shared state stays shared) and keeps
+        payloads tiny for real remote ones.
+
+        ``__main__`` ops (user scripts, notebooks) get BOTH: a reference the
+        loader prefers when the executing interpreter really has this op in
+        its ``__main__`` (thread workers — shared state stays shared), and an
+        embedded by-value copy it falls back to elsewhere — a worker
+        process's ``__main__`` is the worker binary, never the user's script,
+        so the reference alone would resolve to nothing there."""
         import sys
 
         target = sys.modules.get(getattr(self, "__module__", None))
@@ -84,9 +91,20 @@ class LzyOp(WithEnvironmentMixin):
                 target = getattr(target, part)
         except AttributeError:
             target = None
-        if target is self:
-            return (_resolve_op, (self.__module__, self.__qualname__))
-        return super().__reduce__()
+        if target is not self:
+            return super().__reduce__()
+        if self.__module__ == "__main__":
+            import cloudpickle
+
+            try:
+                payload = cloudpickle.dumps((type(self), dict(self.__dict__)))
+            except Exception:  # noqa: BLE001 — e.g. func closes over a live
+                # service handle; same-interpreter execution still works via
+                # the reference, so don't fail the pickle here — the copy
+                # path raises a clear error if it's ever actually needed
+                payload = None
+            return (_resolve_main_op, (self.__qualname__, payload))
+        return (_resolve_op, (self.__module__, self.__qualname__))
 
 
 def _resolve_op(module: str, qualname: str) -> "LzyOp":
@@ -96,6 +114,32 @@ def _resolve_op(module: str, qualname: str) -> "LzyOp":
     for part in qualname.split("."):
         obj = getattr(obj, part)
     return obj
+
+
+def _resolve_main_op(qualname: str, payload: bytes) -> "LzyOp":
+    """Loader for ``__main__`` ops: the live object when this interpreter's
+    ``__main__`` has it (same-process execution), else the shipped copy."""
+    import pickle
+    import sys
+
+    obj = sys.modules.get("__main__")
+    try:
+        for part in qualname.split("."):
+            obj = getattr(obj, part)
+    except AttributeError:
+        obj = None
+    if isinstance(obj, LzyOp) and obj.__qualname__ == qualname:
+        return obj
+    if payload is None:
+        raise RuntimeError(
+            f"op {qualname!r} was defined in __main__ and references state "
+            f"that cannot travel to another process; define it in an "
+            f"importable module or drop the unpicklable reference"
+        )
+    cls, state = pickle.loads(payload)
+    op_obj = cls.__new__(cls)
+    op_obj.__dict__.update(state)
+    return op_obj
 
 
 @overload

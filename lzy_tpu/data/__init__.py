@@ -1,4 +1,6 @@
 from lzy_tpu.data.pipeline import DataPipeline, synthetic_lm_batches
 from lzy_tpu.data.resumable import ResumableSource, array_source
+from lzy_tpu.data.token_file import TokenFile, write_token_file
 
-__all__ = ["DataPipeline", "ResumableSource", "array_source", "synthetic_lm_batches"]
+__all__ = ["DataPipeline", "ResumableSource", "TokenFile", "array_source",
+           "synthetic_lm_batches", "write_token_file"]

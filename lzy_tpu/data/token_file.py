@@ -1,0 +1,199 @@
+"""Token files: the native data-loader's on-disk format + loader.
+
+A ``TokenFile`` is a self-describing binary of packed token ids (the
+pretraining-corpus layout: one flat stream, windows of ``seq_len`` become LM
+examples). The hot path — gathering a batch of strided windows out of the
+memory-mapped file and widening them to int32 — runs in the C++ engine
+(``native/data_loader.cpp``) behind a ctypes call, which releases the GIL for
+the whole gather; under a prefetching :class:`~lzy_tpu.data.DataPipeline`
+batch assembly therefore genuinely overlaps the train step instead of
+contending with it for the interpreter. Ordering, sharding, and resumable
+positions stay in :class:`~lzy_tpu.data.ResumableSource` (one epoch/shuffle
+implementation for every source kind); a pure-numpy fallback keeps the loader
+working where the toolchain is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import struct
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from lzy_tpu.data.resumable import ResumableSource
+from lzy_tpu.native.build import NativeUnavailable, load_native_lib
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+_MAGIC = b"LZYTOK1\n"
+_HEADER = struct.Struct("<8sIQ")  # magic, token bytes (2|4), token count
+
+_lib = None
+_lib_failed = False
+_lib_lock = threading.Lock()
+
+
+def _load_native():
+    """Shared build-on-demand load (native/build.py); None when the engine
+    is unavailable — this loader degrades to numpy instead of raising."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            lib = load_native_lib("liblzy_data.so")
+            lib.lzy_dl_open.argtypes = [ctypes.c_char_p]
+            lib.lzy_dl_open.restype = ctypes.c_void_p
+            lib.lzy_dl_num_tokens.argtypes = [ctypes.c_void_p]
+            lib.lzy_dl_num_tokens.restype = ctypes.c_longlong
+            lib.lzy_dl_token_bytes.argtypes = [ctypes.c_void_p]
+            lib.lzy_dl_token_bytes.restype = ctypes.c_int
+            lib.lzy_dl_close.argtypes = [ctypes.c_void_p]
+            lib.lzy_dl_gather.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+                ctypes.c_int, ctypes.c_longlong,
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ]
+            lib.lzy_dl_gather.restype = ctypes.c_int
+            lib.lzy_dl_last_error.restype = ctypes.c_char_p
+            _lib = lib
+        except NativeUnavailable as e:
+            _lib_failed = True
+            _LOG.warning("native data loader unavailable (%s); "
+                         "using the numpy fallback", e)
+    return _lib
+
+
+def write_token_file(path: str | pathlib.Path, tokens: np.ndarray) -> None:
+    """Pack a 1-D array of token ids; uint16 payload when the vocab fits
+    (halves the file and the read bandwidth), int32 otherwise."""
+    tokens = np.ascontiguousarray(np.asarray(tokens).ravel())
+    if tokens.size == 0:
+        raise ValueError("refusing to write an empty token file")
+    if tokens.min() < 0:
+        raise ValueError("token ids must be non-negative")
+    if tokens.max() >= 2 ** 31:
+        raise ValueError("token ids must fit int32")
+    width = 2 if tokens.max() < 2 ** 16 else 4
+    payload = tokens.astype(np.uint16 if width == 2 else np.int32)
+    path = pathlib.Path(path)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(_HEADER.pack(_MAGIC, width, tokens.size))
+        f.write(payload.tobytes())
+    tmp.replace(path)  # atomic: readers never see a half-written file
+
+
+class TokenFile:
+    """Read side: mmap-backed random-access windows over a token file."""
+
+    def __init__(self, path: str | pathlib.Path, *, native: bool = True):
+        self._path = str(path)
+        self._handle = None
+        self._mmap: Optional[np.memmap] = None
+        lib = _load_native() if native else None
+        if lib is not None:
+            handle = lib.lzy_dl_open(self._path.encode())
+            if not handle:
+                raise ValueError(
+                    f"{self._path}: "
+                    f"{lib.lzy_dl_last_error().decode(errors='replace')}"
+                )
+            self._lib = lib
+            self._handle = handle
+            self.n_tokens = int(lib.lzy_dl_num_tokens(handle))
+            self._token_bytes = lib.lzy_dl_token_bytes(handle)
+        else:
+            with open(self._path, "rb") as f:
+                header = f.read(_HEADER.size)
+            if len(header) < _HEADER.size:
+                # same error contract as the native path's "file too small"
+                raise ValueError(
+                    f"{self._path}: file too small for token header"
+                )
+            magic, width, count = _HEADER.unpack(header)
+            if magic != _MAGIC:
+                raise ValueError(f"{self._path}: not a LZYTOK1 token file")
+            if width not in (2, 4):
+                raise ValueError(f"{self._path}: bad token width {width}")
+            self.n_tokens = int(count)
+            self._token_bytes = width
+            try:
+                self._mmap = np.memmap(
+                    self._path, mode="r",
+                    dtype=np.uint16 if width == 2 else np.int32,
+                    offset=_HEADER.size, shape=(self.n_tokens,),
+                )
+            except ValueError as e:   # shape larger than the file
+                raise ValueError(f"{self._path}: truncated payload") from e
+
+    @property
+    def is_native(self) -> bool:
+        return self._handle is not None
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.lzy_dl_close(self._handle)
+            self._handle = None
+        self._mmap = None
+
+    def __enter__(self) -> "TokenFile":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def gather(self, starts: np.ndarray, width: int,
+               *, n_threads: int = 4) -> np.ndarray:
+        """(len(starts), width) int32 windows; ``starts`` are absolute token
+        offsets. Native path releases the GIL for the whole copy."""
+        starts = np.ascontiguousarray(starts, dtype=np.int64)
+        out = np.empty((starts.size, width), dtype=np.int32)
+        if self._handle is not None:
+            rc = self._lib.lzy_dl_gather(
+                self._handle,
+                starts.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+                starts.size, width,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                n_threads,
+            )
+            if rc != 0:
+                raise IndexError(
+                    self._lib.lzy_dl_last_error().decode(errors="replace")
+                )
+            return out
+        if starts.size and (starts.min() < 0
+                            or starts.max() + width > self.n_tokens):
+            raise IndexError("window out of range")
+        for i, s in enumerate(starts):
+            out[i] = self._mmap[s:s + width]
+        return out
+
+    def lm_source(self, *, batch_size: int, seq_len: int,
+                  stride: Optional[int] = None, n_threads: int = 4,
+                  **kwargs) -> ResumableSource:
+        """ResumableSource of ``{"tokens": (batch, seq_len) int32}`` LM
+        batches over non-overlapping (or ``stride``-strided) windows;
+        shuffling/sharding/resume come from ResumableSource — state saved
+        with a checkpoint resumes at the exact next window."""
+        stride = stride or seq_len
+        if stride <= 0:
+            raise ValueError("stride must be positive")
+        n_windows = (self.n_tokens - seq_len) // stride + 1
+        if n_windows <= 0:
+            raise ValueError(
+                f"file has {self.n_tokens} tokens < seq_len {seq_len}"
+            )
+
+        def batch_of(indices: np.ndarray) -> Dict[str, np.ndarray]:
+            return {"tokens": self.gather(indices * stride, seq_len,
+                                          n_threads=n_threads)}
+
+        return ResumableSource(n_windows, batch_of,
+                               batch_size=batch_size, **kwargs)

@@ -69,7 +69,10 @@ class IamService:
 
     def __init__(self, store: OperationStore, secret: Optional[str] = None,
                  max_token_age_s: Optional[float] = None):
+        import threading
+
         self._store = store
+        self._ott_lock = threading.Lock()
         self.max_token_age_s = (
             self.DEFAULT_MAX_TOKEN_AGE_S if max_token_age_s is None
             else max_token_age_s
@@ -113,6 +116,74 @@ class IamService:
         if doc is None:
             raise KeyError(f"unknown subject {subject_id!r}")
         return self._issue(subject_id, int(doc.get("gen", 0)))
+
+    # -- one-time tokens (OTT) -------------------------------------------------
+
+    DEFAULT_OTT_TTL_S = 900.0   # a VM that takes >15 min to boot is dead
+
+    def issue_ott(self, subject_id: str,
+                  ttl_s: Optional[float] = None) -> str:
+        """One-time bootstrap credential (the reference's ``OttCredentials``/
+        ``OttHelper``, ``util/util-auth/.../credentials/``): handed to a VM at
+        launch in place of a real token, redeemable exactly once. A pod spec
+        or process env that leaks after the worker registered is worthless —
+        the credential inside it is already burned."""
+        nonce = secrets.token_hex(16)
+        ttl = self.DEFAULT_OTT_TTL_S if ttl_s is None else ttl_s
+        with self._ott_lock:
+            # opportunistic sweep: launches that died before registering must
+            # not accumulate rows forever in the durable store
+            self._purge_expired_otts_locked()
+            self._store.kv_put(self._OTT_NS, nonce, {
+                "subject": subject_id, "expires": time.time() + ttl,
+            })
+        # deliberately NOT a valid bearer shape: authenticate() rejects it,
+        # so an OTT can never be replayed as a session token
+        return f"ott/{nonce}"
+
+    # own namespace: the sweep and lookups touch only OTT rows, never the
+    # (much larger) subject/secret table
+    _OTT_NS = "iam_ott"
+
+    def redeem_ott(self, ott: Optional[str],
+                   expect_subject: Optional[str] = None) -> str:
+        """Burn the OTT and return its subject id; AuthError if the token is
+        unknown, expired, or — the point — already redeemed. STRICTLY
+        one-time: there is no redelivery window (a grace would let a leaked
+        launch env be replayed for the durable credential right after the
+        real worker registers — the exact hole OTTs exist to close). A lost
+        register response therefore bricks that worker's credential; the
+        stale-allocation GC destroys and relaunches it with a fresh OTT.
+
+        ``expect_subject`` binds the exchange: a mismatch refuses WITHOUT
+        consuming, so probing with someone else's OTT cannot burn it."""
+        if not ott or not ott.startswith("ott/"):
+            raise AuthError("not a one-time token")
+        key = ott[4:]
+        with self._ott_lock:
+            doc = self._store.kv_get(self._OTT_NS, key)
+            if doc is None:
+                raise AuthError("one-time token unknown or already redeemed")
+            if expect_subject is not None \
+                    and doc["subject"] != expect_subject:
+                raise AuthError(
+                    f"one-time token is for {doc['subject']}, "
+                    f"not {expect_subject}"
+                )
+            self._store.kv_del(self._OTT_NS, key)
+        if time.time() > float(doc["expires"]):
+            raise AuthError("one-time token expired")
+        return doc["subject"]
+
+    def _purge_expired_otts_locked(self) -> None:
+        now = time.time()
+        for key, doc in list(self._store.kv_list(self._OTT_NS).items()):
+            if doc is None or now > float(doc["expires"]):
+                self._store.kv_del(self._OTT_NS, key)
+
+    @staticmethod
+    def is_ott(token: Optional[str]) -> bool:
+        return bool(token) and token.startswith("ott/")
 
     # -- tokens ----------------------------------------------------------------
 

@@ -1,0 +1,63 @@
+"""Shared build-on-demand loader for the C++ engines under ``native/``.
+
+One ``make`` lock for the whole process: the slot engine and the data loader
+build into the same ``native/build`` directory, and two concurrent makes
+racing on shared targets corrupt each other. Failures are cached — retrying
+the compiler on every call would put its timeout on hot paths (VM boot, batch
+assembly).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+import threading
+from typing import Dict, Union
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+NATIVE_DIR = _REPO_ROOT / "native"
+BUILD_DIR = NATIVE_DIR / "build"
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+_lock = threading.Lock()
+_cache: Dict[str, Union[ctypes.CDLL, NativeUnavailable]] = {}
+
+
+def load_native_lib(so_name: str) -> ctypes.CDLL:
+    """CDLL for ``native/build/<so_name>``, building the native tree on
+    first use; raises (and caches) NativeUnavailable when the toolchain or
+    the build is broken. Symbol signatures are the caller's business."""
+    cached = _cache.get(so_name)
+    if cached is not None:
+        if isinstance(cached, NativeUnavailable):
+            raise cached
+        return cached
+    with _lock:
+        cached = _cache.get(so_name)
+        if cached is not None:
+            if isinstance(cached, NativeUnavailable):
+                raise cached
+            return cached
+        path = BUILD_DIR / so_name
+        try:
+            if not path.exists():
+                subprocess.run(
+                    ["make", "-C", str(NATIVE_DIR)],
+                    check=True, capture_output=True, text=True, timeout=120,
+                )
+            lib = ctypes.CDLL(str(path))
+        except (OSError, subprocess.CalledProcessError,
+                subprocess.TimeoutExpired) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            err = NativeUnavailable(
+                f"could not build/load {so_name}: {detail}"
+            )
+            _cache[so_name] = err
+            raise err from e
+        _cache[so_name] = lib
+        return lib

@@ -11,52 +11,24 @@ from __future__ import annotations
 
 import ctypes
 import os
-import pathlib
-import subprocess
 import threading
 import time
 from typing import Optional
 
-_REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
-_NATIVE_DIR = _REPO_ROOT / "native"
-_LIB_PATH = _NATIVE_DIR / "build" / "liblzy_slots.so"
+from lzy_tpu.native.build import NativeUnavailable, load_native_lib
 
 _lib = None
-_lib_error: Optional[Exception] = None
 _lib_lock = threading.Lock()
 
 
-class NativeUnavailable(RuntimeError):
-    pass
-
-
 def _load():
-    global _lib, _lib_error
+    global _lib
     if _lib is not None:
         return _lib
-    if _lib_error is not None:
-        # failed builds are cached too: retrying `make` on every VM boot
-        # would put a compiler timeout on the allocation latency path
-        raise NativeUnavailable(str(_lib_error)) from _lib_error
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if _lib_error is not None:
-            raise NativeUnavailable(str(_lib_error)) from _lib_error
-        if not _LIB_PATH.exists():
-            try:
-                subprocess.run(
-                    ["make", "-C", str(_NATIVE_DIR)],
-                    check=True, capture_output=True, text=True, timeout=120,
-                )
-            except (subprocess.CalledProcessError, OSError,
-                    subprocess.TimeoutExpired) as e:
-                detail = getattr(e, "stderr", "") or str(e)
-                _lib_error = NativeUnavailable(
-                    f"could not build native slot engine: {detail}"
-                )
-                raise _lib_error from e
-        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib = load_native_lib("liblzy_slots.so")
         lib.lzy_slots_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
         lib.lzy_slots_server_start.restype = ctypes.c_int
         lib.lzy_slots_server_port.argtypes = [ctypes.c_int]

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 
 def ulysses_attention(
@@ -68,5 +68,5 @@ def ulysses_attention(
         mesh=mesh,
         in_specs=(q_spec, q_spec, q_spec),
         out_specs=q_spec,
-        check_rep=False,
+        check_vma=False,
     )(q, k, v)

@@ -41,7 +41,15 @@ class WorkerToken:
         self.previous: Optional[str] = None
 
     def rotate(self, new: str) -> None:
-        if new != self.current:
+        if new == self.current:
+            return
+        if self.current.startswith("ott/"):
+            # bootstrap swap, not a refresh: the OTT is burned server-side
+            # and must not linger as an accepted credential (a leaked launch
+            # env would stay usable against our own WorkerApi until the next
+            # rotation otherwise)
+            self.previous, self.current = None, new
+        else:
             self.previous, self.current = self.current, new
 
     def accepts(self, token: Optional[str]) -> bool:
@@ -115,8 +123,18 @@ class ControlPlaneServer:
                     "slot_peer": peer, "storage_uri": ch.storage_uri}
 
         def h_register_vm(p):
-            worker_auth(p, vm_id=p["vm_id"])
             vm_id = p["vm_id"]
+            durable = None
+            if iam is not None and iam.is_ott(p.get("token")):
+                # first boot: the launch env carries a one-time credential;
+                # burn it and swap in the durable WORKER token (reference OTT
+                # bootstrap). Re-registrations present the durable token and
+                # take the ordinary worker_auth path.
+                durable = allocator.redeem_bootstrap_token(
+                    vm_id, p["token"]
+                )
+            else:
+                worker_auth(p, vm_id=vm_id)
             allocator.vm(vm_id)  # KeyError → NOT_FOUND for unknown VMs
             allocator.register_vm(
                 vm_id,
@@ -128,7 +146,7 @@ class ControlPlaneServer:
                     token=lambda: allocator.vm(vm_id).worker_token,
                 ),
             )
-            return {}
+            return {"token": durable} if durable else {}
 
         def h_heartbeat(p):
             worker_auth(p, vm_id=p["vm_id"])
@@ -313,9 +331,13 @@ class RpcAllocatorClient:
 
     def register_vm(self, vm_id: str, agent: Any) -> None:
         # the live agent object cannot travel; its gRPC endpoint does
-        self._client.call("RegisterVm", {"vm_id": vm_id,
-                                         "endpoint": self._endpoint,
-                                         "token": _token_value(self._token)})
+        resp = self._client.call(
+            "RegisterVm", {"vm_id": vm_id, "endpoint": self._endpoint,
+                           "token": _token_value(self._token)})
+        if resp and resp.get("token") and isinstance(self._token, WorkerToken):
+            # OTT bootstrap: the launch env credential was one-time; the
+            # register response carries the durable WORKER token
+            self._token.rotate(resp["token"])
 
     def heartbeat(self, vm_id: str) -> None:
         try:
@@ -329,9 +351,7 @@ class RpcAllocatorClient:
             # a rebooted control plane restored our VM record but lost the
             # endpoint: re-register to reconnect. If the record itself is gone
             # this raises too, and the agent's failure counting takes over.
-            self._client.call("RegisterVm", {"vm_id": vm_id,
-                                             "endpoint": self._endpoint,
-                                             "token": _token_value(self._token)})
+            self.register_vm(vm_id, None)
 
 
 @dataclasses.dataclass

@@ -286,6 +286,33 @@ class AllocatorService:
             self._persist(vm)
             return vm.worker_token
 
+    def mint_bootstrap_token(self, vm_id: str) -> Optional[str]:
+        """Fresh one-time credential for a VM launch (the reference's OTT VM
+        bootstrap, ``util/util-auth/.../OttHelper.java``): backends put THIS
+        in the pod/process env instead of the durable WORKER token, and the
+        register response swaps it for the real one. Each launch/recreate
+        mints its own — a recreated pod never re-presents a burned OTT.
+        None when IAM is off (no tokens anywhere then)."""
+        if self._iam is None:
+            return None
+        return self._iam.issue_ott(f"vm/{vm_id}")
+
+    def redeem_bootstrap_token(self, vm_id: str, ott: str) -> str:
+        """Burn the launch OTT and hand back the VM's durable WORKER token.
+        AuthError if the OTT is spent/expired or bound to a different VM."""
+        from lzy_tpu.iam import AuthError
+
+        if self._iam is None:
+            raise AuthError("no IAM on this plane; nothing to redeem")
+        # bind BEFORE burn: probing vm B's register with vm A's OTT must not
+        # consume A's credential (that would brick A's boot)
+        self._iam.redeem_ott(ott, expect_subject=f"vm/{vm_id}")
+        with self._lock:
+            vm = self._vms.get(vm_id)
+            if vm is None or not vm.worker_token:
+                raise AuthError(f"vm {vm_id!r} has no durable credential")
+            return vm.worker_token
+
     def agent(self, vm_id: str) -> Any:
         with self._lock:
             return self._agents[vm_id]

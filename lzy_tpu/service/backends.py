@@ -31,6 +31,15 @@ from lzy_tpu.utils.log import get_logger
 _LOG = get_logger(__name__)
 
 
+def _bootstrap_token(allocator, vm: Vm) -> Optional[str]:
+    """Launch credential for out-of-process workers: a fresh OTT per launch
+    when the plane runs IAM, else nothing. Falls back to the durable token
+    only for allocators without the mint hook (test doubles)."""
+    if allocator is not None and hasattr(allocator, "mint_bootstrap_token"):
+        return allocator.mint_bootstrap_token(vm.id)
+    return vm.worker_token
+
+
 class ThreadVmBackend(VmBackend):
     def __init__(
         self,
@@ -133,9 +142,12 @@ class ProcessVmBackend(VmBackend):
             pypath.append(env["PYTHONPATH"])
         env["PYTHONPATH"] = os.pathsep.join(pypath)
         env.setdefault("JAX_PLATFORMS", "cpu")
-        if vm.worker_token:
-            # via env, not argv: tokens must not show up in `ps`
-            env["LZY_WORKER_TOKEN"] = vm.worker_token
+        bootstrap = _bootstrap_token(self.allocator, vm)
+        if bootstrap:
+            # via env, not argv: tokens must not show up in `ps`; and a
+            # one-time credential, not the durable one — registration swaps
+            # it (reference OTT bootstrap)
+            env["LZY_WORKER_TOKEN"] = bootstrap
         args = [
             sys.executable, "-m", "lzy_tpu.rpc.worker_main",
             "--control", self._control_address_factory(),
@@ -215,9 +227,11 @@ class GkeTpuBackend(VmBackend):
             {"name": "LZY_WORKER_ADVERTISE_HOST",
              "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}}},
         ]
-        if vm.worker_token:
-            env.append({"name": "LZY_WORKER_TOKEN",
-                        "value": vm.worker_token})
+        bootstrap = _bootstrap_token(self.allocator, vm)
+        if bootstrap:
+            # one-time credential: anyone who reads this pod spec after the
+            # worker registered holds a burned token (reference OTT bootstrap)
+            env.append({"name": "LZY_WORKER_TOKEN", "value": bootstrap})
         container = {
             "name": "worker",
             "image": self._image,

@@ -1,0 +1,49 @@
+"""An op that re-spawns its own __main__ as a subprocess (reference scenario
+subprocess_with_startup: PyTorch-Lightning-style self-replication must not
+re-enter the workflow machinery or double-write outputs)."""
+import os
+import subprocess
+import sys
+
+SUBPROCESS_ENV_VAR = "LZY_SCENARIO_SUBPROCESS"
+
+if os.getenv(SUBPROCESS_ENV_VAR):
+    # the replicated child takes the guard path: no cluster, no workflow —
+    # exactly the reference's main-PID guard semantics
+    print("hello from subprocess", flush=True)
+    sys.exit(0)
+
+from tests.scenarios._base import make_lzy  # noqa: E402
+
+from lzy_tpu import op  # noqa: E402
+
+
+@op
+def run(num: int) -> int:
+    print("hello from main process", flush=True)
+    env = os.environ.copy()
+    env[SUBPROCESS_ENV_VAR] = "1"
+    import __main__
+
+    if getattr(__main__, "__spec__", None) is not None:
+        cmd = [sys.executable, "-m", __main__.__spec__.name]
+    else:
+        cmd = [sys.executable, os.path.abspath(sys.argv[0])]
+    sub = subprocess.run(cmd, env=env, stdout=subprocess.PIPE, text=True)
+    print(sub.stdout, end="", flush=True)
+    print(f"subprocess exit code: {sub.returncode}", flush=True)
+    return num * 2
+
+
+def main():
+    cluster, lzy = make_lzy()
+    try:
+        with lzy.workflow("subprocess-wf"):
+            res = run(21)
+            print(f"main process result: {int(res)}")
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

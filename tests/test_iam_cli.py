@@ -74,6 +74,51 @@ class TestIam:
         assert iam.authenticate(new).id == "alice"
         store.close()
 
+    def test_ott_redeems_exactly_once(self, tmp_path):
+        store = OperationStore(str(tmp_path / "iam.db"))
+        iam = IamService(store)
+        iam.create_subject("vm/v1", kind="WORKER", role="WORKER")
+        ott = iam.issue_ott("vm/v1")
+        assert iam.is_ott(ott) and not iam.is_ott("vm/v1:1:0:sig")
+        # an OTT is not a bearer token
+        with pytest.raises(AuthError):
+            iam.authenticate(ott)
+        assert iam.redeem_ott(ott) == "vm/v1"
+        with pytest.raises(AuthError, match="already redeemed|unknown"):
+            iam.redeem_ott(ott)
+        store.close()
+
+    def test_ott_subject_mismatch_does_not_burn(self, tmp_path):
+        store = OperationStore(str(tmp_path / "iam.db"))
+        iam = IamService(store)
+        ott = iam.issue_ott("vm/a")
+        # probing with the wrong subject refuses WITHOUT consuming…
+        with pytest.raises(AuthError, match="vm/a"):
+            iam.redeem_ott(ott, expect_subject="vm/b")
+        # …so the legitimate holder still boots
+        assert iam.redeem_ott(ott, expect_subject="vm/a") == "vm/a"
+        store.close()
+
+    def test_expired_otts_swept_from_store(self, tmp_path):
+        store = OperationStore(str(tmp_path / "iam.db"))
+        iam = IamService(store)
+        iam.issue_ott("vm/dead", ttl_s=-1.0)
+        assert len(store.kv_list(IamService._OTT_NS)) == 1
+        iam.issue_ott("vm/live")          # sweep runs on every issue
+        assert len(store.kv_list(IamService._OTT_NS)) == 1
+        store.close()
+
+    def test_ott_expires(self, tmp_path):
+        store = OperationStore(str(tmp_path / "iam.db"))
+        iam = IamService(store)
+        ott = iam.issue_ott("vm/v1", ttl_s=-1.0)
+        with pytest.raises(AuthError, match="expired"):
+            iam.redeem_ott(ott)
+        # expiry consumed it too: no second chance to race the clock
+        with pytest.raises(AuthError, match="already redeemed|unknown"):
+            iam.redeem_ott(ott)
+        store.close()
+
     def test_secret_survives_restart(self, tmp_path):
         store = OperationStore(str(tmp_path / "iam.db"))
         token = IamService(store).create_subject("alice")
@@ -263,6 +308,18 @@ class TestWorkerTokenRefresh:
         assert t.accepts("new") and t.accepts("old")     # one-rotation grace
         t.rotate("newer")
         assert not t.accepts("old")
+
+    def test_worker_token_bootstrap_swap_drops_ott(self):
+        """The OTT→durable swap must not keep the burned OTT as an accepted
+        credential — a leaked launch env would stay usable against the
+        worker's own WorkerApi until the next refresh otherwise."""
+        from lzy_tpu.rpc.control import WorkerToken
+
+        t = WorkerToken("ott/abc123")
+        t.rotate("vm/v:1:0:sig")
+        assert t.accepts("vm/v:1:0:sig")
+        assert not t.accepts("ott/abc123")
+        assert t.previous is None
 
 
 class TestAuthCli:

@@ -339,6 +339,21 @@ def test_worker_plane_requires_worker_token(tmp_path):
         with pytest.raises(AuthError):
             raw.call("Heartbeat", {"vm_id": "some-other-vm",
                                    "token": vm.worker_token})
+        # OTT bootstrap: the launch env carried a one-time credential which
+        # registration burned — a replayed OTT cannot re-register the VM
+        ott = c.allocator.mint_bootstrap_token(vm.id)
+        assert c.allocator.redeem_bootstrap_token(vm.id, ott) \
+            == vm.worker_token
+        with pytest.raises(AuthError):
+            raw.call("RegisterVm", {"vm_id": vm.id,
+                                    "endpoint": "127.0.0.1:1",
+                                    "token": ott})
+        # an OTT minted for one VM cannot bootstrap another — and the
+        # probe must not burn it
+        other = c.allocator.mint_bootstrap_token("vm-other")
+        with pytest.raises(AuthError, match="not vm"):
+            c.allocator.redeem_bootstrap_token(vm.id, other)
+        assert c.iam.redeem_ott(other) == "vm/vm-other"   # still redeemable
     finally:
         raw.close()
         client.close()

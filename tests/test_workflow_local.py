@@ -383,3 +383,48 @@ class TestWhiteboards:
         with lzy.workflow("wf") as wf:
             with pytest.raises(TypeError, match="not a whiteboard type"):
                 wf.create_whiteboard(Plain)
+
+
+class TestMainModuleOpPickling:
+    """``__main__`` ops pickle as reference + embedded copy: the same
+    interpreter resolves the live object (shared state), another process
+    falls back to the shipped clone (its __main__ is a different module)."""
+
+    def _main_op(self):
+        import sys
+
+        from lzy_tpu.core.op import op as op_decorator
+
+        @op_decorator
+        def main_op(x: int) -> int:
+            return x + 5
+
+        main_op.__module__ = "__main__"
+        main_op.__qualname__ = "main_op"
+        main_op.func.__module__ = "__main__"
+        main_op.func.__qualname__ = "main_op"
+        setattr(sys.modules["__main__"], "main_op", main_op)
+        return main_op
+
+    def test_same_interpreter_resolves_live_object(self):
+        import pickle
+        import sys
+
+        main_op = self._main_op()
+        try:
+            clone = pickle.loads(pickle.dumps(main_op))
+            assert clone is main_op
+        finally:
+            delattr(sys.modules["__main__"], "main_op")
+
+    def test_foreign_interpreter_gets_by_value_copy(self):
+        import pickle
+        import sys
+
+        main_op = self._main_op()
+        data = pickle.dumps(main_op)
+        # simulate the worker binary: its __main__ lacks the attribute
+        delattr(sys.modules["__main__"], "main_op")
+        clone = pickle.loads(data)
+        assert clone is not main_op
+        assert clone(3) == 8           # runs outside a workflow
