@@ -177,11 +177,18 @@ class TokenFile:
 
     def lm_source(self, *, batch_size: int, seq_len: int,
                   stride: Optional[int] = None, n_threads: int = 4,
+                  eos_id: Optional[int] = None,
                   **kwargs) -> ResumableSource:
         """ResumableSource of ``{"tokens": (batch, seq_len) int32}`` LM
         batches over non-overlapping (or ``stride``-strided) windows;
         shuffling/sharding/resume come from ResumableSource — state saved
-        with a checkpoint resumes at the exact next window."""
+        with a checkpoint resumes at the exact next window.
+
+        ``eos_id``: document delimiter in the packed stream. When given,
+        batches also carry ``"segments"`` — non-decreasing per-window
+        document ids (the EOS token closes its document) that the models
+        route into segment-masked attention, per-document positions, and
+        boundary-masked loss."""
         stride = stride or seq_len
         if stride <= 0:
             raise ValueError("stride must be positive")
@@ -192,8 +199,14 @@ class TokenFile:
             )
 
         def batch_of(indices: np.ndarray) -> Dict[str, np.ndarray]:
-            return {"tokens": self.gather(indices * stride, seq_len,
-                                          n_threads=n_threads)}
+            tokens = self.gather(indices * stride, seq_len,
+                                 n_threads=n_threads)
+            batch = {"tokens": tokens}
+            if eos_id is not None:
+                segments = np.zeros_like(tokens)
+                segments[:, 1:] = np.cumsum(tokens[:, :-1] == eos_id, axis=1)
+                batch["segments"] = segments
+            return batch
 
         return ResumableSource(n_windows, batch_of,
                                batch_size=batch_size, **kwargs)

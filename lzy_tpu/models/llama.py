@@ -120,7 +120,7 @@ class Attention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, mesh=None):
+    def __call__(self, x, positions, mesh=None, segments=None):
         cfg = self.cfg
         dense = lambda features, name, axes: nn.DenseGeneral(  # noqa: E731
             features=features, axis=-1, use_bias=False, name=name,
@@ -152,6 +152,11 @@ class Attention(nn.Module):
         if cfg.use_ring_attention and mesh is not None:
             from lzy_tpu.parallel.ring import ring_attention
 
+            if segments is not None:
+                raise NotImplementedError(
+                    "packed segments are not supported under ring "
+                    "sequence parallelism yet"
+                )
             out = ring_attention(q, k, v, mesh=mesh, causal=True)
         elif cfg.use_ulysses_attention and mesh is not None:
             # all-to-all SP: reshard seq→heads so each device sees the FULL
@@ -159,19 +164,26 @@ class Attention(nn.Module):
             # ring's ppermute latency dominates)
             from lzy_tpu.parallel.ulysses import ulysses_attention
 
+            if segments is not None:
+                raise NotImplementedError(
+                    "packed segments are not supported under Ulysses "
+                    "sequence parallelism yet"
+                )
             out = ulysses_attention(q, k, v, mesh=mesh, causal=True)
         elif cfg.use_flash_kernel and t % 128 == 0:
             # lane-aligned sequences take the Pallas kernel; tiny traces
             # (init, smoke shapes) fall through to the dense path
             from lzy_tpu.ops.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=True)
+            out = flash_attention(q, k, v, causal=True,
+                                  segment_ids=segments)
         else:
             # portable fallback: chunked online-softmax attention — O(T·block)
             # activations, never the T×T score matrix (lzy_tpu/ops/attention)
             from lzy_tpu.ops.attention import chunked_attention
 
-            out = chunked_attention(q, k, v, causal=True)
+            out = chunked_attention(q, k, v, causal=True,
+                                    segment_ids=segments)
 
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * d)
         return self._o_proj(out)
@@ -260,11 +272,11 @@ class DecoderLayer(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, mesh=None):
+    def __call__(self, x, positions, mesh=None, segments=None):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attn_norm")(x),
-            positions, mesh,
+            positions, mesh, segments,
         )
         h = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="mlp_norm")(x)
         if cfg.n_experts > 0:
@@ -284,7 +296,7 @@ class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, mesh=None):
+    def __call__(self, tokens, mesh=None, segments=None):
         cfg = self.cfg
         emb = self.param(
             "embed_tokens",
@@ -294,9 +306,17 @@ class Llama(nn.Module):
             (cfg.vocab_size, cfg.d_model), cfg.param_dtype,
         )
         x = emb.astype(cfg.dtype)[tokens]
-        positions = jnp.broadcast_to(
-            jnp.arange(tokens.shape[1]), tokens.shape
-        )
+        if segments is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1]), tokens.shape
+            )
+        else:
+            # packed documents: RoPE positions restart at every document so
+            # each one sees the same positional geometry it would unpacked
+            from lzy_tpu.ops.flash_attention import document_starts
+
+            idx = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+            positions = idx[None, :] - document_starts(segments)
         layer = DecoderLayer
         if cfg.remat:
             layer = nn.remat(
@@ -304,7 +324,7 @@ class Llama(nn.Module):
                 policy=jax.checkpoint_policies.nothing_saveable,
             )
         for i in range(cfg.n_layers):
-            x = layer(cfg, name=f"layer_{i}")(x, positions, mesh)
+            x = layer(cfg, name=f"layer_{i}")(x, positions, mesh, segments)
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="final_norm")(x)
         if cfg.tie_embeddings:
             head = emb
@@ -346,19 +366,27 @@ def make_loss_fn(cfg: LlamaConfig, mesh=None):
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]
+        segments = batch.get("segments")
         if cfg.n_experts > 0:
             logits, sown = model.apply(
-                {"params": params}, tokens, mesh, mutable=["losses"]
+                {"params": params}, tokens, mesh, segments,
+                mutable=["losses"],
             )
             aux = sum(
                 jax.tree_util.tree_leaves(sown.get("losses", {})),
                 jnp.zeros((), jnp.float32),
             )
         else:
-            logits = model.apply({"params": params}, tokens, mesh)
+            logits = model.apply({"params": params}, tokens, mesh, segments)
             aux = 0.0
         mask = batch.get("mask")
         shifted_mask = mask[:, 1:] if mask is not None else None
+        if segments is not None:
+            # a position whose next token belongs to a different document
+            # must not be asked to predict it
+            same_doc = segments[:, 1:] == segments[:, :-1]
+            shifted_mask = same_doc if shifted_mask is None \
+                else jnp.logical_and(shifted_mask, same_doc)
         if cfg.fused_ce:
             features, head = logits
             from lzy_tpu.ops.chunked_ce import chunked_cross_entropy

@@ -37,10 +37,14 @@ def chunked_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     block_size: int = 512,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """q/k/v: [B, H, T, D] → [B, H, T, D]. Keys/values are processed in
     blocks with the flash merge recurrence; ``block_size`` is clamped to the
-    largest divisor of T."""
+    largest divisor of T.
+
+    ``segment_ids``: optional [B, T] ints — attention is confined to equal
+    ids (packed documents never see each other)."""
     b, h, t, d = q.shape
     scale = scale if scale is not None else d ** -0.5
     block = auto_block(t, block_size)
@@ -50,23 +54,43 @@ def chunked_attention(
     k_blocks = k.reshape(b, h, n_blocks, block, d)
     v_blocks = v.reshape(b, h, n_blocks, block, d)
     q_pos = lax.broadcasted_iota(jnp.int32, (t, block), 0)
+    seg_q = None
+    seg_blocks = None
+    if segment_ids is not None:
+        if segment_ids.shape != (b, t):
+            raise ValueError(
+                f"segment_ids shape {segment_ids.shape} != {(b, t)}"
+            )
+        seg_q = segment_ids.reshape(b, 1, t, 1)
+        seg_blocks = jnp.moveaxis(
+            segment_ids.reshape(b, n_blocks, block), 1, 0
+        )
 
     def body(carry, inputs):
         o, m, l = carry
-        blk_idx, k_blk, v_blk = inputs
+        if seg_blocks is not None:
+            blk_idx, k_blk, v_blk, seg_blk = inputs
+        else:
+            (blk_idx, k_blk, v_blk), seg_blk = inputs, None
         s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32))
+        keep = None
         if causal:
             k_pos = blk_idx * block + lax.broadcasted_iota(
                 jnp.int32, (t, block), 1
             )
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            keep = (q_pos >= k_pos)[None, None]
+        if seg_blk is not None:
+            same = seg_q == seg_blk[:, None, None, :]
+            keep = same if keep is None else jnp.logical_and(keep, same)
+        if keep is not None:
+            s = jnp.where(keep, s, _NEG_INF)
         m_blk = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_blk)
         # fully-masked rows keep m at -inf; shift by 0 there to avoid NaN
         m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
         p = jnp.exp(s - m_safe[..., None])
-        if causal:
-            p = jnp.where(q_pos[None, None] >= k_pos[None, None], p, 0.0)
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
         alpha = jnp.exp(jnp.where(m <= _NEG_INF / 2, _NEG_INF, m) - m_safe)
         alpha = jnp.where(m <= _NEG_INF / 2, 0.0, alpha)
         l_new = l * alpha + jnp.sum(p, axis=-1)
@@ -79,9 +103,8 @@ def chunked_attention(
     m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, t), jnp.float32)
     idxs = jnp.arange(n_blocks)
-    (o, m, l), _ = lax.scan(
-        jax.checkpoint(body),
-        (o0, m0, l0),
-        (idxs, jnp.moveaxis(k_blocks, 2, 0), jnp.moveaxis(v_blocks, 2, 0)),
-    )
+    xs = (idxs, jnp.moveaxis(k_blocks, 2, 0), jnp.moveaxis(v_blocks, 2, 0))
+    if seg_blocks is not None:
+        xs = xs + (seg_blocks,)
+    (o, m, l), _ = lax.scan(jax.checkpoint(body), (o0, m0, l0), xs)
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)

@@ -10,6 +10,12 @@ mapped to the TPU memory hierarchy (``/opt/skills/guides/pallas_guide.md``):
   inputs stay bfloat16;
 - causal programs stop their KV loop at the diagonal (no wasted FLOPs on
   masked blocks);
+- packed documents (``segment_ids``) confine attention to equal ids AND
+  tighten the KV loop to the blocks the query block's documents span —
+  data-dependent ``fori_loop`` bounds read from a precomputed per-position
+  (id, doc start, doc end) slab, so cross-document blocks cost nothing
+  (for fully packed batches the FLOPs drop from O(T²/2) toward
+  O(sum_doc len²/2));
 - backward is two Pallas kernels (dK/dV over KV blocks, dQ over Q blocks)
   using the saved per-row logsumexp, wrapped in ``jax.custom_vjp``.
 
@@ -17,6 +23,8 @@ TPU tiling note: auxiliary row vectors (logsumexp, delta) cannot use
 ``(1, block)`` blocks — the last two block dims must be (8k, 128k) or
 full-dim. Both directions therefore carry lse/delta broadcast across the head
 dim (the same layout jax's reference TPU flash kernel uses for l/m residuals).
+The segment slab likewise rides a 128-lane dim: lane 0 = segment id,
+lane 1 = document start, lane 2 = document end (exclusive).
 
 Off-TPU (tests, virtual CPU meshes) the same kernels run in interpreter mode.
 """
@@ -53,14 +61,25 @@ def _pick_block(t: int, requested: int) -> int:
     return _LANE  # t is a multiple of 128 (checked by caller)
 
 
+def _split_in_refs(refs, masked, segmented, n_out):
+    """(base_inputs, bias_ref, seg_ref, outputs) for a kernel's ref list —
+    optional operands appear in bias, seg order."""
+    refs = list(refs)
+    ins, outs = refs[:len(refs) - n_out], refs[len(refs) - n_out:]
+    n_base = len(ins) - int(masked) - int(segmented)
+    base = ins[:n_base]
+    bias_ref = ins[n_base] if masked else None
+    seg_ref = ins[n_base + int(masked)] if segmented else None
+    return base, bias_ref, seg_ref, outs
+
+
 # -- forward --------------------------------------------------------------------
 
 
-def _fwd_kernel(*refs, scale, causal, masked, block_q, block_kv, seq_len):
-    if masked:
-        q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref = refs
-    else:
-        (q_ref, k_ref, v_ref, o_ref, lse_ref), bias_ref = refs, None
+def _fwd_kernel(*refs, scale, causal, masked, segmented, block_q, block_kv,
+                seq_len):
+    (q_ref, k_ref, v_ref), bias_ref, seg_ref, (o_ref, lse_ref) = \
+        _split_in_refs(refs, masked, segmented, 2)
     iq = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale            # [bq, d]
     q_start = iq * block_q
@@ -68,6 +87,20 @@ def _fwd_kernel(*refs, scale, causal, masked, block_q, block_kv, seq_len):
     hi = jnp.minimum(
         lax.div(q_start + block_q + block_kv - 1, block_kv), n_kv
     ) if causal else n_kv
+    lo = 0
+    seg_q = None
+    if seg_ref is not None:
+        seg_rows = seg_ref[0, pl.ds(q_start, block_q), :]   # [bq, LANE]
+        seg_q = seg_rows[:, 0]
+        # ids are non-decreasing (packed layout): the block's documents span
+        # [start of first row's doc, end of last row's doc) — KV blocks
+        # outside that range are entirely cross-document, skip them
+        lo = lax.div(seg_rows[0, 1].astype(jnp.int32), block_kv)
+        seg_hi = lax.div(
+            seg_rows[block_q - 1, 2].astype(jnp.int32) + block_kv - 1,
+            block_kv,
+        )
+        hi = jnp.minimum(hi, seg_hi)
 
     d = q.shape[-1]
     acc0 = jnp.zeros((block_q, d), jnp.float32)
@@ -86,16 +119,23 @@ def _fwd_kernel(*refs, scale, causal, masked, block_q, block_kv, seq_len):
             # additive KV bias (0 keep / -inf drop), one lane per position
             b_col = bias_ref[0, pl.ds(j * block_kv, block_kv), 0]
             s = s + b_col[None, :]
+        keep = None
         if causal:
             rows = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = j * block_kv + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            keep = rows >= cols
+        if seg_q is not None:
+            seg_kv = seg_ref[0, pl.ds(j * block_kv, block_kv), 0]
+            same = seg_q[:, None] == seg_kv[None, :]
+            keep = same if keep is None else jnp.logical_and(keep, same)
+        if keep is not None:
+            s = jnp.where(keep, s, _NEG_INF)
         m_blk = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_blk)
         m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
         p = jnp.exp(s - m_safe[:, None])
-        if causal:
-            p = jnp.where(rows >= cols, p, 0.0)
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
         alpha = jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(m - m_safe))
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
@@ -104,19 +144,20 @@ def _fwd_kernel(*refs, scale, causal, masked, block_q, block_kv, seq_len):
         )
         return acc_new, m_new, l_new
 
-    acc, m, l = lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    acc, m, l = lax.fori_loop(lo, hi, body, (acc0, m0, l0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
     lse = jnp.where(l > 0.0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG_INF)
     lse_ref[0] = jnp.broadcast_to(lse[:, None], (block_q, d))
 
 
-def _fwd(q, k, v, bias, *, scale, causal, block_q, block_kv, interpret,
+def _fwd(q, k, v, bias, seg, *, scale, causal, block_q, block_kv, interpret,
          n_heads):
     bh, t, d = q.shape
     n_q = t // block_q
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, masked=bias is not None,
-        block_q=block_q, block_kv=block_kv, seq_len=t,
+        segmented=seg is not None, block_q=block_q, block_kv=block_kv,
+        seq_len=t,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -124,11 +165,15 @@ def _fwd(q, k, v, bias, *, scale, causal, block_q, block_kv, interpret,
         pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
     ]
     operands = [q, k, v]
+    # bias/seg are per-BATCH [b, t, LANE]; grid dim 0 walks batch·heads
     if bias is not None:
-        # bias is per-BATCH [b, t, LANE]; grid dim 0 walks batch·heads
         in_specs.append(pl.BlockSpec(
             (1, t, _LANE), lambda b, i: (b // n_heads, 0, 0)))
         operands.append(bias)
+    if seg is not None:
+        in_specs.append(pl.BlockSpec(
+            (1, t, _LANE), lambda b, i: (b // n_heads, 0, 0)))
+        operands.append(seg)
     o, lse_bcast = pl.pallas_call(
         kernel,
         grid=(bh, n_q),
@@ -149,13 +194,10 @@ def _fwd(q, k, v, bias, *, scale, causal, block_q, block_kv, interpret,
 # -- backward -------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(*refs, scale, causal, masked, block_q, block_kv, seq_len):
-    if masked:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
-         dq_ref) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref) = refs
-        bias_ref = None
+def _bwd_dq_kernel(*refs, scale, causal, masked, segmented, block_q,
+                   block_kv, seq_len):
+    ((q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), bias_ref, seg_ref,
+     (dq_ref,)) = _split_in_refs(refs, masked, segmented, 1)
     iq = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
@@ -168,6 +210,16 @@ def _bwd_dq_kernel(*refs, scale, causal, masked, block_q, block_kv, seq_len):
     hi = jnp.minimum(
         lax.div(q_start + block_q + block_kv - 1, block_kv), n_kv
     ) if causal else n_kv
+    lo = 0
+    seg_q = None
+    if seg_ref is not None:
+        seg_rows = seg_ref[0, pl.ds(q_start, block_q), :]
+        seg_q = seg_rows[:, 0]
+        lo = lax.div(seg_rows[0, 1].astype(jnp.int32), block_kv)
+        hi = jnp.minimum(hi, lax.div(
+            seg_rows[block_q - 1, 2].astype(jnp.int32) + block_kv - 1,
+            block_kv,
+        ))
 
     def body(j, dq):
         k_blk = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
@@ -188,6 +240,9 @@ def _bwd_dq_kernel(*refs, scale, causal, masked, block_q, block_kv, seq_len):
         p = jnp.where(lse > _NEG_INF / 2, p, 0.0)
         if causal:
             p = jnp.where(rows >= cols, p, 0.0)
+        if seg_q is not None:
+            seg_kv = seg_ref[0, pl.ds(j * block_kv, block_kv), 0]
+            p = jnp.where(seg_q[:, None] == seg_kv[None, :], p, 0.0)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -198,25 +253,35 @@ def _bwd_dq_kernel(*refs, scale, causal, masked, block_q, block_kv, seq_len):
             preferred_element_type=jnp.float32,
         )
 
-    dq = lax.fori_loop(0, hi, body, jnp.zeros_like(q))
+    dq = lax.fori_loop(lo, hi, body, jnp.zeros_like(q))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, scale, causal, masked, block_q, block_kv,
-                    seq_len):
-    if masked:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
-         dk_ref, dv_ref) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-         dv_ref) = refs
-        bias_ref = None
+def _bwd_dkv_kernel(*refs, scale, causal, masked, segmented, block_q,
+                    block_kv, seq_len):
+    ((q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref), bias_ref, seg_ref,
+     (dk_ref, dv_ref)) = _split_in_refs(refs, masked, segmented, 2)
     jkv = pl.program_id(1)
     k_blk = k_ref[0].astype(jnp.float32)                  # [bkv, d]
     v_blk = v_ref[0].astype(jnp.float32)
     kv_start = jkv * block_kv
     n_q = seq_len // block_q
     lo = lax.div(kv_start, block_q) if causal else 0
+    hi = n_q
+    seg_kv = None
+    if seg_ref is not None:
+        seg_rows = seg_ref[0, pl.ds(kv_start, block_kv), :]
+        seg_kv = seg_rows[:, 0]
+        # mirror of the forward skip: only q rows inside this KV block's
+        # documents can reach it
+        if not causal:
+            lo = jnp.maximum(
+                lo, lax.div(seg_rows[0, 1].astype(jnp.int32), block_q)
+            )
+        hi = jnp.minimum(hi, lax.div(
+            seg_rows[block_kv - 1, 2].astype(jnp.int32) + block_q - 1,
+            block_q,
+        ))
 
     d = k_blk.shape[-1]
 
@@ -241,6 +306,9 @@ def _bwd_dkv_kernel(*refs, scale, causal, masked, block_q, block_kv,
         p = jnp.where(lse_blk > _NEG_INF / 2, p, 0.0)
         if causal:
             p = jnp.where(rows >= cols, p, 0.0)
+        if seg_kv is not None:
+            seg_q = seg_ref[0, pl.ds(q_start, block_q), 0]
+            p = jnp.where(seg_q[:, None] == seg_kv[None, :], p, 0.0)
         dv_new = dv + jax.lax.dot_general(
             p, do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -258,12 +326,12 @@ def _bwd_dkv_kernel(*refs, scale, causal, masked, block_q, block_kv,
 
     dk0 = jnp.zeros((block_kv, d), jnp.float32)
     dv0 = jnp.zeros((block_kv, d), jnp.float32)
-    dk, dv = lax.fori_loop(lo, n_q, body, (dk0, dv0))
+    dk, dv = lax.fori_loop(lo, hi, body, (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, bias, o, lse, do, *, scale, causal, block_q, block_kv,
+def _bwd(q, k, v, bias, seg, o, lse, do, *, scale, causal, block_q, block_kv,
          interpret, n_heads):
     bh, t, d = q.shape
     delta = jnp.sum(
@@ -274,6 +342,7 @@ def _bwd(q, k, v, bias, o, lse, do, *, scale, causal, block_q, block_kv,
     lse_t = jnp.broadcast_to(lse[:, :, None], (bh, t, d))
     delta_t = jnp.broadcast_to(delta[:, :, None], (bh, t, d))
     masked = bias is not None
+    segmented = seg is not None
 
     dq_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # q
@@ -288,10 +357,15 @@ def _bwd(q, k, v, bias, o, lse, do, *, scale, causal, block_q, block_kv,
         dq_specs.append(pl.BlockSpec(
             (1, t, _LANE), lambda b, i: (b // n_heads, 0, 0)))
         dq_operands.append(bias)
+    if segmented:
+        dq_specs.append(pl.BlockSpec(
+            (1, t, _LANE), lambda b, i: (b // n_heads, 0, 0)))
+        dq_operands.append(seg)
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, masked=masked,
-            block_q=block_q, block_kv=block_kv, seq_len=t,
+            segmented=segmented, block_q=block_q, block_kv=block_kv,
+            seq_len=t,
         ),
         grid=(bh, t // block_q),
         in_specs=dq_specs,
@@ -313,10 +387,17 @@ def _bwd(q, k, v, bias, o, lse, do, *, scale, causal, block_q, block_kv,
         dkv_specs.append(pl.BlockSpec(
             (1, block_kv, _LANE), lambda b, j: (b // n_heads, j, 0)))
         dkv_operands.append(bias)
+    if segmented:
+        # the dKV kernel needs BOTH its own KV rows and arbitrary q rows of
+        # the slab: pass it full-length
+        dkv_specs.append(pl.BlockSpec(
+            (1, t, _LANE), lambda b, j: (b // n_heads, 0, 0)))
+        dkv_operands.append(seg)
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, masked=masked,
-            block_q=block_q, block_kv=block_kv, seq_len=t,
+            segmented=segmented, block_q=block_q, block_kv=block_kv,
+            seq_len=t,
         ),
         grid=(bh, t // block_kv),
         in_specs=dkv_specs,
@@ -337,34 +418,69 @@ def _bwd(q, k, v, bias, o, lse, do, *, scale, causal, block_q, block_kv,
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10)
 )
-def _flash(q, k, v, bias, scale, causal, block_q, block_kv, interpret,
+def _flash(q, k, v, bias, seg, scale, causal, block_q, block_kv, interpret,
            n_heads):
-    o, _ = _fwd(q, k, v, bias, scale=scale, causal=causal, block_q=block_q,
-                block_kv=block_kv, interpret=interpret, n_heads=n_heads)
+    o, _ = _fwd(q, k, v, bias, seg, scale=scale, causal=causal,
+                block_q=block_q, block_kv=block_kv, interpret=interpret,
+                n_heads=n_heads)
     return o
 
 
-def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_kv, interpret,
-               n_heads):
-    o, lse = _fwd(q, k, v, bias, scale=scale, causal=causal, block_q=block_q,
-                  block_kv=block_kv, interpret=interpret, n_heads=n_heads)
-    return o, (q, k, v, bias, o, lse)
+def _flash_fwd(q, k, v, bias, seg, scale, causal, block_q, block_kv,
+               interpret, n_heads):
+    o, lse = _fwd(q, k, v, bias, seg, scale=scale, causal=causal,
+                  block_q=block_q, block_kv=block_kv, interpret=interpret,
+                  n_heads=n_heads)
+    return o, (q, k, v, bias, seg, o, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_kv, interpret, n_heads, res,
                do):
-    q, k, v, bias, o, lse = res
-    dq, dk, dv = _bwd(q, k, v, bias, o, lse, do, scale=scale, causal=causal,
-                      block_q=block_q, block_kv=block_kv,
+    q, k, v, bias, seg, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, bias, seg, o, lse, do, scale=scale,
+                      causal=causal, block_q=block_q, block_kv=block_kv,
                       interpret=interpret, n_heads=n_heads)
-    # bias encodes a boolean mask; its cotangent is structurally zero
+    # bias/seg encode boolean structure; their cotangents are structurally 0
     dbias = None if bias is None else jnp.zeros_like(bias)
-    return dq, dk, dv, dbias
+    dseg = None if seg is None else jnp.zeros_like(seg)
+    return dq, dk, dv, dbias, dseg
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def document_starts(segment_ids: jax.Array) -> jax.Array:
+    """[B, T] non-decreasing document ids → [B, T] int32 start index of each
+    position's document (cummax over change points). Shared by the kernel
+    slab below and per-document RoPE positions in the models."""
+    b, t = segment_ids.shape
+    seg = segment_ids.astype(jnp.int32)
+    idx = jnp.arange(t, dtype=jnp.int32)
+    first = jnp.concatenate(
+        [jnp.ones((b, 1), bool), seg[:, 1:] != seg[:, :-1]], axis=1
+    )
+    return lax.cummax(jnp.where(first, idx[None, :], 0), axis=1)
+
+
+def segment_slab(segment_ids: jax.Array, lane: int = _LANE) -> jax.Array:
+    """[B, T] non-decreasing document ids → the [B, T, lane] float32 slab the
+    kernels read: lane 0 = id, lane 1 = document start, lane 2 = document end
+    (exclusive). Positions of the SAME document share start/end, which is
+    what turns the mask into loop bounds."""
+    b, t = segment_ids.shape
+    seg = segment_ids.astype(jnp.int32)
+    idx = jnp.arange(t, dtype=jnp.int32)
+    start = document_starts(seg)
+    last = jnp.concatenate(
+        [seg[:, 1:] != seg[:, :-1], jnp.ones((b, 1), bool)], axis=1
+    )
+    end = lax.cummin(
+        jnp.where(last, idx[None, :] + 1, t)[:, ::-1], axis=1
+    )[:, ::-1]
+    aux = jnp.stack([seg, start, end], axis=-1).astype(jnp.float32)
+    return jnp.pad(aux, ((0, 0), (0, 0), (0, lane - 3)))
 
 
 def flash_attention(
@@ -374,6 +490,7 @@ def flash_attention(
     *,
     causal: bool = True,
     kv_mask: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     block_q: int = 512,
     block_kv: int = 512,
@@ -386,6 +503,12 @@ def flash_attention(
     (padding masks for encoder models). Carried into the kernels as an
     additive 0/-inf bias, one 128-lane slab per batch row; fully-masked
     query rows produce zero output and zero gradients.
+
+    ``segment_ids``: optional [B, T] ints, non-decreasing along T (the packed
+    layout the token loader emits) — attention is confined to equal ids, and
+    the KV loops skip blocks entirely outside the query block's documents,
+    so packing N short documents costs ~the sum of their individual
+    attention FLOPs, not the full T² triangle.
     """
     b, h, t, d = q.shape
     scale = scale if scale is not None else d ** -0.5
@@ -404,7 +527,15 @@ def flash_attention(
         bias = jnp.where(kv_mask, 0.0, _NEG_INF).astype(jnp.float32)
         bias = jnp.broadcast_to(bias[:, :, None], (b, t, _LANE))
 
+    seg = None
+    if segment_ids is not None:
+        if segment_ids.shape != (b, t):
+            raise ValueError(
+                f"segment_ids shape {segment_ids.shape} != {(b, t)}"
+            )
+        seg = segment_slab(segment_ids)
+
     flat = lambda x: x.reshape(b * h, t, d)  # noqa: E731
-    o = _flash(flat(q), flat(k), flat(v), bias, scale, causal, block_q,
+    o = _flash(flat(q), flat(k), flat(v), bias, seg, scale, causal, block_q,
                block_kv, interpret, h)
     return o.reshape(b, h, t, d)

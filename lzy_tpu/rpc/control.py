@@ -122,19 +122,23 @@ class ControlPlaneServer:
             return {"completed": ch.completed, "failed": ch.failed,
                     "slot_peer": peer, "storage_uri": ch.storage_uri}
 
+        def h_exchange_ott(p):
+            # OTT bootstrap, step 1 of 2: burn the launch credential for the
+            # durable WORKER token. Deliberately does NOT register the
+            # endpoint — the VM only becomes callable (step 2, RegisterVm,
+            # authenticated with the durable token) once the worker already
+            # HOLDS that token, so the control plane can never dial back
+            # with a credential the worker doesn't yet accept.
+            if iam is None:
+                from lzy_tpu.iam import AuthError
+
+                raise AuthError("no IAM on this plane; nothing to exchange")
+            return {"token": allocator.redeem_bootstrap_token(
+                p["vm_id"], p.get("token"))}
+
         def h_register_vm(p):
             vm_id = p["vm_id"]
-            durable = None
-            if iam is not None and iam.is_ott(p.get("token")):
-                # first boot: the launch env carries a one-time credential;
-                # burn it and swap in the durable WORKER token (reference OTT
-                # bootstrap). Re-registrations present the durable token and
-                # take the ordinary worker_auth path.
-                durable = allocator.redeem_bootstrap_token(
-                    vm_id, p["token"]
-                )
-            else:
-                worker_auth(p, vm_id=vm_id)
+            worker_auth(p, vm_id=vm_id)
             allocator.vm(vm_id)  # KeyError → NOT_FOUND for unknown VMs
             allocator.register_vm(
                 vm_id,
@@ -146,7 +150,7 @@ class ControlPlaneServer:
                     token=lambda: allocator.vm(vm_id).worker_token,
                 ),
             )
-            return {"token": durable} if durable else {}
+            return {}
 
         def h_heartbeat(p):
             worker_auth(p, vm_id=p["vm_id"])
@@ -230,6 +234,7 @@ class ControlPlaneServer:
                 p["entry_id"], SlotPeer(**p["peer"]))),
             "WaitChannel": h_wait_channel,
             # allocator private (worker-only surface, VM-scoped)
+            "ExchangeOtt": h_exchange_ott,
             "RegisterVm": h_register_vm,
             "Heartbeat": h_heartbeat,
             # status surface (CLI --address / console over RPC)
@@ -330,14 +335,21 @@ class RpcAllocatorClient:
         self._token = token                # str or shared WorkerToken holder
 
     def register_vm(self, vm_id: str, agent: Any) -> None:
-        # the live agent object cannot travel; its gRPC endpoint does
-        resp = self._client.call(
-            "RegisterVm", {"vm_id": vm_id, "endpoint": self._endpoint,
-                           "token": _token_value(self._token)})
-        if resp and resp.get("token") and isinstance(self._token, WorkerToken):
-            # OTT bootstrap: the launch env credential was one-time; the
-            # register response carries the durable WORKER token
+        token = _token_value(self._token)
+        if token and token.startswith("ott/") \
+                and isinstance(self._token, WorkerToken):
+            # OTT bootstrap: exchange the one-time launch credential for the
+            # durable WORKER token BEFORE registering — registration makes
+            # this VM callable, and the control plane dials back with the
+            # durable token, which we must already accept by then
+            resp = self._client.call(
+                "ExchangeOtt", {"vm_id": vm_id, "token": token})
             self._token.rotate(resp["token"])
+            token = self._token.current
+        # the live agent object cannot travel; its gRPC endpoint does
+        self._client.call(
+            "RegisterVm", {"vm_id": vm_id, "endpoint": self._endpoint,
+                           "token": token})
 
     def heartbeat(self, vm_id: str) -> None:
         try:

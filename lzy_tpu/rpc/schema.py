@@ -220,6 +220,8 @@ REQUESTS: Dict[str, Schema] = {
     "WaitChannel": Schema("WaitChannelRequest", {
         "entry_id": f(str, required=True),
         "timeout_s": f(float, int), **_TOKEN}),
+    "ExchangeOtt": Schema("ExchangeOttRequest", {
+        "vm_id": f(str, required=True), **_TOKEN}),
     "RegisterVm": Schema("RegisterVmRequest", {
         "vm_id": f(str, required=True),
         "endpoint": f(str, required=True), **_TOKEN}),

@@ -471,3 +471,101 @@ class TestSampling:
         tok, _ = sample_token(self._logits(), 1.0, jax.random.PRNGKey(0),
                               top_k=0)
         assert 0 <= int(tok[0]) < 5
+
+
+class TestPackedDocuments:
+    """Segment-masked attention + per-document positions: a packed row must
+    behave exactly like its documents run separately."""
+
+    def test_packed_forward_equals_per_document(self):
+        cfg = dataclasses.replace(
+            LlamaConfig.tiny(vocab_size=128),
+            dtype=jnp.float32, param_dtype=jnp.float32,
+        )
+        boxed, _ = llama.init_params(cfg, jax.random.PRNGKey(0))
+        params = unbox(boxed)
+        model = llama.Llama(cfg)
+
+        rng = np.random.default_rng(0)
+        doc_a = rng.integers(0, 128, 24)
+        doc_b = rng.integers(0, 128, 40)
+        packed = jnp.asarray(np.concatenate([doc_a, doc_b]))[None, :]
+        segments = jnp.asarray(
+            np.concatenate([np.zeros(24, np.int32), np.ones(40, np.int32)])
+        )[None, :]
+
+        packed_logits = model.apply({"params": params}, packed, None,
+                                    segments)
+        la = model.apply({"params": params}, jnp.asarray(doc_a)[None, :])
+        lb = model.apply({"params": params}, jnp.asarray(doc_b)[None, :])
+        np.testing.assert_allclose(
+            np.asarray(packed_logits[0, :24]), np.asarray(la[0]),
+            atol=2e-4, rtol=2e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(packed_logits[0, 24:]), np.asarray(lb[0]),
+            atol=2e-4, rtol=2e-4,
+        )
+
+    def test_flash_path_matches_fallback_packed(self):
+        cfg = dataclasses.replace(
+            LlamaConfig.tiny(vocab_size=128),
+            dtype=jnp.float32, param_dtype=jnp.float32,
+        )
+        boxed, _ = llama.init_params(cfg, jax.random.PRNGKey(0))
+        params = unbox(boxed)
+        tokens = jnp.asarray(
+            np.random.default_rng(1).integers(0, 128, (2, 256))
+        )
+        segments = jnp.asarray(
+            np.repeat(np.arange(4), 64)[None, :].repeat(2, 0)
+        )
+        base = llama.Llama(cfg).apply({"params": params}, tokens, None,
+                                      segments)
+        flash_cfg = dataclasses.replace(cfg, use_flash_kernel=True)
+        flashed = llama.Llama(flash_cfg).apply({"params": params}, tokens,
+                                               None, segments)
+        np.testing.assert_allclose(np.asarray(flashed), np.asarray(base),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_loss_masks_document_boundaries(self):
+        cfg = dataclasses.replace(LlamaConfig.tiny(vocab_size=64),
+                                  dtype=jnp.float32,
+                                  param_dtype=jnp.float32)
+        boxed, _ = llama.init_params(cfg, jax.random.PRNGKey(0))
+        params = unbox(boxed)
+        loss_fn = llama.make_loss_fn(cfg)
+        tokens = jnp.asarray(
+            np.random.default_rng(2).integers(0, 64, (2, 32))
+        )
+        segments = jnp.zeros((2, 32), jnp.int32).at[:, 16:].set(1)
+        # boundary-masked packed loss == mean of the two per-document losses
+        # over the same model (manual check: identical token count per doc)
+        packed = float(loss_fn(params, {"tokens": tokens,
+                                        "segments": segments}))
+        explicit_mask = np.ones((2, 32), bool)
+        # shifted mask index 15 = full index 16: target token 16 is the
+        # first of document 1, predicted from document 0 — the boundary
+        explicit_mask[:, 16] = False
+        manual = float(loss_fn(params, {
+            "tokens": tokens, "segments": segments,
+            "mask": jnp.asarray(explicit_mask),
+        }))
+        assert abs(packed - manual) < 1e-6
+
+    def test_train_step_with_segments_decreases_loss(self):
+        cfg = dataclasses.replace(LlamaConfig.tiny(vocab_size=64))
+        boxed, axes = llama.init_params(cfg, jax.random.PRNGKey(0))
+        params = unbox(boxed)
+        tokens = jnp.asarray(
+            np.random.default_rng(3).integers(0, 64, (8, 64))
+        )
+        segments = jnp.asarray(
+            np.repeat(np.arange(2), 32)[None, :].repeat(8, 0)
+        )
+        mesh = fsdp_mesh()
+        losses, _ = _train(
+            llama.make_loss_fn(cfg, mesh), params, axes,
+            {"tokens": tokens, "segments": segments}, mesh, steps=4,
+        )
+        assert losses[-1] < losses[0]

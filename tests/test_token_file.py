@@ -85,6 +85,22 @@ def test_lm_source_covers_file_and_resumes(token_path):
         np.testing.assert_array_equal(got["tokens"], expected["tokens"])
 
 
+def test_lm_source_emits_packed_segments(tmp_path):
+    eos = 99
+    docs = [3, 4, eos, 7, eos, 1, 2, 3, 4, eos, 5, 6]
+    write_token_file(tmp_path / "docs.bin", np.array(docs * 20))
+    with TokenFile(tmp_path / "docs.bin") as tf:
+        src = tf.lm_source(batch_size=1, seq_len=12, shuffle=False,
+                           eos_id=eos)
+        batch = next(iter(src))
+        seg = batch["segments"][0]
+        # the EOS token closes its document; ids are non-decreasing
+        np.testing.assert_array_equal(
+            seg, [0, 0, 0, 1, 1, 2, 2, 2, 2, 2, 3, 3]
+        )
+        assert (np.diff(batch["segments"], axis=1) >= 0).all()
+
+
 def test_lm_source_sharded_hosts_disjoint(token_path):
     with TokenFile(token_path) as tf:
         per_host = [
