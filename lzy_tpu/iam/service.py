@@ -52,6 +52,15 @@ class AuthError(PermissionError):
     pass
 
 
+# one-time-token wire prefix — the single definition every layer (issuer,
+# RPC handlers, worker-side token holder) keys on
+OTT_PREFIX = "ott/"
+
+
+def is_ott_token(token: Optional[str]) -> bool:
+    return bool(token) and token.startswith(OTT_PREFIX)
+
+
 @dataclasses.dataclass(frozen=True)
 class Subject:
     id: str
@@ -139,7 +148,7 @@ class IamService:
             })
         # deliberately NOT a valid bearer shape: authenticate() rejects it,
         # so an OTT can never be replayed as a session token
-        return f"ott/{nonce}"
+        return f"{OTT_PREFIX}{nonce}"
 
     # own namespace: the sweep and lookups touch only OTT rows, never the
     # (much larger) subject/secret table
@@ -157,9 +166,9 @@ class IamService:
 
         ``expect_subject`` binds the exchange: a mismatch refuses WITHOUT
         consuming, so probing with someone else's OTT cannot burn it."""
-        if not ott or not ott.startswith("ott/"):
+        if not is_ott_token(ott):
             raise AuthError("not a one-time token")
-        key = ott[4:]
+        key = ott[len(OTT_PREFIX):]
         with self._ott_lock:
             doc = self._store.kv_get(self._OTT_NS, key)
             if doc is None:
@@ -183,7 +192,7 @@ class IamService:
 
     @staticmethod
     def is_ott(token: Optional[str]) -> bool:
-        return bool(token) and token.startswith("ott/")
+        return is_ott_token(token)
 
     # -- tokens ----------------------------------------------------------------
 

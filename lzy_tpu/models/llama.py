@@ -152,24 +152,16 @@ class Attention(nn.Module):
         if cfg.use_ring_attention and mesh is not None:
             from lzy_tpu.parallel.ring import ring_attention
 
-            if segments is not None:
-                raise NotImplementedError(
-                    "packed segments are not supported under ring "
-                    "sequence parallelism yet"
-                )
-            out = ring_attention(q, k, v, mesh=mesh, causal=True)
+            out = ring_attention(q, k, v, mesh=mesh, causal=True,
+                                 segment_ids=segments)
         elif cfg.use_ulysses_attention and mesh is not None:
             # all-to-all SP: reshard seq→heads so each device sees the FULL
             # sequence for its head slice (better when heads ≥ sp and the
             # ring's ppermute latency dominates)
             from lzy_tpu.parallel.ulysses import ulysses_attention
 
-            if segments is not None:
-                raise NotImplementedError(
-                    "packed segments are not supported under Ulysses "
-                    "sequence parallelism yet"
-                )
-            out = ulysses_attention(q, k, v, mesh=mesh, causal=True)
+            out = ulysses_attention(q, k, v, mesh=mesh, causal=True,
+                                    segment_ids=segments)
         elif cfg.use_flash_kernel and t % 128 == 0:
             # lane-aligned sequences take the Pallas kernel; tiny traces
             # (init, smoke shapes) fall through to the dense path

@@ -43,8 +43,9 @@ def chunked_attention(
     blocks with the flash merge recurrence; ``block_size`` is clamped to the
     largest divisor of T.
 
-    ``segment_ids``: optional [B, T] ints — attention is confined to equal
-    ids (packed documents never see each other)."""
+    ``segment_ids``: optional [B, T] ints — a document is a contiguous run
+    of equal ids; attention never crosses documents (same run semantics as
+    the flash kernel: ids are normalized to run starts before comparing)."""
     b, h, t, d = q.shape
     scale = scale if scale is not None else d ** -0.5
     block = auto_block(t, block_size)
@@ -61,10 +62,11 @@ def chunked_attention(
             raise ValueError(
                 f"segment_ids shape {segment_ids.shape} != {(b, t)}"
             )
-        seg_q = segment_ids.reshape(b, 1, t, 1)
-        seg_blocks = jnp.moveaxis(
-            segment_ids.reshape(b, n_blocks, block), 1, 0
-        )
+        from lzy_tpu.ops.flash_attention import document_starts
+
+        runs = document_starts(segment_ids)
+        seg_q = runs.reshape(b, 1, t, 1)
+        seg_blocks = jnp.moveaxis(runs.reshape(b, n_blocks, block), 1, 0)
 
     def body(carry, inputs):
         o, m, l = carry

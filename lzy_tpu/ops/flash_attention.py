@@ -452,9 +452,13 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def document_starts(segment_ids: jax.Array) -> jax.Array:
-    """[B, T] non-decreasing document ids → [B, T] int32 start index of each
-    position's document (cummax over change points). Shared by the kernel
-    slab below and per-document RoPE positions in the models."""
+    """[B, T] document ids → [B, T] int32 start index of each position's
+    document, where a document is a CONTIGUOUS RUN of equal ids (cummax over
+    change points). The start index uniquely identifies the run, so every
+    attention path normalizes ids through this before comparing — repeated
+    ids in non-adjacent runs are distinct documents everywhere, and the
+    kernel's run-based block skipping can never disagree with its mask.
+    Also shared by per-document RoPE positions in the models. Idempotent."""
     b, t = segment_ids.shape
     seg = segment_ids.astype(jnp.int32)
     idx = jnp.arange(t, dtype=jnp.int32)
@@ -504,11 +508,11 @@ def flash_attention(
     additive 0/-inf bias, one 128-lane slab per batch row; fully-masked
     query rows produce zero output and zero gradients.
 
-    ``segment_ids``: optional [B, T] ints, non-decreasing along T (the packed
-    layout the token loader emits) — attention is confined to equal ids, and
-    the KV loops skip blocks entirely outside the query block's documents,
-    so packing N short documents costs ~the sum of their individual
-    attention FLOPs, not the full T² triangle.
+    ``segment_ids``: optional [B, T] ints — a document is a contiguous run
+    of equal ids (repeating an id later starts a NEW document). Attention is
+    confined within documents, and the KV loops skip blocks entirely outside
+    the query block's documents, so packing N short documents costs ~the sum
+    of their individual attention FLOPs, not the full T² triangle.
     """
     b, h, t, d = q.shape
     scale = scale if scale is not None else d ** -0.5
@@ -533,7 +537,10 @@ def flash_attention(
             raise ValueError(
                 f"segment_ids shape {segment_ids.shape} != {(b, t)}"
             )
-        seg = segment_slab(segment_ids)
+        # normalize to run starts: the id the kernels compare IS the run
+        # identity, so the mask and the block-skip bounds agree by
+        # construction whatever ids the caller passed
+        seg = segment_slab(document_starts(segment_ids))
 
     flat = lambda x: x.reshape(b * h, t, d)  # noqa: E731
     o = _flash(flat(q), flat(k), flat(v), bias, seg, scale, causal, block_q,

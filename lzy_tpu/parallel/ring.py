@@ -67,16 +67,31 @@ def ring_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     q_spec: P = P(("dp", "fsdp"), None, "sp", None),
+    segment_ids: Optional[jax.Array] = None,
+    seg_spec: P = P(("dp", "fsdp"), "sp"),
 ) -> jax.Array:
     """Attention over a sequence sharded on ``axis``.
 
     Shapes (per global array): q/k/v ``[batch, heads, seq, head_dim]`` with
     ``seq`` sharded over ``axis``. Returns the same layout as q.
+
+    ``segment_ids``: optional global ``[batch, seq]`` packed-document ids
+    (seq sharded like q; a document = a contiguous run of equal ids). Each
+    rank's id chunk rides the ring alongside its K/V chunk, so attention
+    stays confined within documents across rank boundaries too — documents
+    may straddle ring shards.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     n = mesh.shape[axis]
+    if segment_ids is not None:
+        # normalize to GLOBAL run starts before sharding (same run semantics
+        # as the flash kernel); a local normalization inside shard_map would
+        # renumber each shard from zero and glue runs at shard boundaries
+        from lzy_tpu.ops.flash_attention import document_starts
 
-    def local_fn(q_blk, k_blk, v_blk):
+        segment_ids = document_starts(segment_ids)
+
+    def local_fn(q_blk, k_blk, v_blk, seg_blk):
         my_rank = lax.axis_index(axis)
         tq = q_blk.shape[2]
         tk = k_blk.shape[2]
@@ -87,8 +102,9 @@ def ring_attention(
             return rows >= cols
 
         def body(carry, step):
-            o, m, l, k_cur, v_cur = carry
+            o, m, l, k_cur, v_cur, seg_cur = carry
             src_rank = (my_rank - step) % n          # who produced this block
+            mask = None
             if causal:
                 keep_all = src_rank < my_rank
                 keep_none = src_rank > my_rank
@@ -96,30 +112,38 @@ def ring_attention(
                     keep_all, True,
                     jnp.where(keep_none, False, diag_mask()),
                 )
-            else:
-                mask = None
+            if seg_cur is not None:
+                # [B, 1, Tq, Tk]: this rank's q ids vs the ids that arrived
+                # with the current K/V chunk
+                same = seg_blk[:, None, :, None] == seg_cur[:, None, None, :]
+                mask = same if mask is None else jnp.logical_and(mask, same)
             o_b, m_b, l_b = _block_attn(q_blk, k_cur, v_cur, scale=scale, mask=mask)
             o, m, l = _merge(o, m, l, o_b, m_b, l_b)
-            # rotate K/V to the next rank; overlaps with the next block's math
+            # rotate K/V (and their ids) to the next rank; overlaps with the
+            # next block's math
             perm = [(i, (i + 1) % n) for i in range(n)]
             k_nxt = lax.ppermute(k_cur, axis, perm)
             v_nxt = lax.ppermute(v_cur, axis, perm)
-            return (o, m, l, k_nxt, v_nxt), None
+            seg_nxt = None if seg_cur is None \
+                else lax.ppermute(seg_cur, axis, perm)
+            return (o, m, l, k_nxt, v_nxt, seg_nxt), None
 
         b, h, _, d = q_blk.shape
         o0 = jnp.zeros((b, h, tq, d), jnp.float32)
         m0 = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
         l0 = jnp.zeros((b, h, tq), jnp.float32)
-        (o, m, l, _, _), _ = lax.scan(
-            body, (o0, m0, l0, k_blk, v_blk), jnp.arange(n)
+        (o, m, l, _, _, _), _ = lax.scan(
+            body, (o0, m0, l0, k_blk, v_blk, seg_blk), jnp.arange(n)
         )
         out = o / jnp.maximum(l, 1e-30)[..., None]
         return out.astype(q_blk.dtype)
 
+    if segment_ids is None:
+        fn, in_specs, args = (functools.partial(local_fn, seg_blk=None),
+                              (q_spec, q_spec, q_spec), (q, k, v))
+    else:
+        fn, in_specs, args = (local_fn, (q_spec, q_spec, q_spec, seg_spec),
+                              (q, k, v, segment_ids))
     return shard_map(
-        local_fn,
-        mesh=mesh,
-        in_specs=(q_spec, q_spec, q_spec),
-        out_specs=q_spec,
-        check_vma=False,
-    )(q, k, v)
+        fn, mesh=mesh, in_specs=in_specs, out_specs=q_spec, check_vma=False,
+    )(*args)

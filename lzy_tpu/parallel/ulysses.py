@@ -16,6 +16,7 @@ rounds. Requires ``n_heads % sp == 0``.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -35,17 +36,30 @@ def ulysses_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     q_spec: P = P(("dp", "fsdp"), None, "sp", None),
+    segment_ids: Optional[jax.Array] = None,
+    seg_spec: P = P(("dp", "fsdp"), "sp"),
 ) -> jax.Array:
     """q/k/v: global ``[B, H, T, D]`` with T sharded over ``axis``; returns the
     same layout. Exact attention (computed via the chunked online-softmax
-    kernel on each device's full-sequence head shard)."""
+    kernel on each device's full-sequence head shard).
+
+    ``segment_ids``: optional global ``[B, T]`` packed-document ids (T
+    sharded like q; a document = a contiguous run of equal ids);
+    all-gathered over ``axis`` so each head shard masks against the full
+    sequence (ids are int32 — the gather is negligible next to the K/V
+    all-to-alls)."""
     n = mesh.shape[axis]
     h = q.shape[1]
     if h % n:
         raise ValueError(f"n_heads={h} must be divisible by {axis}={n}")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if segment_ids is not None:
+        # global run starts BEFORE sharding (see ring.py for the rationale)
+        from lzy_tpu.ops.flash_attention import document_starts
 
-    def local_fn(q_blk, k_blk, v_blk):
+        segment_ids = document_starts(segment_ids)
+
+    def local_fn(q_blk, k_blk, v_blk, seg_blk):
         # local: [B, H, T/n, D] → heads scatter, sequence gathers
         def seq_to_head(x):
             # split_axis=1 (heads), concat_axis=2 (sequence)
@@ -57,16 +71,22 @@ def ulysses_attention(
                                   tiled=True)
 
         qg, kg, vg = (seq_to_head(x) for x in (q_blk, k_blk, v_blk))
+        seg_full = None
+        if seg_blk is not None:
+            seg_full = lax.all_gather(seg_blk, axis, axis=1, tiled=True)
         # [B, H/n, T, D]: exact attention over the full sequence
         from lzy_tpu.ops.attention import chunked_attention
 
-        out = chunked_attention(qg, kg, vg, causal=causal, scale=scale)
+        out = chunked_attention(qg, kg, vg, causal=causal, scale=scale,
+                                segment_ids=seg_full)
         return head_to_seq(out)
 
+    if segment_ids is None:
+        fn, in_specs, args = (functools.partial(local_fn, seg_blk=None),
+                              (q_spec, q_spec, q_spec), (q, k, v))
+    else:
+        fn, in_specs, args = (local_fn, (q_spec, q_spec, q_spec, seg_spec),
+                              (q, k, v, segment_ids))
     return shard_map(
-        local_fn,
-        mesh=mesh,
-        in_specs=(q_spec, q_spec, q_spec),
-        out_specs=q_spec,
-        check_vma=False,
-    )(q, k, v)
+        fn, mesh=mesh, in_specs=in_specs, out_specs=q_spec, check_vma=False,
+    )(*args)

@@ -43,7 +43,9 @@ class WorkerToken:
     def rotate(self, new: str) -> None:
         if new == self.current:
             return
-        if self.current.startswith("ott/"):
+        from lzy_tpu.iam import is_ott_token
+
+        if is_ott_token(self.current):
             # bootstrap swap, not a refresh: the OTT is burned server-side
             # and must not linger as an accepted credential (a leaked launch
             # env would stay usable against our own WorkerApi until the next
@@ -336,8 +338,9 @@ class RpcAllocatorClient:
 
     def register_vm(self, vm_id: str, agent: Any) -> None:
         token = _token_value(self._token)
-        if token and token.startswith("ott/") \
-                and isinstance(self._token, WorkerToken):
+        from lzy_tpu.iam import is_ott_token
+
+        if is_ott_token(token) and isinstance(self._token, WorkerToken):
             # OTT bootstrap: exchange the one-time launch credential for the
             # durable WORKER token BEFORE registering — registration makes
             # this VM callable, and the control plane dials back with the

@@ -397,3 +397,28 @@ class TestSegmentedAttention:
         q, k, v = make_qkv()
         with pytest.raises(ValueError, match="segment_ids"):
             flash_attention(q, k, v, segment_ids=jnp.zeros((2, 8), jnp.int32))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_repeated_id_in_nonadjacent_runs_is_a_new_document(self, causal):
+        """Documents are contiguous RUNS: reusing an id later must start a
+        new document, identically in the flash kernel (whose block skipping
+        is run-based) and the chunked fallback."""
+        q, k, v = make_qkv()
+        seg = jnp.asarray(
+            np.concatenate([np.zeros(64), np.ones(64), np.zeros(128)])
+            .astype(np.int32)[None, :].repeat(2, 0)
+        )
+        out_flash = flash_attention(q, k, v, causal=causal, segment_ids=seg,
+                                    block_q=128, block_kv=128)
+        out_chunk = chunked_attention(q, k, v, causal=causal,
+                                      segment_ids=seg, block_size=64)
+        # run-normalized ids = what both paths must behave like
+        runs = jnp.asarray(
+            np.concatenate([np.zeros(64), np.ones(64), 2 * np.ones(128)])
+            .astype(np.int32)[None, :].repeat(2, 0)
+        )
+        ref = self.dense_segmented(q, k, v, runs, causal)
+        np.testing.assert_allclose(np.asarray(out_flash), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(np.asarray(out_chunk), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
