@@ -1,0 +1,105 @@
+"""Workflow-overhead benchmark: the control-plane share of the north-star
+latency targets (BASELINE.md: allocation→first-step < 60 s, workflow
+wall-clock per config).
+
+On a cloud deployment alloc→first-step is dominated by pod scheduling + VM
+boot; everything else — graph compile, channel setup, scheduling, dispatch,
+data plane — is THIS framework's overhead, measured here on the in-process
+cluster (thread VMs, CPU). Prints one JSON line per scenario:
+
+    {"scenario": "cold_dispatch", "wall_s": ..., "alloc_to_op_start_s": ...}
+
+Scenarios: cold single-op dispatch (fresh VM), warm dispatch (VM-cache
+reuse, the 21-min-idle reference behavior), 16-wide fan-out (config 1), and
+a cached re-run (server-side CheckCache short-circuit).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from lzy_tpu import op                                  # noqa: E402
+from lzy_tpu.service import InProcessCluster            # noqa: E402
+
+OP_STARTED_AT = {}
+
+
+@op
+def stamp(tag: str) -> float:
+    t = time.perf_counter()
+    OP_STARTED_AT[tag] = t
+    return t
+
+
+@op
+def fan(i: int) -> int:
+    return i * i
+
+
+@op(cache=True, version="1.0")
+def cached_heavy(x: int) -> int:
+    time.sleep(0.5)
+    return x * x
+
+
+def emit(scenario: str, **fields) -> None:
+    print(json.dumps({"scenario": scenario,
+                      **{k: round(v, 4) for k, v in fields.items()}}),
+          flush=True)
+
+
+def main() -> None:
+    tmp = tempfile.mkdtemp(prefix="bench-wf-")
+    cluster = InProcessCluster(
+        db_path=os.path.join(tmp, "meta.db"),
+        storage_uri=f"file://{tmp}/storage",
+        poll_period_s=0.02,
+    )
+    lzy = cluster.lzy()
+    try:
+        # cold: first op pays VM allocation + channel + dispatch
+        t0 = time.perf_counter()
+        with lzy.workflow("bench-cold"):
+            started = float(stamp("cold"))
+        emit("cold_dispatch", wall_s=time.perf_counter() - t0,
+             alloc_to_op_start_s=started - t0)
+
+        # warm: the IDLE VM is reused from the session cache
+        t0 = time.perf_counter()
+        with lzy.workflow("bench-warm"):
+            started = float(stamp("warm"))
+        emit("warm_dispatch", wall_s=time.perf_counter() - t0,
+             alloc_to_op_start_s=started - t0)
+
+        # fan-out: 16 independent ops (BASELINE config 1 shape)
+        t0 = time.perf_counter()
+        with lzy.workflow("bench-fan"):
+            results = [fan(i) for i in range(16)]
+            total = sum(int(r) for r in results)
+        assert total == sum(i * i for i in range(16))
+        emit("fanout_16", wall_s=time.perf_counter() - t0)
+
+        # cache: second run of an expensive op never executes it
+        t0 = time.perf_counter()
+        with lzy.workflow("bench-cache"):
+            assert int(cached_heavy(7)) == 49
+        first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with lzy.workflow("bench-cache"):
+            assert int(cached_heavy(7)) == 49
+        emit("cached_rerun", first_s=first,
+             wall_s=time.perf_counter() - t0)
+    finally:
+        cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()
