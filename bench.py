@@ -16,12 +16,15 @@ hang is attributable to a phase.
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 import time
 
 ATTEMPT_DEADLINE_S = 560  # per child attempt; first TPU compile alone can take 90 s
 ATTEMPTS = 2
+PROBE_DEADLINE_S = 125  # child self-terminates at 120 s; small margin on top
+PROBE_ATTEMPTS = 4
 METRIC = "llama_train_step_mfu"
 
 
@@ -45,7 +48,56 @@ def _scan_metric(out: str):
     return None, None
 
 
+def probe_backend() -> str | None:
+    """Cheap relay probes before committing to a full measurement attempt.
+
+    The relay either answers `jax.devices()` in seconds or hangs; burning a
+    full 560 s attempt on a hung init wastes the driver window (BENCH_r02
+    died this way, twice). Four 120 s probes give a flaky relay more bites
+    at a fraction of the cost. Returns None when a probe succeeds, else the
+    joined error string.
+    """
+    errors = []
+    for attempt in range(1, PROBE_ATTEMPTS + 1):
+        _log(f"probe {attempt}/{PROBE_ATTEMPTS} (deadline {PROBE_DEADLINE_S}s)")
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__, "--probe"],
+                stdout=subprocess.PIPE,
+                timeout=PROBE_DEADLINE_S,
+            )
+        except subprocess.TimeoutExpired:
+            errors.append(f"probe {attempt}: hung, killed after {PROBE_DEADLINE_S}s")
+            _log(errors[-1])
+            continue
+        out = proc.stdout.decode("utf-8", "replace").strip().splitlines()
+        last = out[-1] if out else ""
+        if proc.returncode == 0 and last.startswith("ok"):
+            _log(f"probe {attempt}: backend up in "
+                 f"{time.monotonic() - t0:.0f}s ({last})")
+            return None
+        errors.append(f"probe {attempt}: {last or f'rc={proc.returncode}'}")
+        _log(errors[-1])
+    return "; ".join(errors)
+
+
 def supervise() -> None:
+    probe_err = probe_backend()
+    if probe_err is not None:
+        print(
+            json.dumps(
+                {
+                    "metric": METRIC,
+                    "value": 0.0,
+                    "unit": "mfu_fraction",
+                    "vs_baseline": 0.0,
+                    "error": f"backend never initialized: {probe_err}",
+                }
+            ),
+            flush=True,
+        )
+        return
     errors = []
     deadline = ATTEMPT_DEADLINE_S
     for attempt in range(1, ATTEMPTS + 1):
@@ -392,8 +444,23 @@ def step_breakdown(jax, loss_fn, params, batch, step_ms: float, n: int = 5):
         return {}
 
 
+def probe() -> None:
+    """Child probe: init the backend under a 120 s watchdog, print one line."""
+    try:
+        devices = init_devices(120.0)
+    except Exception as e:  # noqa: BLE001 — reported to the supervisor
+        print(f"init failed: {e}", flush=True)
+        # hard-exit: a hung daemon init thread can block normal interpreter
+        # teardown past the supervisor's margin
+        os._exit(1)
+    print(f"ok: {len(devices)}x {devices[0].platform}", flush=True)
+    os._exit(0)
+
+
 if __name__ == "__main__":
     if "--run" in sys.argv:
         run()
+    elif "--probe" in sys.argv:
+        probe()
     else:
         supervise()

@@ -1,0 +1,157 @@
+"""Background TPU-relay evidence collector (VERDICT r2 weak #1).
+
+The relay ("axon") can be dead for the entire driver window, erasing the
+bench number no matter how good the supervisor is. This loop runs all round
+in the background: every ~10 minutes it probes `jax.devices()` under a
+watchdog; the moment the relay answers it immediately runs the FULL bench
+(plus the on-hardware kernel tests and the flash block-size sweep) and
+writes timestamped artifacts under `tpu_evidence/` for the builder to
+commit — so a dead relay at driver time no longer erases the number.
+
+Usage:  python tools/tpu_probe_loop.py  (blocks; run in the background)
+
+Artifacts (all timestamped, newest wins):
+  tpu_evidence/BENCH_LOCAL.json      — the bench JSON line + metadata
+  tpu_evidence/bench_stderr.log      — raw bench stderr (staged progress)
+  tpu_evidence/kernels_tpu.log       — pytest tpu_tests/ output
+  tpu_evidence/tune_flash.log        — block-size sweep output
+  tpu_evidence/probe_history.jsonl   — one line per probe (up/down + latency)
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EVIDENCE = os.path.join(REPO, "tpu_evidence")
+PROBE_PERIOD_S = 600
+PROBE_DEADLINE_S = 125
+BENCH_DEADLINE_S = 1500
+KERNELS_DEADLINE_S = 1200
+TUNE_DEADLINE_S = 2400
+
+
+def now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+def log(msg: str) -> None:
+    print(f"[probe-loop {now()}] {msg}", flush=True)
+
+
+def append_history(rec: dict) -> None:
+    os.makedirs(EVIDENCE, exist_ok=True)
+    with open(os.path.join(EVIDENCE, "probe_history.jsonl"), "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def probe_once() -> bool:
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--probe"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            timeout=PROBE_DEADLINE_S, cwd=REPO,
+        )
+        out = proc.stdout.decode("utf-8", "replace").strip()
+        up = proc.returncode == 0 and "ok" in out
+    except subprocess.TimeoutExpired:
+        out, up = f"hung, killed after {PROBE_DEADLINE_S}s", False
+    dt = round(time.monotonic() - t0, 1)
+    append_history({"t": now(), "up": up, "latency_s": dt, "detail": out[-200:]})
+    log(f"probe: {'UP' if up else 'down'} ({dt}s) {out[-120:]}")
+    return up
+
+
+def run_logged(cmd: list, log_name: str, deadline: int) -> str:
+    """Run cmd, tee combined output to an evidence log, return the output."""
+    path = os.path.join(EVIDENCE, log_name)
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            timeout=deadline, cwd=REPO,
+        )
+        out = proc.stdout.decode("utf-8", "replace")
+        status = f"rc={proc.returncode}"
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"").decode("utf-8", "replace") if e.stdout else ""
+        status = f"hung, killed after {deadline}s"
+    header = (f"# {now()} cmd={' '.join(cmd)} {status} "
+              f"({time.monotonic() - t0:.0f}s)\n")
+    with open(path, "w") as f:
+        f.write(header + out)
+    log(f"{log_name}: {status}")
+    return out
+
+
+def capture_bench() -> bool:
+    """Full bench with stderr captured; returns True on a non-error metric."""
+    t_start = now()
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            timeout=BENCH_DEADLINE_S, cwd=REPO,
+        )
+        stdout = proc.stdout.decode("utf-8", "replace")
+        stderr = proc.stderr.decode("utf-8", "replace")
+    except subprocess.TimeoutExpired as e:
+        stdout = (e.stdout or b"").decode("utf-8", "replace") if e.stdout else ""
+        stderr = (e.stderr or b"").decode("utf-8", "replace") if e.stderr else ""
+        stderr += f"\n[probe-loop] bench hung, killed after {BENCH_DEADLINE_S}s\n"
+    wall = round(time.monotonic() - t0, 1)
+    with open(os.path.join(EVIDENCE, "bench_stderr.log"), "w") as f:
+        f.write(f"# started {t_start}, wall {wall}s\n" + stderr)
+    parsed = None
+    for line in reversed(stdout.splitlines()):
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            parsed = obj
+            break
+    ok = parsed is not None and not parsed.get("error")
+    record = {
+        "started": t_start, "finished": now(), "wall_s": wall,
+        "ok": ok, "parsed": parsed, "raw_stdout": stdout[-4000:],
+    }
+    with open(os.path.join(EVIDENCE, "BENCH_LOCAL.json"), "w") as f:
+        json.dump(record, f, indent=2)
+    log(f"bench: ok={ok} value={parsed.get('value') if parsed else None}")
+    return ok
+
+
+def main() -> None:
+    os.makedirs(EVIDENCE, exist_ok=True)
+    captured_bench = captured_kernels = captured_tune = False
+    while not (captured_bench and captured_kernels and captured_tune):
+        if probe_once():
+            if not captured_bench:
+                captured_bench = capture_bench()
+            if captured_bench and not captured_kernels:
+                out = run_logged(
+                    [sys.executable, "-m", "pytest", "tpu_tests/", "-q",
+                     "--no-header"],
+                    "kernels_tpu.log", KERNELS_DEADLINE_S)
+                captured_kernels = " passed" in out
+            if captured_bench and not captured_tune:
+                out = run_logged(
+                    [sys.executable, "tools/tune_flash.py", "--steps", "10"],
+                    "tune_flash.log", TUNE_DEADLINE_S)
+                captured_tune = "mfu" in out
+        if captured_bench and captured_kernels and captured_tune:
+            break
+        time.sleep(PROBE_PERIOD_S)
+    log("all evidence captured; exiting")
+
+
+if __name__ == "__main__":
+    main()
