@@ -41,10 +41,12 @@ VOCAB = 256
 SEQ = 128
 
 
-@op(cache=True, version="1.0")
-def build_corpus(n_docs: int) -> str:
+@op(cache=True, version="1.1")
+def build_corpus(n_docs: int) -> bytes:
     """Pack synthetic documents (repeating-pattern 'sentences') into a
-    self-describing token file; returns its path."""
+    self-describing token file; returns its BYTES. A cached value must be
+    self-contained: returning a temp path would dangle on a later run (or
+    another host) after temp cleanup."""
     import tempfile
 
     import numpy as np
@@ -58,14 +60,18 @@ def build_corpus(n_docs: int) -> str:
         base = rng.integers(0, VOCAB - 1, period)
         reps = int(rng.integers(4, 12))
         stream.extend(np.tile(base, reps).tolist() + [EOS])
-    path = os.path.join(tempfile.mkdtemp(prefix="corpus-"), "corpus.bin")
-    write_token_file(path, np.asarray(stream))
-    return path
+    with tempfile.TemporaryDirectory(prefix="corpus-") as tmp:
+        path = os.path.join(tmp, "corpus.bin")
+        write_token_file(path, np.asarray(stream))
+        with open(path, "rb") as f:
+            return f.read()
 
 
 @op
-def pretrain(corpus_path: str, steps: int) -> dict:
+def pretrain(corpus: bytes, steps: int) -> dict:
     """Packed, sharded, checkpointed training; returns params + curve."""
+    import tempfile
+
     import jax
     import numpy as np
     import optax
@@ -87,13 +93,18 @@ def pretrain(corpus_path: str, steps: int) -> dict:
     state = shard_state(TrainState.create(unbox(boxed), tx))
 
     losses = []
-    with TokenFile(corpus_path) as tf:
-        src = tf.lm_source(batch_size=8, seq_len=SEQ, eos_id=EOS, seed=1)
-        for i, batch in enumerate(DataPipeline(src, batch_sharding)):
-            state, metrics = step(state, batch)
-            losses.append(float(metrics["loss"]))
-            if i + 1 >= steps:
-                break
+    # scratch file lifetime bounded by the op (the loader mmaps from a path)
+    with tempfile.TemporaryDirectory(prefix="corpus-") as tmp:
+        corpus_path = os.path.join(tmp, "corpus.bin")
+        with open(corpus_path, "wb") as f:
+            f.write(corpus)
+        with TokenFile(corpus_path) as tf:
+            src = tf.lm_source(batch_size=8, seq_len=SEQ, eos_id=EOS, seed=1)
+            for i, batch in enumerate(DataPipeline(src, batch_sharding)):
+                state, metrics = step(state, batch)
+                losses.append(float(metrics["loss"]))
+                if i + 1 >= steps:
+                    break
     return {
         "params": jax.device_get(state.params),
         "first_loss": losses[0],

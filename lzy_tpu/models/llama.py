@@ -57,6 +57,12 @@ class LlamaConfig:
     # logits-free loss: the model returns (features, head) and the loss uses
     # chunked_cross_entropy — saves the [B,T,V] activation (ops/chunked_ce.py)
     fused_ce: bool = False
+    # GPipe pipeline parallelism: >1 partitions the decoder stack into that
+    # many stages streamed over the mesh's 'pp' axis (parallel/pipeline.py);
+    # composes with dp/fsdp/tp. Training-only (generate() takes the dense
+    # tree — see unstack_pp_params).
+    pp_stages: int = 0
+    pp_microbatches: int = 0  # 0 → pp_stages (the minimum that fills the pipe)
 
     @property
     def head_dim(self) -> int:
@@ -341,10 +347,150 @@ class Llama(nn.Module):
         )
 
 
+class LlamaStage(nn.Module):
+    """One pipeline stage: ``n_layers`` consecutive decoded layers.
+
+    Every stage runs the same module shape with per-stage weights — the
+    constraint ``parallel.pipeline.pipeline_apply`` streams microbatches
+    through (stage i holds layers [i*k, (i+1)*k))."""
+
+    cfg: LlamaConfig
+    n_layers: int
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        layer = DecoderLayer
+        if cfg.remat:
+            layer = nn.remat(
+                DecoderLayer, static_argnums=(),
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+        for i in range(self.n_layers):
+            x = layer(cfg, name=f"layer_{i}")(x, positions)
+        return x
+
+
+def _check_pp_config(cfg: LlamaConfig) -> int:
+    """Validate a pipeline config; returns layers-per-stage."""
+    if cfg.n_layers % cfg.pp_stages:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pp_stages={cfg.pp_stages}"
+        )
+    unsupported = [
+        name for name, on in [
+            ("use_ring_attention", cfg.use_ring_attention),
+            ("use_ulysses_attention", cfg.use_ulysses_attention),
+            ("n_experts", cfg.n_experts > 0),
+            ("decode", cfg.decode),
+        ] if on
+    ]
+    if unsupported:
+        raise ValueError(
+            f"pp_stages>1 does not compose with {unsupported} (pipeline the "
+            f"dense decoder; decode via unstack_pp_params + the dense tree)"
+        )
+    return cfg.n_layers // cfg.pp_stages
+
+
+def _init_pp_params(cfg: LlamaConfig, rng: jax.Array, seq_len: int):
+    """Pipeline layout: the decoder stack lives under ``"stages"`` with every
+    leaf stacked ``[pp_stages, ...]`` (logical axis ``"stage"`` → mesh ``pp``);
+    embed/final-norm/head stay top-level exactly as in the dense tree.
+    Returned params are plain arrays (``unbox`` is a no-op on them), so the
+    ``boxed, axes = init_params(...); params = unbox(boxed)`` call pattern
+    works unchanged."""
+    from lzy_tpu.models.common import param_logical_axes, unbox as _unbox
+
+    k = _check_pp_config(cfg)
+    r_trunk, r_stages = jax.random.split(rng)
+
+    trunk_cfg = dataclasses.replace(cfg, n_layers=0, pp_stages=0)
+    tokens = jnp.zeros((1, seq_len), jnp.int32)
+    trunk_boxed = Llama(trunk_cfg).init(r_trunk, tokens)["params"]
+
+    stage = LlamaStage(cfg, k)
+    dummy_x = jnp.zeros((1, seq_len, cfg.d_model), cfg.dtype)
+    dummy_pos = jnp.zeros((1, seq_len), jnp.int32)
+    one_boxed = stage.init(jax.random.PRNGKey(0), dummy_x, dummy_pos)["params"]
+    stage_axes = jax.tree_util.tree_map(
+        lambda axes: ("stage",) + axes,
+        param_logical_axes(one_boxed),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+    stacked = jax.vmap(
+        lambda r: _unbox(stage.init(r, dummy_x, dummy_pos)["params"])
+    )(jax.random.split(r_stages, cfg.pp_stages))
+
+    params = dict(_unbox(trunk_boxed))
+    params["stages"] = stacked
+    axes = dict(param_logical_axes(trunk_boxed))
+    axes["stages"] = stage_axes
+    return params, axes
+
+
+def pp_forward(params, tokens: jax.Array, cfg: LlamaConfig, mesh,
+               axis: str = "pp"):
+    """Pipelined forward: embed → GPipe over the decoder stack → norm + head.
+
+    Embedding/norm/head run outside the pipeline (replicated over ``pp``,
+    sharded over the remaining mesh axes as usual); only the decoder stack
+    streams microbatches stage-to-stage over ``ppermute`` neighbor hops."""
+    from lzy_tpu.parallel.pipeline import pipeline_apply
+
+    k = _check_pp_config(cfg)
+    if mesh.shape[axis] != cfg.pp_stages:
+        raise ValueError(
+            f"mesh {axis}={mesh.shape[axis]} != pp_stages={cfg.pp_stages}"
+        )
+    b, t = tokens.shape
+    n_micro = cfg.pp_microbatches or cfg.pp_stages
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible by {n_micro} microbatches")
+
+    x = params["embed_tokens"].astype(cfg.dtype)[tokens]
+    mb = b // n_micro
+    xm = x.reshape(n_micro, mb, t, x.shape[-1])
+    positions = jnp.broadcast_to(jnp.arange(t), (mb, t))
+
+    stage = LlamaStage(cfg, k)
+
+    def stage_fn(p, h):
+        return stage.apply({"params": p}, h, positions)
+
+    x = pipeline_apply(stage_fn, params["stages"], xm, mesh=mesh, axis=axis)
+    x = x.reshape(b, t, -1)
+    x = RMSNorm(cfg.norm_eps, cfg.param_dtype).apply(
+        {"params": params["final_norm"]}, x
+    )
+    head = params["embed_tokens"] if cfg.tie_embeddings else params["lm_head"]
+    if cfg.fused_ce:
+        return x.astype(cfg.dtype), head.astype(cfg.dtype)
+    return jnp.einsum(
+        "bte,ve->btv", x.astype(cfg.dtype), head.astype(cfg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def unstack_pp_params(cfg: LlamaConfig, params):
+    """Pipeline-stacked params → the standard dense Llama tree (so a
+    pp-trained model can run ``generate``/eval, which don't pipeline)."""
+    k = _check_pp_config(cfg)
+    dense = {key: val for key, val in params.items() if key != "stages"}
+    for s in range(cfg.pp_stages):
+        for j in range(k):
+            dense[f"layer_{s * k + j}"] = jax.tree_util.tree_map(
+                lambda a, s=s: a[s], params["stages"][f"layer_{j}"]
+            )
+    return dense
+
+
 def init_params(cfg: LlamaConfig, rng: jax.Array, seq_len: int = 8):
     """Returns (boxed_params, logical_axes). Unbox with models.common.unbox."""
     from lzy_tpu.models.common import param_logical_axes
 
+    if cfg.pp_stages > 1:
+        return _init_pp_params(cfg, rng, seq_len)
     model = Llama(cfg)
     tokens = jnp.zeros((1, seq_len), jnp.int32)
     boxed = model.init(rng, tokens)["params"]
@@ -353,7 +499,23 @@ def init_params(cfg: LlamaConfig, rng: jax.Array, seq_len: int = 8):
 
 def make_loss_fn(cfg: LlamaConfig, mesh=None):
     """Causal-LM loss: predict tokens[t+1] from tokens[:t]. MoE configs add
-    the routers' load-balancing aux losses."""
+    the routers' load-balancing aux losses. ``pp_stages>1`` streams the
+    decoder stack over the mesh's pp axis (mesh required)."""
+    if cfg.pp_stages > 1:
+        _check_pp_config(cfg)
+        if mesh is None:
+            raise ValueError("pp_stages>1 requires make_loss_fn(cfg, mesh=...)")
+
+        def pp_loss_fn(params, batch):
+            tokens = batch["tokens"]
+            if batch.get("segments") is not None:
+                raise ValueError("packed segments do not compose with pp yet")
+            out = pp_forward(params, tokens, cfg, mesh)
+            mask = batch.get("mask")
+            shifted_mask = mask[:, 1:] if mask is not None else None
+            return _lm_loss(cfg, out, tokens, shifted_mask)
+
+        return pp_loss_fn
     model = Llama(cfg)
 
     def loss_fn(params, batch):
@@ -379,15 +541,19 @@ def make_loss_fn(cfg: LlamaConfig, mesh=None):
             same_doc = segments[:, 1:] == segments[:, :-1]
             shifted_mask = same_doc if shifted_mask is None \
                 else jnp.logical_and(shifted_mask, same_doc)
-        if cfg.fused_ce:
-            features, head = logits
-            from lzy_tpu.ops.chunked_ce import chunked_cross_entropy
-
-            return chunked_cross_entropy(
-                features[:, :-1], head, tokens[:, 1:], mask=shifted_mask,
-            ) + aux
-        return cross_entropy_loss(
-            logits[:, :-1], tokens[:, 1:], shifted_mask,
-        ) + aux
+        return _lm_loss(cfg, logits, tokens, shifted_mask) + aux
 
     return loss_fn
+
+
+def _lm_loss(cfg: LlamaConfig, out, tokens, shifted_mask):
+    """Shared next-token loss tail: ``out`` is logits, or (features, head)
+    when ``cfg.fused_ce`` (both the dense and pipelined paths end here)."""
+    if cfg.fused_ce:
+        features, head = out
+        from lzy_tpu.ops.chunked_ce import chunked_cross_entropy
+
+        return chunked_cross_entropy(
+            features[:, :-1], head, tokens[:, 1:], mask=shifted_mask,
+        )
+    return cross_entropy_loss(out[:, :-1], tokens[:, 1:], shifted_mask)

@@ -20,6 +20,21 @@ from jax import lax
 _NEG_INF = -1e30
 
 
+def _match_vma(init, *refs):
+    """Align a zero-init scan carry's varying-over-manual-axes type with the
+    data it will accumulate. Inside a partial-manual ``shard_map`` (e.g. the
+    pipeline's pp axis with fsdp/tp auto), q/k/v are device-varying over the
+    manual axes while a plain ``jnp.zeros`` is invariant — the scan's vma
+    type check rejects that mix unless the init is pcast up front."""
+    vma = frozenset().union(
+        *(getattr(jax.typeof(r), "vma", frozenset()) for r in refs)
+    )
+    missing = vma - getattr(jax.typeof(init), "vma", frozenset())
+    if missing:
+        init = lax.pcast(init, tuple(missing), to="varying")
+    return init
+
+
 def auto_block(t: int, requested: int = 512) -> int:
     """Largest divisor of ``t`` that is ≤ requested — any sequence length gets
     a valid block without callers hand-rolling divisor hunts."""
@@ -101,9 +116,9 @@ def chunked_attention(
         )
         return (o_new, m_new, l_new), None
 
-    o0 = jnp.zeros((b, h, t, d), jnp.float32)
-    m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, t), jnp.float32)
+    o0 = _match_vma(jnp.zeros((b, h, t, d), jnp.float32), q, k, v)
+    m0 = _match_vma(jnp.full((b, h, t), _NEG_INF, jnp.float32), q, k, v)
+    l0 = _match_vma(jnp.zeros((b, h, t), jnp.float32), q, k, v)
     idxs = jnp.arange(n_blocks)
     xs = (idxs, jnp.moveaxis(k_blocks, 2, 0), jnp.moveaxis(v_blocks, 2, 0))
     if seg_blocks is not None:

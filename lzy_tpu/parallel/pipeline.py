@@ -42,6 +42,7 @@ def pipeline_apply(
     """
     n_stages = mesh.shape[axis]
     n_micro = x.shape[0]
+    dtype = x.dtype
 
     param_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
 
@@ -50,10 +51,25 @@ def pipeline_apply(
         params = jax.tree_util.tree_map(lambda a: a[0], params_local)
         rank = lax.axis_index(axis)
         total = n_micro + n_stages - 1
-        micro_shape = x_all.shape[1:]
 
-        outs0 = jnp.zeros((n_micro,) + micro_shape, x_all.dtype)
-        buf0 = jnp.zeros(micro_shape, x_all.dtype)
+        # the carry is device-varying over pp (each rank banks different
+        # values), so the zero-init must carry that vma type too or the
+        # cond/scan type checks reject the mix. Derive the zeros from the
+        # (varying) rank index instead of lax.pcast: a bf16 pcast lowers to
+        # a copy-computation all-reduce that crashes XLA:CPU's
+        # AllReducePromotion pass (hlo_instruction.cc "Invalid binary
+        # instruction opcode copy"), while this arithmetic form lowers to
+        # plain elementwise ops on every backend.
+        # x_all enters f32 (see the boundary note below) and becomes the
+        # compute dtype here; adding zero_v also makes it pp-varying so the
+        # tick's where(rank==0, inject, buf) needs no implicit pvary.
+        zero_v = (rank * 0).astype(dtype)
+        # varying-making add BEFORE the downcast: the implicit pvary (and
+        # its psum transpose in the backward) must see f32, not bf16
+        x_all = (x_all + (rank * 0).astype(x_all.dtype)).astype(dtype)
+        micro_shape = x_all.shape[1:]
+        outs0 = jnp.zeros((n_micro,) + micro_shape, dtype) + zero_v
+        buf0 = jnp.zeros(micro_shape, dtype) + zero_v
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def tick(carry, t):
@@ -77,12 +93,23 @@ def pipeline_apply(
         (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(total))
         # only the last stage banked real outputs (every other rank kept
         # zeros), so a psum replicates them to all ranks in one collective
-        return lax.psum(outs, axis)
+        return lax.psum(outs.astype(jnp.float32), axis)
 
-    return shard_map(
+    # only ``pp`` is manual: the other mesh axes (dp/fsdp/tp) stay auto, so
+    # the stage body's matmuls are sharded by XLA from the params' own
+    # shardings — pipeline composes with fsdp/tp instead of forcing stage
+    # params replicated onto every device.
+    # The boundary (x in, outs out, and their grad transposes) is f32: the
+    # partial-manual lowering wraps boundary all-reduces' reduction bodies
+    # in a sharding constraint, and XLA:CPU's AllReducePromotion pass
+    # crashes cloning that body for promoted (bf16) types — f32 is never
+    # promoted. Inside, compute stays in x.dtype; one boundary-sized f32
+    # collective is noise next to the pipeline itself.
+    out = shard_map(
         local,
         mesh=mesh,
         in_specs=(param_specs, P()),
         out_specs=P(),
-        check_vma=False,
-    )(stage_params, x)
+        axis_names={axis},
+    )(stage_params, x.astype(jnp.float32))
+    return out.astype(dtype)

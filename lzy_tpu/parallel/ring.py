@@ -30,7 +30,9 @@ _NEG_INF = -1e30
 def _block_attn(q, k, v, *, scale, mask):
     """One flash block: returns (unnormalized out, row max, row sumexp).
 
-    q: [B, H, Tq, D], k/v: [B, H, Tk, D], mask: [Tq, Tk] or None.
+    q: [B, H, Tq, D], k/v: [B, H, Tk, D]; mask: None or any shape
+    broadcastable to [B, H, Tq, Tk] (the segmented ring path passes
+    [B, 1, Tq, Tk]).
     """
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale

@@ -1,5 +1,7 @@
 """Expert-parallel MoE and pipeline-parallel tests on the 8-device mesh."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -7,7 +9,9 @@ import optax
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from lzy_tpu.models import llama
 from lzy_tpu.models.common import param_logical_axes, unbox
+from lzy_tpu.models.llama import Llama, LlamaConfig
 from lzy_tpu.models.moe import MoeConfig, MoeMlp
 from lzy_tpu.parallel import TrainState, make_train_step, mesh_for
 from lzy_tpu.parallel.pipeline import pipeline_apply
@@ -128,3 +132,93 @@ class TestPipeline:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(expected), atol=1e-5
         )
+
+
+class TestLlamaPipeline:
+    """Pipeline parallelism wired into the Llama family (VERDICT r2 #5)."""
+
+    def _cfg(self, **kw):
+        kw.setdefault("pp_stages", 2)
+        return dataclasses.replace(LlamaConfig.tiny(vocab_size=256), **kw)
+
+    def test_pp_forward_matches_dense(self):
+        cfg = self._cfg(dtype=jnp.float32)
+        mesh = mesh_for(2, pp=2)
+        params, _ = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size
+        )
+        pp_logits = llama.pp_forward(params, tokens, cfg, mesh)
+
+        dense_cfg = dataclasses.replace(cfg, pp_stages=0)
+        dense_params = llama.unstack_pp_params(cfg, params)
+        dense_logits = Llama(dense_cfg).apply(
+            {"params": dense_params}, tokens
+        )
+        np.testing.assert_allclose(
+            np.asarray(pp_logits), np.asarray(dense_logits),
+            atol=1e-4, rtol=1e-4,
+        )
+
+    def test_pp_composes_with_fsdp_tp_and_trains(self):
+        cfg = self._cfg()
+        mesh = mesh_for(8, pp=2, fsdp=2, tp=2)
+        params, axes = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tx = optax.adamw(1e-2)
+        step, shard_state, _ = make_train_step(
+            llama.make_loss_fn(cfg, mesh), tx, mesh=mesh,
+            param_logical_axes=axes, batch_logical_axes=("batch", "seq"),
+        )
+        state = shard_state(TrainState.create(params, tx))
+
+        # stage stacking sharded over pp AND the stage weights over fsdp/tp
+        gate = state.params["stages"]["layer_0"]["mlp"]["gate_proj"]["kernel"]
+        assert gate.sharding.spec[0] == "pp", gate.sharding.spec
+        assert "tp" in str(gate.sharding.spec) and "fsdp" in str(
+            gate.sharding.spec
+        ), gate.sharding.spec
+
+        before = np.asarray(jax.device_get(gate))
+        batch = {
+            "tokens": jax.random.randint(
+                jax.random.PRNGKey(2), (8, 32), 0, cfg.vocab_size
+            )
+        }
+        losses = []
+        for _ in range(5):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0], losses
+
+        # grads flowed into BOTH stages (both slices of the stack moved)
+        after = np.asarray(jax.device_get(
+            state.params["stages"]["layer_0"]["mlp"]["gate_proj"]["kernel"]
+        ))
+        for s in range(cfg.pp_stages):
+            assert np.abs(after[s] - before[s]).max() > 0, f"stage {s} frozen"
+
+    def test_pp_microbatches_flag(self):
+        cfg = self._cfg(dtype=jnp.float32, pp_microbatches=4)
+        mesh = mesh_for(2, pp=2)
+        params, _ = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size
+        )
+        pp_logits = llama.pp_forward(params, tokens, cfg, mesh)
+        dense_logits = Llama(dataclasses.replace(cfg, pp_stages=0)).apply(
+            {"params": llama.unstack_pp_params(cfg, params)}, tokens
+        )
+        np.testing.assert_allclose(
+            np.asarray(pp_logits), np.asarray(dense_logits),
+            atol=1e-4, rtol=1e-4,
+        )
+
+    def test_pp_rejects_bad_configs(self):
+        with pytest.raises(ValueError, match="divisible"):
+            llama.init_params(
+                self._cfg(pp_stages=3), jax.random.PRNGKey(0)
+            )
+        with pytest.raises(ValueError, match="compose"):
+            llama.init_params(
+                self._cfg(use_ring_attention=True), jax.random.PRNGKey(0)
+            )
