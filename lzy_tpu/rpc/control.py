@@ -108,6 +108,7 @@ class ControlPlaneServer:
                 p.get("user", ""), p["workflow_name"], p["storage_uri"],
                 execution_id=p.get("execution_id"),
                 token=p.get("token"), client_version=p.get("client_version"),
+                idempotency_key=p.get("idempotency_key"),
             )}
 
         def h_wait_channel(p):
@@ -207,15 +208,19 @@ class ControlPlaneServer:
             # workflow service
             "StartWorkflow": h_start,
             "FinishWorkflow": lambda p: svc.finish_workflow(
-                p["execution_id"], token=p.get("token")),
+                p["execution_id"], token=p.get("token"),
+                idempotency_key=p.get("idempotency_key")),
             "AbortWorkflow": lambda p: svc.abort_workflow(
-                p["execution_id"], token=p.get("token")),
+                p["execution_id"], token=p.get("token"),
+                idempotency_key=p.get("idempotency_key")),
             "ExecuteGraph": lambda p: {"graph_op_id": svc.execute_graph(
-                p["execution_id"], p["graph"], token=p.get("token"))},
+                p["execution_id"], p["graph"], token=p.get("token"),
+                idempotency_key=p.get("idempotency_key"))},
             "GraphStatus": lambda p: svc.graph_status(
                 p["execution_id"], p["graph_op_id"], token=p.get("token")),
             "StopGraph": lambda p: svc.stop_graph(
-                p["execution_id"], p["graph_op_id"], token=p.get("token")),
+                p["execution_id"], p["graph_op_id"], token=p.get("token"),
+                idempotency_key=p.get("idempotency_key")),
             "GetPoolSpecs": lambda p: {"pools": [
                 {"kind": "tpu", **dataclasses.asdict(s)}
                 if isinstance(s, TpuPoolSpec)
@@ -356,8 +361,10 @@ class RpcAllocatorClient:
 
     def heartbeat(self, vm_id: str) -> None:
         try:
+            # naturally idempotent: safe to retry bare on transient statuses
             resp = self._client.call("Heartbeat", {
-                "vm_id": vm_id, "token": _token_value(self._token)})
+                "vm_id": vm_id, "token": _token_value(self._token)},
+                retry=True)
             if resp and resp.get("token") and isinstance(self._token,
                                                          WorkerToken):
                 # control plane reissued our credential (half-life refresh)
@@ -434,10 +441,21 @@ class RpcChannelsClient:
 
 class RpcWorkflowClient:
     """SDK-side client with the WorkflowService method surface; plug into
-    ``RemoteRuntime(client=...)`` for a fully remote deployment."""
+    ``RemoteRuntime(client=...)`` for a fully remote deployment.
+
+    Retry policy (reference ``pylzy/lzy/utils/grpc.py:240``): reads retry
+    bare on transient statuses; mutations carry a fresh idempotency key per
+    logical request — stable across its retries — so a lost reply never
+    double-applies (server dedup: ``workflow_service._idempotent``)."""
 
     def __init__(self, address: str):
         self._client = JsonRpcClient(address)
+
+    @staticmethod
+    def _idem_key() -> str:
+        import uuid
+
+        return uuid.uuid4().hex
 
     def start_workflow(self, user, workflow_name, storage_uri,
                        execution_id=None, *, token=None, client_version=None):
@@ -445,36 +463,38 @@ class RpcWorkflowClient:
             "user": user, "workflow_name": workflow_name,
             "storage_uri": storage_uri, "execution_id": execution_id,
             "token": token, "client_version": client_version,
-        })["execution_id"]
+        }, idempotency_key=self._idem_key())["execution_id"]
 
     def finish_workflow(self, execution_id, *, token=None):
         self._client.call("FinishWorkflow", {"execution_id": execution_id,
-                                             "token": token})
+                                             "token": token},
+                          idempotency_key=self._idem_key())
 
     def abort_workflow(self, execution_id, *, token=None):
         self._client.call("AbortWorkflow", {"execution_id": execution_id,
-                                            "token": token})
+                                            "token": token},
+                          idempotency_key=self._idem_key())
 
     def execute_graph(self, execution_id, graph_doc, *, token=None):
         return self._client.call("ExecuteGraph", {
             "execution_id": execution_id, "graph": graph_doc, "token": token,
-        })["graph_op_id"]
+        }, idempotency_key=self._idem_key())["graph_op_id"]
 
     def graph_status(self, execution_id, graph_op_id, *, token=None):
         return self._client.call("GraphStatus", {
             "execution_id": execution_id, "graph_op_id": graph_op_id,
             "token": token,
-        })
+        }, retry=True)
 
     def stop_graph(self, execution_id, graph_op_id, *, token=None):
         self._client.call("StopGraph", {
             "execution_id": execution_id, "graph_op_id": graph_op_id,
             "token": token,
-        })
+        }, idempotency_key=self._idem_key())
 
     def get_pool_specs(self):
         pools = []
-        for doc in self._client.call("GetPoolSpecs")["pools"]:
+        for doc in self._client.call("GetPoolSpecs", retry=True)["pools"]:
             kind = doc.pop("kind")
             doc["zones"] = tuple(doc.get("zones", ()))
             pools.append(TpuPoolSpec(**doc) if kind == "tpu" else VmSpec(**doc))
@@ -484,7 +504,7 @@ class RpcWorkflowClient:
         return self._client.call("ReadStdLogs", {
             "execution_id": execution_id, "offsets": offsets or {},
             "token": token,
-        })["logs"]
+        }, retry=True)["logs"]
 
     # -- debug surface (only served when the plane enables debug=True) ---------
 

@@ -15,6 +15,7 @@ re-raise the matching Python exception.
 from __future__ import annotations
 
 import json
+import time
 from concurrent import futures
 from typing import Any, Callable, Dict, Optional
 
@@ -27,11 +28,20 @@ _LOG = get_logger(__name__)
 _SERVICE = "lzy.Rpc"
 
 
+class Unavailable(ConnectionError):
+    """Transient transport failure (gRPC UNAVAILABLE): the request may or may
+    not have been applied. Safe to retry only with an idempotency key (the
+    reference retries these in ``pylzy/lzy/utils/grpc.py:240`` and dedups
+    server-side via ``IdempotencyUtils``)."""
+
+
 def _codes(e: BaseException) -> grpc.StatusCode:
     from lzy_tpu.iam import AuthError
 
     if isinstance(e, AuthError):
         return grpc.StatusCode.PERMISSION_DENIED
+    if isinstance(e, Unavailable):
+        return grpc.StatusCode.UNAVAILABLE
     if isinstance(e, KeyError):
         return grpc.StatusCode.NOT_FOUND
     if isinstance(e, TimeoutError):
@@ -99,27 +109,57 @@ class JsonRpcServer:
         self._server.stop(grace)
 
 
+#: gRPC statuses worth a client-side retry: the server either never saw the
+#: request (UNAVAILABLE) or may still be applying it (DEADLINE_EXCEEDED).
+_TRANSIENT = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED)
+
+
 class JsonRpcClient:
-    def __init__(self, address: str, *, timeout_s: float = 60.0):
+    def __init__(self, address: str, *, timeout_s: float = 60.0,
+                 max_attempts: int = 4, backoff_base_s: float = 0.1,
+                 backoff_cap_s: float = 2.0):
         self._channel = grpc.insecure_channel(address)
         self._timeout_s = timeout_s
         self._address = address
+        self._max_attempts = max_attempts
+        self._backoff_base_s = backoff_base_s
+        self._backoff_cap_s = backoff_cap_s
 
     def call(self, method: str, payload: Optional[dict] = None,
-             timeout_s: Optional[float] = None) -> dict:
+             timeout_s: Optional[float] = None, *, retry: bool = False,
+             idempotency_key: Optional[str] = None) -> dict:
+        """One unary call. ``retry=True`` enables exponential backoff on
+        transient statuses — pass it bare only for naturally idempotent
+        methods (reads, heartbeats); for mutations pass ``idempotency_key``
+        (stable across the retries of one logical request) so the server
+        dedups a request whose first reply was lost (reference
+        ``pylzy/lzy/utils/grpc.py:240`` + ``IdempotencyUtils.java``)."""
+        payload = dict(payload or {})
+        if idempotency_key is not None:
+            payload["idempotency_key"] = idempotency_key
+            retry = True
         fn = self._channel.unary_unary(
             f"/{_SERVICE}/{method}",
             request_serializer=None,
             response_deserializer=None,
         )
-        try:
-            raw = fn(
-                json.dumps(payload or {}).encode("utf-8"),
-                timeout=timeout_s or self._timeout_s,
-            )
-        except grpc.RpcError as e:
-            raise _to_exception(e) from None
-        return json.loads(raw.decode("utf-8")) if raw else {}
+        request = json.dumps(payload).encode("utf-8")
+        attempts = self._max_attempts if retry else 1
+        delay = self._backoff_base_s
+        for attempt in range(1, attempts + 1):
+            try:
+                raw = fn(request, timeout=timeout_s or self._timeout_s)
+                return json.loads(raw.decode("utf-8")) if raw else {}
+            except grpc.RpcError as e:
+                if attempt < attempts and e.code() in _TRANSIENT:
+                    _LOG.info("rpc %s transient %s (attempt %d/%d); retrying "
+                              "in %.2fs", method, e.code().name, attempt,
+                              attempts, delay)
+                    time.sleep(delay)
+                    delay = min(delay * 2, self._backoff_cap_s)
+                    continue
+                raise _to_exception(e) from None
+        raise AssertionError("unreachable")
 
     def close(self) -> None:
         self._channel.close()
@@ -132,6 +172,8 @@ def _to_exception(e: grpc.RpcError) -> BaseException:
         from lzy_tpu.iam import AuthError
 
         return AuthError(detail)
+    if code == grpc.StatusCode.UNAVAILABLE:
+        return Unavailable(detail)
     if code == grpc.StatusCode.NOT_FOUND:
         return KeyError(detail)
     if code == grpc.StatusCode.DEADLINE_EXCEEDED:

@@ -175,10 +175,14 @@ VM = Schema("Vm", {
 })
 
 _TOKEN = {"token": f(str, sensitive=True)}
+# mutating requests carry a client-generated dedup key (IdempotencyUtils
+# parity); stable across one logical request's retries
+_IDEM = {"idempotency_key": f(str)}
 
 # request schemas per RPC method (ControlPlaneServer handler map)
 REQUESTS: Dict[str, Schema] = {
     "StartWorkflow": Schema("StartWorkflowRequest", {
+        **_IDEM,
         "user": f(str),
         "workflow_name": f(str, required=True),
         "storage_uri": f(str, required=True),
@@ -187,10 +191,13 @@ REQUESTS: Dict[str, Schema] = {
         **_TOKEN,
     }),
     "FinishWorkflow": Schema("FinishWorkflowRequest", {
+        **_IDEM,
         "execution_id": f(str, required=True), **_TOKEN}),
     "AbortWorkflow": Schema("AbortWorkflowRequest", {
+        **_IDEM,
         "execution_id": f(str, required=True), **_TOKEN}),
     "ExecuteGraph": Schema("ExecuteGraphRequest", {
+        **_IDEM,
         "execution_id": f(str, required=True),
         "graph": f(dict, required=True, nested=GRAPH_DESC),
         **_TOKEN,
@@ -199,6 +206,7 @@ REQUESTS: Dict[str, Schema] = {
         "execution_id": f(str, required=True),
         "graph_op_id": f(str, required=True), **_TOKEN}),
     "StopGraph": Schema("StopGraphRequest", {
+        **_IDEM,
         "execution_id": f(str, required=True),
         "graph_op_id": f(str, required=True), **_TOKEN}),
     "GetPoolSpecs": Schema("GetPoolSpecsRequest", {}),

@@ -30,6 +30,21 @@ FINISHED = "FINISHED"
 ABORTED = "ABORTED"
 
 
+def _replay_error(recorded: str) -> BaseException:
+    """Recorded failure ("TypeName: message") → the matching exception type,
+    so a replayed outcome maps to the same gRPC status as the original
+    (KeyError → NOT_FOUND, AuthError → PERMISSION_DENIED, ...)."""
+    from lzy_tpu.iam import AuthError
+
+    type_name, _, message = recorded.partition(": ")
+    types = {t.__name__: t for t in
+             (AuthError, KeyError, TimeoutError, ValueError, RuntimeError)}
+    exc_type = types.get(type_name)
+    if exc_type is None:
+        return RuntimeError(recorded)
+    return exc_type(message or recorded)
+
+
 def _parse_version(v: str):
     try:
         return tuple(int(x) for x in v.split("."))
@@ -87,12 +102,69 @@ class WorkflowService:
             owner = self._execution(execution_id).get("user")
         self._iam.authorize(subject, permission, resource_owner=owner)
 
+    # -- idempotent mutations (IdempotencyUtils parity) ------------------------
+
+    def _idempotent(self, key: Optional[str], kind: str, fn,
+                    wait_s: float = 10.0):
+        """Run ``fn`` exactly once per idempotency key. A duplicate request
+        (same key — e.g. a client retry after a lost reply) replays the
+        recorded outcome instead of re-executing; a concurrent duplicate
+        waits briefly for the first to finish. Mirrors the reference's
+        server-side dedup (``IdempotencyUtils.java``) over the store's
+        UNIQUE idempotency index (``durable/store.py:34``)."""
+        if key is None:
+            return fn()
+        from lzy_tpu.durable.store import RUNNING
+
+        op_id = gen_id(f"idem-{kind}")
+        rec = self._store.create(op_id, f"idem.{kind}", {},
+                                 idempotency_key=key)
+        if rec.id == op_id:                       # we own the key: execute
+            try:
+                result = fn()
+            except BaseException as e:            # noqa: BLE001 — replayed
+                self._store.fail(op_id, f"{type(e).__name__}: {e}")
+                raise
+            self._store.complete(op_id, result)
+            return result
+        if rec.kind != f"idem.{kind}":
+            # a key reused across different methods must not silently replay
+            # the other call's result as this call's (reference
+            # IdempotencyUtils rejects mismatched duplicates the same way)
+            raise ValueError(
+                f"idempotency key {key!r} was already used for "
+                f"{rec.kind.removeprefix('idem.')!r}, not {kind!r}")
+        deadline = time.time() + wait_s
+        while rec.status == RUNNING and time.time() < deadline:
+            time.sleep(0.05)
+            rec = self._store.load(rec.id)
+        if rec.status == RUNNING:
+            raise RuntimeError(
+                f"request with idempotency key {key!r} still in flight")
+        if rec.error is not None:
+            raise _replay_error(rec.error)
+        _LOG.info("idempotent replay of %s (key %s)", kind, key)
+        return rec.result
+
     # -- workflow lifecycle (startWorkflow/finishWorkflow/abortWorkflow) -------
 
     def start_workflow(self, user: str, workflow_name: str, storage_uri: str,
                        execution_id: Optional[str] = None, *,
                        token: Optional[str] = None,
-                       client_version: Optional[str] = None) -> str:
+                       client_version: Optional[str] = None,
+                       idempotency_key: Optional[str] = None) -> str:
+        return self._idempotent(
+            idempotency_key, "start_workflow",
+            lambda: self._start_workflow(
+                user, workflow_name, storage_uri, execution_id,
+                token=token, client_version=client_version,
+            ),
+        )
+
+    def _start_workflow(self, user: str, workflow_name: str, storage_uri: str,
+                        execution_id: Optional[str] = None, *,
+                        token: Optional[str] = None,
+                        client_version: Optional[str] = None) -> str:
         from lzy_tpu.iam import WORKFLOW_RUN
 
         self._check_version(client_version)
@@ -119,18 +191,22 @@ class WorkflowService:
         return execution_id
 
     def finish_workflow(self, execution_id: str, *,
-                        token: Optional[str] = None) -> None:
+                        token: Optional[str] = None,
+                        idempotency_key: Optional[str] = None) -> None:
         from lzy_tpu.iam import WORKFLOW_MANAGE
 
         self._authz(token, WORKFLOW_MANAGE, execution_id)
-        self._teardown(execution_id, FINISHED)
+        self._idempotent(idempotency_key, "finish_workflow",
+                         lambda: self._teardown(execution_id, FINISHED))
 
     def abort_workflow(self, execution_id: str, *,
-                       token: Optional[str] = None) -> None:
+                       token: Optional[str] = None,
+                       idempotency_key: Optional[str] = None) -> None:
         from lzy_tpu.iam import WORKFLOW_MANAGE
 
         self._authz(token, WORKFLOW_MANAGE, execution_id)
-        self._abort(execution_id)
+        self._idempotent(idempotency_key, "abort_workflow",
+                         lambda: self._abort(execution_id))
 
     def _abort(self, execution_id: str) -> None:
         exec_doc = self._execution(execution_id)
@@ -158,10 +234,18 @@ class WorkflowService:
     # -- graphs (executeGraph/graphStatus/stopGraph) ---------------------------
 
     def execute_graph(self, execution_id: str, graph_doc: Dict[str, Any], *,
-                      token: Optional[str] = None) -> Optional[str]:
+                      token: Optional[str] = None,
+                      idempotency_key: Optional[str] = None) -> Optional[str]:
         """Compile + run a graph. Returns the graph op id, or None when every
         task was satisfied from cache ("Results of all graph operations are
         cached", ``remote/runtime.py:170-172``)."""
+        return self._idempotent(
+            idempotency_key, "execute_graph",
+            lambda: self._execute_graph(execution_id, graph_doc, token=token),
+        )
+
+    def _execute_graph(self, execution_id: str, graph_doc: Dict[str, Any], *,
+                       token: Optional[str] = None) -> Optional[str]:
         from lzy_tpu.iam import WORKFLOW_RUN
 
         self._authz(token, WORKFLOW_RUN, execution_id)
@@ -212,11 +296,13 @@ class WorkflowService:
         return self._ge.status(graph_op_id)
 
     def stop_graph(self, execution_id: str, graph_op_id: str, *,
-                   token: Optional[str] = None) -> None:
+                   token: Optional[str] = None,
+                   idempotency_key: Optional[str] = None) -> None:
         from lzy_tpu.iam import WORKFLOW_MANAGE
 
         self._authz(token, WORKFLOW_MANAGE, execution_id)
-        self._ge.stop(graph_op_id)
+        self._idempotent(idempotency_key, "stop_graph",
+                         lambda: self._ge.stop(graph_op_id))
 
     # -- GC (lzy-service GarbageCollector parity: reap abandoned executions) ---
 

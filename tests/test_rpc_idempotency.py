@@ -1,0 +1,224 @@
+"""Client retry + idempotency keys on mutating RPCs (VERDICT r2 #4).
+
+The reference retries transient statuses everywhere
+(``pylzy/lzy/utils/grpc.py:240``) and dedups server-side
+(``IdempotencyUtils.java``). The critical case: the server COMMITS a
+mutation but the reply is lost — the client's retry must not double-apply.
+"""
+
+import threading
+import time
+import types
+
+import pytest
+
+from lzy_tpu.rpc.control import ControlPlaneServer, RpcWorkflowClient
+from lzy_tpu.rpc.core import JsonRpcClient, JsonRpcServer, Unavailable
+from lzy_tpu.service import InProcessCluster
+
+
+class ReplyLoss:
+    """Service proxy: named methods COMMIT, then the reply is dropped
+    (UNAVAILABLE) for the first ``n`` calls — the lost-reply window."""
+
+    def __init__(self, target, methods, n=1):
+        self._target = target
+        self._drop = {m: n for m in methods}
+        self.calls = {m: 0 for m in methods}
+
+    def __getattr__(self, name):
+        attr = getattr(self._target, name)
+        if name not in self._drop or not callable(attr):
+            return attr
+
+        def wrapped(*args, **kwargs):
+            self.calls[name] += 1
+            result = attr(*args, **kwargs)
+            if self._drop[name] > 0:
+                self._drop[name] -= 1
+                raise Unavailable("injected reply loss after commit")
+            return result
+
+        return wrapped
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = InProcessCluster(
+        db_path=str(tmp_path / "meta.db"),
+        storage_uri=f"file://{tmp_path}/storage",
+        poll_period_s=0.05,
+    )
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture()
+def flaky_plane(cluster):
+    """Control plane whose workflow service commits, then loses the first
+    reply of each listed mutation."""
+    flaky = ReplyLoss(cluster.workflow_service,
+                      ["start_workflow", "finish_workflow"])
+    ns = types.SimpleNamespace(
+        workflow_service=flaky,
+        channels=cluster.channels,
+        allocator=cluster.allocator,
+        iam=cluster.iam,
+        store=cluster.store,
+    )
+    server = ControlPlaneServer(ns)
+    client = RpcWorkflowClient(server.address)
+    yield cluster, flaky, client
+    client.close()
+    server.stop()
+
+
+class TestExactlyOnce:
+    def test_lost_reply_does_not_double_start(self, flaky_plane):
+        cluster, flaky, client = flaky_plane
+        execution_id = client.start_workflow(
+            "user", "wf", cluster.storage_uri,
+            client_version="99.0.0",
+        )
+        # the server ran the mutation twice over the wire, but the second
+        # call replayed the first outcome: one execution, one session
+        assert flaky.calls["start_workflow"] == 2
+        executions = cluster.store.kv_list("executions")
+        assert list(executions) == [execution_id]
+        sessions = cluster.store.kv_list("sessions")
+        assert len(sessions) == 1
+
+        # finish: same lost-reply window; teardown must run exactly once
+        client.finish_workflow(execution_id)
+        assert flaky.calls["finish_workflow"] == 2
+        doc = cluster.store.kv_get("executions", execution_id)
+        assert doc["status"] == "FINISHED"
+        assert cluster.store.kv_list("sessions") == {}
+
+    def test_failures_replay_not_rerun(self, cluster):
+        svc = cluster.workflow_service
+        runs = {"n": 0}
+        orig = svc._start_workflow
+
+        def counting(*args, **kwargs):
+            runs["n"] += 1
+            return orig(*args, **kwargs)
+
+        svc._start_workflow = counting
+        try:
+            with pytest.raises(RuntimeError, match="unsupported client"):
+                svc.start_workflow("u", "wf", cluster.storage_uri,
+                                   client_version="0.0.1",
+                                   idempotency_key="k-fail")
+            # the retry with the same key replays the recorded error without
+            # re-executing (exactly-once also for failed outcomes)
+            with pytest.raises(RuntimeError, match="unsupported client"):
+                svc.start_workflow("u", "wf", cluster.storage_uri,
+                                   client_version="0.0.1",
+                                   idempotency_key="k-fail")
+        finally:
+            svc._start_workflow = orig
+        assert runs["n"] == 1
+        assert cluster.store.kv_list("executions") == {}
+
+    def test_replayed_error_keeps_its_type(self, cluster):
+        svc = cluster.workflow_service
+        # KeyError (NOT_FOUND over the wire) must replay as KeyError, not a
+        # generic RuntimeError that would surface as INTERNAL
+        with pytest.raises(KeyError):
+            svc.finish_workflow("no-such-exec", idempotency_key="k-nf")
+        with pytest.raises(KeyError):
+            svc.finish_workflow("no-such-exec", idempotency_key="k-nf")
+
+    def test_key_reuse_across_methods_rejected(self, cluster):
+        svc = cluster.workflow_service
+        execution_id = svc.start_workflow(
+            "u", "wf", cluster.storage_uri, client_version="99.0.0",
+            idempotency_key="k-reuse")
+        with pytest.raises(ValueError, match="already used"):
+            svc.finish_workflow(execution_id, idempotency_key="k-reuse")
+
+    def test_concurrent_duplicate_waits_for_first(self, cluster):
+        svc = cluster.workflow_service
+        release = threading.Event()
+        results = []
+
+        def slow():
+            release.wait(5.0)
+            return "slow-result"
+
+        t = threading.Thread(
+            target=lambda: results.append(
+                svc._idempotent("k-conc", "probe", slow)),
+            daemon=True,
+        )
+        t.start()
+        time.sleep(0.1)
+        # duplicate arrives while the first is in flight: it must wait and
+        # then replay the first result, not run `slow` again
+        dup = threading.Thread(
+            target=lambda: results.append(
+                svc._idempotent("k-conc", "probe", lambda: "dup-ran")),
+            daemon=True,
+        )
+        dup.start()
+        time.sleep(0.1)
+        release.set()
+        t.join(5.0)
+        dup.join(5.0)
+        assert results == ["slow-result", "slow-result"]
+
+
+class TestTransportRetry:
+    def test_reads_retry_transient_then_succeed(self):
+        hits = {"n": 0}
+
+        def handler(p):
+            hits["n"] += 1
+            if hits["n"] < 3:
+                raise Unavailable("backend hiccup")
+            return {"ok": True}
+
+        server = JsonRpcServer({"Probe": handler})
+        client = JsonRpcClient(server.address, backoff_base_s=0.01)
+        try:
+            assert client.call("Probe", retry=True) == {"ok": True}
+            assert hits["n"] == 3
+        finally:
+            client.close()
+            server.stop()
+
+    def test_mutations_without_key_do_not_retry(self):
+        hits = {"n": 0}
+
+        def handler(p):
+            hits["n"] += 1
+            raise Unavailable("down")
+
+        server = JsonRpcServer({"Mutate": handler})
+        client = JsonRpcClient(server.address, backoff_base_s=0.01)
+        try:
+            with pytest.raises(Unavailable):
+                client.call("Mutate")
+            assert hits["n"] == 1
+        finally:
+            client.close()
+            server.stop()
+
+    def test_idempotency_key_rides_the_payload(self):
+        seen = []
+
+        def handler(p):
+            seen.append(p.get("idempotency_key"))
+            if len(seen) == 1:
+                raise Unavailable("reply lost")
+            return {}
+
+        server = JsonRpcServer({"Mutate": handler})
+        client = JsonRpcClient(server.address, backoff_base_s=0.01)
+        try:
+            client.call("Mutate", idempotency_key="stable-key")
+            assert seen == ["stable-key", "stable-key"]
+        finally:
+            client.close()
+            server.stop()
