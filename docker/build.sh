@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Build the lzy-tpu images. Run from anywhere; builds from the repo root.
+#
+#   docker/build.sh                 # lzy-tpu-worker + lzy-tpu-control :latest
+#   TAG=v0.3 docker/build.sh        # custom tag
+#   REGISTRY=gcr.io/proj docker/build.sh   # prefix + push-ready names
+set -euo pipefail
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+TAG="${TAG:-latest}"
+PREFIX="${REGISTRY:+${REGISTRY}/}"
+
+docker build -f "$ROOT/docker/Dockerfile.worker" \
+    -t "${PREFIX}lzy-tpu-worker:${TAG}" "$ROOT"
+docker build -f "$ROOT/docker/Dockerfile.controlplane" \
+    -t "${PREFIX}lzy-tpu-control:${TAG}" "$ROOT"
+
+echo "built: ${PREFIX}lzy-tpu-worker:${TAG} ${PREFIX}lzy-tpu-control:${TAG}"
+if [ -n "${REGISTRY:-}" ] && [ "${PUSH:-0}" = "1" ]; then
+    docker push "${PREFIX}lzy-tpu-worker:${TAG}"
+    docker push "${PREFIX}lzy-tpu-control:${TAG}"
+fi

@@ -42,11 +42,16 @@ class Lzy(WithEnvironmentMixin):
         storage_registry: Optional[StorageRegistry] = None,
         serializer_registry: Optional[SerializerRegistry] = None,
         env: Optional[LzyEnvironment] = None,
+        whiteboard_client=None,
     ):
         self.env = env or LzyEnvironment()
         self._runtime = runtime or self._default_runtime()
         self._storage_registry = storage_registry or self._default_storage()
         self._serializer_registry = serializer_registry or default_registry()
+        # remote deployments route whiteboards through the control plane's
+        # IAM-guarded surface (rpc.RpcWhiteboardClient) instead of straight
+        # to storage; local single-tenant mode keeps the storage-native index
+        self._whiteboard_client = whiteboard_client
 
     @staticmethod
     def _default_runtime() -> Runtime:

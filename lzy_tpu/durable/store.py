@@ -148,6 +148,35 @@ class OperationStore:
             )
             self._conn.commit()
 
+    def reclaim(self, op_id: str, old_deadline: Optional[float],
+                new_deadline: float) -> bool:
+        """Atomically take over a RUNNING op whose deadline passed (its
+        creator crashed mid-flight): compare-and-swap on the deadline so
+        exactly one contender wins. Returns True when this caller now owns
+        the op."""
+        with self._lock:
+            cur = self._conn.execute(
+                "UPDATE operations SET deadline = ?, updated_at = ? "
+                "WHERE id = ? AND status = ? AND deadline IS ?",
+                (new_deadline, time.time(), op_id, RUNNING, old_deadline),
+            )
+            self._conn.commit()
+            return cur.rowcount == 1
+
+    def purge_done_ops(self, kind_prefix: str, older_than_s: float) -> int:
+        """Delete DONE/FAILED ops of the given kind prefix not updated for
+        ``older_than_s`` — retention for high-churn records (idempotency
+        dedup rows); returns rows deleted."""
+        cutoff = time.time() - older_than_s
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM operations WHERE kind LIKE ? "
+                "AND status IN (?, ?) AND updated_at < ?",
+                (kind_prefix + "%", DONE, FAILED, cutoff),
+            )
+            self._conn.commit()
+            return cur.rowcount
+
     def running_ops(self) -> List[OpRecord]:
         with self._lock:
             rows = self._conn.execute(

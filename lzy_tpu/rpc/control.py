@@ -67,6 +67,14 @@ def _token_value(token) -> Optional[str]:
     return token()
 
 
+def _parse_dt(value: Optional[str]):
+    if value is None:
+        return None
+    import datetime
+
+    return datetime.datetime.fromisoformat(value)
+
+
 # -- server ---------------------------------------------------------------------
 
 
@@ -247,6 +255,31 @@ class ControlPlaneServer:
             # status surface (CLI --address / console over RPC)
             "GetStatus": h_get_status,
         }
+        whiteboards = getattr(cluster, "whiteboard_service", None)
+        if whiteboards is not None:
+            def wb_doc(m):
+                return m.doc
+
+            handlers.update({
+                # whiteboard surface (reference WhiteboardService.java:45;
+                # per-call IAM enforcement lives in service/whiteboard_service)
+                "WhiteboardRegister": lambda p: {"manifest": wb_doc(
+                    whiteboards.register(
+                        wb_id=p["wb_id"], name=p["name"],
+                        tags=p.get("tags") or (), token=p.get("token")))},
+                "WhiteboardFinalize": lambda p: whiteboards.finalize(
+                    p["wb_id"], p["fields"], token=p.get("token")),
+                "WhiteboardGet": lambda p: {"manifest": wb_doc(
+                    whiteboards.get(id_=p.get("wb_id"),
+                                    storage_uri=p.get("storage_uri"),
+                                    token=p.get("token")))},
+                "WhiteboardQuery": lambda p: {"manifests": [
+                    wb_doc(m) for m in whiteboards.query(
+                        name=p.get("name"), tags=p.get("tags") or (),
+                        not_before=_parse_dt(p.get("not_before")),
+                        not_after=_parse_dt(p.get("not_after")),
+                        token=p.get("token"))]},
+            })
         if debug:
             def _dbg(fn):
                 def handler(p):
@@ -506,6 +539,13 @@ class RpcWorkflowClient:
             "token": token,
         }, retry=True)["logs"]
 
+    # -- whiteboards (reference RemoteWhiteboardIndexClient parity) ------------
+
+    def whiteboard_client(self, token=None) -> "RpcWhiteboardClient":
+        """A whiteboard index client sharing this connection; plug into
+        ``Lzy(whiteboard_client=...)``."""
+        return RpcWhiteboardClient(client=self._client, token=token)
+
     # -- debug surface (only served when the plane enables debug=True) ---------
 
     def arm_failure(self, point: str, n_hits: int = 1, *, token=None):
@@ -526,3 +566,68 @@ class RpcWorkflowClient:
 
     def close(self) -> None:
         self._client.close()
+
+
+class RpcWhiteboardClient:
+    """Method-compatible with the ``WhiteboardIndex`` surface the SDK uses
+    (register/finalize/get/query — field URIs come from the returned
+    manifest's ``base_uri``), but every call goes through the control
+    plane's IAM-guarded whiteboard surface instead of straight to storage — the
+    remote-deployment analog of the reference's
+    ``RemoteWhiteboardIndexClient`` (``pylzy/lzy/whiteboards/index.py:48``)
+    against ``WhiteboardService.java:45``."""
+
+    def __init__(self, address: Optional[str] = None, *, token=None,
+                 client: Optional[JsonRpcClient] = None):
+        if client is None:
+            if address is None:
+                raise ValueError("pass address or client")
+            client = JsonRpcClient(address)
+            self._owns_client = True
+        else:
+            self._owns_client = False
+        self._client = client
+        self._token = token
+
+    def _manifest(self, doc):
+        from lzy_tpu.whiteboards.index import WhiteboardManifest
+
+        return WhiteboardManifest(doc)
+
+    def register(self, *, wb_id: str, name: str, tags=(), owner: str = ""):
+        # owner is ignored on purpose: in remote mode the CONTROL PLANE
+        # assigns ownership from the authenticated token, never the client
+        # retry bare: re-registering the same client-generated wb_id just
+        # rewrites the same manifest (naturally idempotent), same for finalize
+        doc = self._client.call("WhiteboardRegister", {
+            "wb_id": wb_id, "name": name, "tags": list(tags),
+            "token": _token_value(self._token),
+        }, retry=True)["manifest"]
+        return self._manifest(doc)
+
+    def finalize(self, wb_id: str, fields) -> None:
+        self._client.call("WhiteboardFinalize", {
+            "wb_id": wb_id, "fields": fields,
+            "token": _token_value(self._token),
+        }, retry=True)
+
+    def get(self, *, id_: Optional[str] = None,
+            storage_uri: Optional[str] = None):
+        doc = self._client.call("WhiteboardGet", {
+            "wb_id": id_, "storage_uri": storage_uri,
+            "token": _token_value(self._token),
+        }, retry=True)["manifest"]
+        return self._manifest(doc)
+
+    def query(self, *, name=None, tags=(), not_before=None, not_after=None):
+        docs = self._client.call("WhiteboardQuery", {
+            "name": name, "tags": list(tags),
+            "not_before": not_before.isoformat() if not_before else None,
+            "not_after": not_after.isoformat() if not_after else None,
+            "token": _token_value(self._token),
+        }, retry=True)["manifests"]
+        return [self._manifest(d) for d in docs]
+
+    def close(self) -> None:
+        if self._owns_client:
+            self._client.close()

@@ -250,6 +250,22 @@ REQUESTS: Dict[str, Schema] = {
         "read_only": f(bool), **_TOKEN}),
     "Unmount": Schema("UnmountRequest", {
         "name": f(str, required=True), **_TOKEN}),
+    # whiteboard surface (WhiteboardService parity)
+    "WhiteboardRegister": Schema("WhiteboardRegisterRequest", {
+        "wb_id": f(str, required=True),
+        "name": f(str, required=True),
+        "tags": f(list), **_TOKEN}),
+    "WhiteboardFinalize": Schema("WhiteboardFinalizeRequest", {
+        "wb_id": f(str, required=True),
+        "fields": f(dict, required=True), **_TOKEN}),
+    "WhiteboardGet": Schema("WhiteboardGetRequest", {
+        "wb_id": f(str),
+        "storage_uri": f(str), **_TOKEN}),
+    "WhiteboardQuery": Schema("WhiteboardQueryRequest", {
+        "name": f(str),
+        "tags": f(list),
+        "not_before": f(str),
+        "not_after": f(str), **_TOKEN}),
     # status surface
     "GetStatus": Schema("GetStatusRequest", {
         "view": f(str, required=True), **_TOKEN}),

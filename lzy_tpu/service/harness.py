@@ -56,6 +56,7 @@ class InProcessCluster:
         debug_rpc: bool = False,          # expose fault-injection over RPC
         gc_period_s: Optional[float] = None,   # background GC timer
         execution_ttl_s: float = 86_400.0,     # stale-execution reap age
+        backend=None,                     # explicit VmBackend (e.g. GKE)
     ):
         self._rpc_port = rpc_port
         self.storage_uri = storage_uri
@@ -65,7 +66,11 @@ class InProcessCluster:
         self.serializers = default_registry()
         self.storage_client = client_for(StorageConfig(uri=storage_uri))
         self.rpc_server = None
-        if worker_mode == "process":
+        if backend is not None:
+            # cloud deployments pass a ready backend (GkeTpuBackend) whose
+            # workers dial back over the network; worker_mode is ignored
+            self.backend = backend
+        elif worker_mode == "process":
             from lzy_tpu.service.backends import ProcessVmBackend
 
             if storage_uri.startswith("mem://"):
@@ -112,6 +117,12 @@ class InProcessCluster:
         self.workflow_service = WorkflowService(
             self.store, self.executor, self.allocator, self.channels,
             self.graph_executor, self.storage_client, iam=self.iam,
+        )
+        from lzy_tpu.service.whiteboard_service import WhiteboardService
+        from lzy_tpu.whiteboards.index import WhiteboardIndex
+
+        self.whiteboard_service = WhiteboardService(
+            WhiteboardIndex(self.storage_client, storage_uri), iam=self.iam,
         )
         self._debug_rpc = debug_rpc
         if worker_mode == "process":

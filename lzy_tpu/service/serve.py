@@ -1,0 +1,107 @@
+"""Control-plane server entrypoint (the deployable binary).
+
+What the reference runs as per-service Micronaut mains (``AllocatorMain``,
+``LzyService`` etc.) deploys here as ONE process: metadata store + durable
+executor + allocator + channel manager + graph executor + workflow service +
+whiteboard service, served over gRPC. The container image
+(``docker/Dockerfile.controlplane``) uses this as its entrypoint.
+
+Modes (``--backend``):
+- ``process`` (default): workers are OS processes on THIS host — the
+  single-machine distributed mode (docs/deployment.md §3);
+- ``gke``: workers are TPU pods created through the Kubernetes API
+  (``GkeTpuBackend``); requires ``--worker-image`` and
+  ``--advertise`` (the address pods dial back, e.g. the Service DNS name).
+
+Example (GKE):
+    python -m lzy_tpu.service.serve \\
+        --db /var/lzy/meta.db --storage-uri s3://bucket/lzy \\
+        --port 18700 --advertise lzy-control.lzy-tpu:18700 \\
+        --backend gke --worker-image gcr.io/proj/lzy-tpu-worker:latest \\
+        --with-iam
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m lzy_tpu.service.serve",
+        description="Serve the lzy-tpu control plane over gRPC.",
+    )
+    parser.add_argument("--db", default="/var/lzy/meta.db",
+                        help="metadata store path (SQLite)")
+    parser.add_argument("--storage-uri", required=True,
+                        help="durable storage root (s3:// or file://)")
+    parser.add_argument("--port", type=int, default=18700)
+    parser.add_argument("--backend", choices=("process", "gke"),
+                        default="process")
+    parser.add_argument("--worker-image", default=None,
+                        help="worker image for --backend gke "
+                             "(docker/Dockerfile.worker)")
+    parser.add_argument("--advertise", default=None,
+                        help="address workers dial back (pod Service DNS on "
+                             "gke; defaults to 127.0.0.1:<port>)")
+    parser.add_argument("--namespace", default="lzy-tpu")
+    parser.add_argument("--service-account", default=None)
+    parser.add_argument("--with-iam", action="store_true",
+                        help="enforce authentication (mint subjects with "
+                             "`python -m lzy_tpu auth`)")
+    parser.add_argument("--debug-rpc", action="store_true",
+                        help="expose the fault-injection surface (never in "
+                             "production)")
+    parser.add_argument("--gc-period-s", type=float, default=300.0)
+    args = parser.parse_args(argv)
+
+    from lzy_tpu.service import InProcessCluster
+
+    backend = None
+    if args.backend == "gke":
+        if not args.worker_image:
+            parser.error("--backend gke requires --worker-image")
+        from lzy_tpu.service.backends import GkeTpuBackend
+
+        backend = GkeTpuBackend(
+            control_address=args.advertise or f"127.0.0.1:{args.port}",
+            storage_uri=args.storage_uri,
+            image=args.worker_image,
+            namespace=args.namespace,
+            service_account=args.service_account,
+        )
+
+    cluster = InProcessCluster(
+        db_path=args.db,
+        storage_uri=args.storage_uri,
+        with_iam=args.with_iam,
+        backend=backend,
+        worker_mode="process" if backend is None else "thread",
+        rpc_port=args.port,
+        debug_rpc=args.debug_rpc,
+        gc_period_s=args.gc_period_s,
+    )
+    server = cluster.serve(args.port)
+    print(f"lzy-tpu control plane serving on {server.address} "
+          f"(backend={args.backend}, iam={'on' if args.with_iam else 'off'})",
+          flush=True)
+
+    stop = threading.Event()
+
+    def handle(signum, frame):
+        print(f"signal {signum}; shutting down", flush=True)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, handle)
+    signal.signal(signal.SIGINT, handle)
+    stop.wait()
+    cluster.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,29 +104,39 @@ class WorkflowService:
 
     # -- idempotent mutations (IdempotencyUtils parity) ------------------------
 
+    #: a RUNNING idempotency record older than this is an orphan (its
+    #: creator crashed between create and complete) and may be reclaimed
+    IDEM_INFLIGHT_TTL_S = 120.0
+
     def _idempotent(self, key: Optional[str], kind: str, fn,
                     wait_s: float = 10.0):
         """Run ``fn`` exactly once per idempotency key. A duplicate request
         (same key — e.g. a client retry after a lost reply) replays the
         recorded outcome instead of re-executing; a concurrent duplicate
-        waits briefly for the first to finish. Mirrors the reference's
+        waits briefly for the first to finish; a record orphaned RUNNING by
+        a control-plane crash is taken over (deadline CAS) so the retry
+        that follows a restart still succeeds. Mirrors the reference's
         server-side dedup (``IdempotencyUtils.java``) over the store's
         UNIQUE idempotency index (``durable/store.py:34``)."""
         if key is None:
             return fn()
         from lzy_tpu.durable.store import RUNNING
 
-        op_id = gen_id(f"idem-{kind}")
-        rec = self._store.create(op_id, f"idem.{kind}", {},
-                                 idempotency_key=key)
-        if rec.id == op_id:                       # we own the key: execute
+        def run_and_record(record_id: str):
             try:
                 result = fn()
             except BaseException as e:            # noqa: BLE001 — replayed
-                self._store.fail(op_id, f"{type(e).__name__}: {e}")
+                self._store.fail(record_id, f"{type(e).__name__}: {e}")
                 raise
-            self._store.complete(op_id, result)
+            self._store.complete(record_id, result)
             return result
+
+        op_id = gen_id(f"idem-{kind}")
+        rec = self._store.create(op_id, f"idem.{kind}", {},
+                                 idempotency_key=key,
+                                 deadline=time.time() + self.IDEM_INFLIGHT_TTL_S)
+        if rec.id == op_id:                       # we own the key: execute
+            return run_and_record(op_id)
         if rec.kind != f"idem.{kind}":
             # a key reused across different methods must not silently replay
             # the other call's result as this call's (reference
@@ -134,13 +144,21 @@ class WorkflowService:
             raise ValueError(
                 f"idempotency key {key!r} was already used for "
                 f"{rec.kind.removeprefix('idem.')!r}, not {kind!r}")
-        deadline = time.time() + wait_s
-        while rec.status == RUNNING and time.time() < deadline:
+        wait_deadline = time.time() + wait_s
+        while rec.status == RUNNING:
+            if rec.deadline is not None and time.time() > rec.deadline:
+                if self._store.reclaim(
+                        rec.id, rec.deadline,
+                        time.time() + self.IDEM_INFLIGHT_TTL_S):
+                    _LOG.warning(
+                        "taking over orphaned idempotent %s (key %s)",
+                        kind, key)
+                    return run_and_record(rec.id)
+            elif time.time() > wait_deadline:
+                raise RuntimeError(
+                    f"request with idempotency key {key!r} still in flight")
             time.sleep(0.05)
             rec = self._store.load(rec.id)
-        if rec.status == RUNNING:
-            raise RuntimeError(
-                f"request with idempotency key {key!r} still in flight")
         if rec.error is not None:
             raise _replay_error(rec.error)
         _LOG.info("idempotent replay of %s (key %s)", kind, key)
@@ -307,10 +325,18 @@ class WorkflowService:
     # -- GC (lzy-service GarbageCollector parity: reap abandoned executions) ---
 
     def gc_tick(self, *, ttl_s: float = 86_400.0,
+                idem_ttl_s: float = 86_400.0,
                 now: Optional[float] = None) -> List[str]:
         """Abort ACTIVE executions older than ``ttl_s`` (clients that died
-        without finish/abort). Returns reaped execution ids."""
+        without finish/abort). Returns reaped execution ids. Also reaps
+        settled idempotency-dedup rows older than ``idem_ttl_s`` — every
+        keyed mutation creates one, so without retention the store grows
+        one row per graph submission forever (the reference TTLs its
+        idempotency keys the same way)."""
         now = now if now is not None else time.time()
+        purged = self._store.purge_done_ops("idem.", idem_ttl_s)
+        if purged:
+            _LOG.info("gc purged %d settled idempotency records", purged)
         reaped = []
         for execution_id, doc in self._store.kv_list("executions").items():
             if doc.get("status") == ACTIVE and now - doc.get("started_at", now) > ttl_s:

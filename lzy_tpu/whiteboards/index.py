@@ -63,6 +63,13 @@ class WhiteboardManifest:
     def base_uri(self) -> str:
         return self.doc["base_uri"]
 
+    @property
+    def owner(self) -> str:
+        """Registering subject's id; "" for pre-IAM / single-tenant
+        whiteboards (treated as unowned — readable by any authenticated
+        subject)."""
+        return self.doc.get("owner", "")
+
 
 class WhiteboardIndex:
     def __init__(self, client: StorageClient, root_uri: str):
@@ -70,7 +77,12 @@ class WhiteboardIndex:
         self._root = join_uri(root_uri, "whiteboards")
 
     @classmethod
-    def for_lzy(cls, lzy: "Lzy") -> "WhiteboardIndex":
+    def for_lzy(cls, lzy: "Lzy"):
+        remote = getattr(lzy, "_whiteboard_client", None)
+        if remote is not None:
+            # remote deployment: every whiteboard call goes through the
+            # control plane's IAM-guarded surface, never straight to storage
+            return remote
         client = lzy.storage_registry.default_client()
         config = lzy.storage_registry.default_config()
         if client is None or config is None:
@@ -83,12 +95,14 @@ class WhiteboardIndex:
     def _manifest_uri(self, wb_id: str) -> str:
         return join_uri(self._root, wb_id, "manifest.json")
 
-    def register(self, *, wb_id: str, name: str, tags: Sequence[str]) -> WhiteboardManifest:
+    def register(self, *, wb_id: str, name: str, tags: Sequence[str],
+                 owner: str = "") -> WhiteboardManifest:
         doc = {
             "id": wb_id,
             "name": name,
             "status": CREATED,
             "tags": list(tags),
+            "owner": owner,
             "created_at": datetime.datetime.now(datetime.timezone.utc).isoformat(),
             "base_uri": self.base_uri(wb_id),
             "fields": {},
@@ -131,7 +145,8 @@ class WhiteboardIndex:
     def _write_index_records(self, doc: Dict[str, Any]) -> None:
         record = json.dumps({
             "id": doc["id"], "name": doc["name"], "status": doc["status"],
-            "tags": doc.get("tags", []), "created_at": doc["created_at"],
+            "tags": doc.get("tags", []), "owner": doc.get("owner", ""),
+            "created_at": doc["created_at"],
         }).encode("utf-8")
         for uri in self._index_uris(doc):
             self._client.write_bytes(uri, record)
@@ -163,10 +178,15 @@ class WhiteboardIndex:
 
     def query(self, *, name: Optional[str] = None, tags: Sequence[str] = (),
               not_before: Optional[datetime.datetime] = None,
-              not_after: Optional[datetime.datetime] = None) -> List[WhiteboardManifest]:
+              not_after: Optional[datetime.datetime] = None,
+              visible_to: Optional[str] = None) -> List[WhiteboardManifest]:
         """O(matches): list the narrowest index prefix (name > tag > all),
         prune time ranges on object names, filter remaining predicates on the
-        compact records, and read full manifests only for matches."""
+        compact records, and read full manifests only for matches.
+
+        ``visible_to``: restrict to whiteboards owned by that subject (or
+        unowned) — the enforcement hook for OWNER-scoped reads; filtering on
+        the compact record keeps the no-match case manifest-read-free."""
         # trailing "/" matters: list() is raw string-prefix on every backend,
         # so "name/foo" would also match "name/foobar/..."
         if name is not None:
@@ -196,6 +216,9 @@ class WhiteboardIndex:
                 continue
             record = json.loads(self._client.read_bytes(uri))
             if record.get("status") != FINALIZED:
+                continue
+            if (visible_to is not None
+                    and record.get("owner", "") not in ("", visible_to)):
                 continue
             # re-check every predicate on the record itself — the prefix is
             # routing, not authority

@@ -169,6 +169,38 @@ class TestExactlyOnce:
         assert results == ["slow-result", "slow-result"]
 
 
+class TestOrphanedRecords:
+    def test_crash_orphaned_running_record_is_taken_over(self, cluster):
+        """A record left RUNNING by a control-plane crash (created, never
+        completed) must not wedge its key forever: once its in-flight
+        deadline passes, the retry takes it over and executes."""
+        import time as _time
+
+        svc = cluster.workflow_service
+        # simulate the crash: record exists, RUNNING, deadline already past
+        cluster.store.create("idem-crashed", "idem.probe", {},
+                             idempotency_key="k-orphan",
+                             deadline=_time.time() - 1.0)
+        result = svc._idempotent("k-orphan", "probe", lambda: "recovered")
+        assert result == "recovered"
+        rec = cluster.store.load("idem-crashed")
+        assert rec.status == "DONE" and rec.result == "recovered"
+
+    def test_settled_idem_rows_are_gc_reaped(self, cluster):
+        svc = cluster.workflow_service
+        svc._idempotent("k-old", "probe", lambda: "x")
+        assert cluster.store.load is not None
+        # young rows survive, old rows go
+        assert svc.gc_tick(idem_ttl_s=3600.0) == []
+        rows = [r for r in cluster.store._conn.execute(
+            "SELECT id FROM operations WHERE kind LIKE 'idem.%'")]
+        assert len(rows) == 1
+        svc.gc_tick(idem_ttl_s=0.0)
+        rows = [r for r in cluster.store._conn.execute(
+            "SELECT id FROM operations WHERE kind LIKE 'idem.%'")]
+        assert rows == []
+
+
 class TestTransportRetry:
     def test_reads_retry_transient_then_succeed(self):
         hits = {"n": 0}
