@@ -150,22 +150,68 @@ class EnvRealizer:
                 return overlay
             os.makedirs(overlay, exist_ok=True)
             reqs = [f"{name}=={version}" for name, version, _ in mismatched]
-            _LOG.info("building env overlay %s: %s", overlay, reqs)
+            # resolve the full dependency closure first (a bare --no-deps of
+            # the mismatched list would drop a mismatched package's OWN new
+            # dependencies and import-error at op time — the exact failure
+            # the overlay exists to prevent), then overlay only what the
+            # host doesn't already satisfy, never the accelerator stack
+            to_install = self._closure_to_install(reqs)
+            if not to_install:
+                # closure resolved to host-provided/already-satisfied only
+                with open(marker, "w") as f:
+                    f.write(json.dumps(spec_doc))
+                return overlay
+            _LOG.info("building env overlay %s: %s", overlay, to_install)
             cmd = [
                 sys.executable, "-m", "pip", "install",
                 "--quiet", "--no-deps", "--target", overlay,
-                *self._pip_args, *reqs,
+                *self._pip_args, *to_install,
             ]
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
                 tail = (proc.stderr or proc.stdout or "").strip()[-2000:]
                 raise EnvBuildError(
                     f"pip could not build the op env overlay "
-                    f"({' '.join(reqs)}): {tail}"
+                    f"({' '.join(to_install)}): {tail}"
                 )
             with open(marker, "w") as f:
                 f.write(json.dumps(spec_doc))
             return overlay
+
+    def _closure_to_install(self, reqs: List[str]) -> List[str]:
+        """Resolve ``reqs`` + their transitive dependencies with pip's
+        resolver (``--dry-run --report``), then keep only what this host
+        does not already satisfy exactly; HOST_PROVIDED packages are never
+        overlaid regardless of what the closure says (the image's jax/libtpu
+        stay authoritative)."""
+        cmd = [
+            sys.executable, "-m", "pip", "install",
+            "--quiet", "--dry-run", "--report", "-", "--no-input",
+            *self._pip_args, *reqs,
+        ]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            tail = (proc.stderr or proc.stdout or "").strip()[-2000:]
+            raise EnvBuildError(
+                f"pip could not resolve the op env closure "
+                f"({' '.join(reqs)}): {tail}"
+            )
+        try:
+            report = json.loads(proc.stdout)
+        except ValueError as e:
+            raise EnvBuildError(
+                f"unparseable pip resolution report: {e}") from None
+        skip = {_norm(n) for n in HOST_PROVIDED}
+        out = []
+        for item in report.get("install", []):
+            meta = item.get("metadata", {})
+            name, version = meta.get("name"), meta.get("version")
+            if not name or not version or _norm(name) in skip:
+                continue
+            if installed_version(name) == version:
+                continue   # the host already satisfies this exact pin
+            out.append(f"{name}=={version}")
+        return sorted(out)
 
 
 class applied_overlay:

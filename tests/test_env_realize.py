@@ -26,17 +26,21 @@ TESTS_DIR = str(pathlib.Path(__file__).parent)
 PY_VERSION = "%d.%d" % sys.version_info[:2]
 
 
-def make_wheel(directory, name: str, version: str, body: str) -> str:
+def make_wheel(directory, name: str, version: str, body: str,
+               requires=()) -> str:
     """Handmade minimal wheel so pip can install fully offline
-    (``--no-index --find-links``)."""
+    (``--no-index --find-links``); ``requires`` become Requires-Dist
+    entries (for dependency-closure tests)."""
     mod = name.replace("-", "_")
     path = os.path.join(directory, f"{mod}-{version}-py3-none-any.whl")
     dist_info = f"{mod}-{version}.dist-info"
+    requires_lines = "".join(f"Requires-Dist: {r}\n" for r in requires)
     with zipfile.ZipFile(path, "w") as z:
         z.writestr(f"{mod}/__init__.py", body)
         z.writestr(
             f"{dist_info}/METADATA",
-            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n",
+            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n"
+            + requires_lines,
         )
         z.writestr(
             f"{dist_info}/WHEEL",
@@ -106,8 +110,63 @@ class TestOverlay:
         )
         doc = {"python_version": PY_VERSION,
                "packages": [["lzy-testpkg", "9.9"]]}
-        with pytest.raises(EnvBuildError, match="pip could not build"):
+        with pytest.raises(EnvBuildError, match="pip could not"):
             realizer.realize(doc)
+
+    def test_overlay_resolves_the_dependency_closure(self, tmp_path):
+        """VERDICT r2 #7: a mismatched package whose OWN dependency also
+        mismatches must arrive complete — the old --no-deps install dropped
+        the dependency and import-errored at op time."""
+        wheels = tmp_path / "wheels"
+        wheels.mkdir()
+        make_wheel(str(wheels), "lzy-deeplib", "1.5", "DEEP = 'deep-1.5'\n")
+        make_wheel(
+            str(wheels), "lzy-toplib", "2.0",
+            "from lzy_deeplib import DEEP\nTOP = 'top-2.0+' + DEEP\n",
+            requires=["lzy-deeplib"],
+        )
+        realizer = EnvRealizer(
+            str(tmp_path / "envs"),
+            pip_args=["--no-index", "--find-links", str(wheels)],
+        )
+        # the captured spec mentions only the package the op imported;
+        # its dependency must come in through resolution
+        doc = {"python_version": PY_VERSION,
+               "packages": [["lzy-toplib", "2.0"]]}
+        overlay = realizer.realize(doc)
+        assert overlay is not None
+        assert os.path.isdir(os.path.join(overlay, "lzy_toplib"))
+        assert os.path.isdir(os.path.join(overlay, "lzy_deeplib"))
+        with applied_overlay(overlay):
+            import lzy_toplib
+
+            assert lzy_toplib.TOP == "top-2.0+deep-1.5"
+        assert "lzy_toplib" not in sys.modules
+
+    def test_closure_never_overlays_the_accelerator_stack(self, tmp_path):
+        """Even when the closure RESOLVES jax (a dependency pin), the
+        overlay must not shadow the host's accelerator stack."""
+        import jax as host_jax
+
+        wheels = tmp_path / "wheels"
+        wheels.mkdir()
+        make_wheel(str(wheels), "jax", "0.0.1", "BOGUS = True\n")
+        make_wheel(
+            str(wheels), "lzy-jaxuser", "1.0", "USES_JAX = True\n",
+            requires=["jax"],
+        )
+        realizer = EnvRealizer(
+            str(tmp_path / "envs"),
+            pip_args=["--no-index", "--find-links", str(wheels)],
+        )
+        doc = {"python_version": PY_VERSION,
+               "packages": [["lzy-jaxuser", "1.0"]]}
+        overlay = realizer.realize(doc)
+        assert overlay is not None
+        assert os.path.isdir(os.path.join(overlay, "lzy_jaxuser"))
+        assert not os.path.isdir(os.path.join(overlay, "jax")), \
+            "host jax must never be shadowed by an overlay"
+        del host_jax
 
 
 # module-level ops: worker processes resolve them by reference
