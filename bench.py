@@ -48,6 +48,43 @@ def _scan_metric(out: str):
     return None, None
 
 
+def tcp_preflight() -> str | None:
+    """~1 ms relay-liveness check before any 120 s jax probe.
+
+    Round 4 pinned the init hang: the PJRT plugin blocks retrying
+    `GET http://127.0.0.1:8083/init` against ECONNREFUSED when the
+    relay/tunnel isn't running (tpu_evidence/DIAGNOSIS.md). A refused
+    loopback connect is definitive — same netns, nothing to time out —
+    so report it precisely instead of burning 4x120 s to say "hang".
+    Returns None when the preflight passes (port open, or this isn't
+    the relayed-axon environment), else the diagnosis string.
+    """
+    if os.environ.get("JAX_PLATFORMS") != "axon" or not os.environ.get(
+            "PALLAS_AXON_POOL_IPS"):
+        return None  # not the relayed environment; nothing to preflight
+    try:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools"))
+        from tpu_diag import RELAY_HOST, RELAY_PORTS, tcp_probe
+    except Exception:  # noqa: BLE001 — a tooling import must never kill bench
+        return None
+    port = RELAY_PORTS[0]
+    last = "unknown"
+    deadline = time.monotonic() + 60  # relay may be mid-restart; give it 60 s
+    while time.monotonic() < deadline:
+        status = tcp_probe(RELAY_HOST, port)["status"]
+        if status == "open":
+            return None
+        if status != "refused":
+            return None  # timeout/filtered: a listener may exist — probe on
+        last = "connection refused"
+        time.sleep(5)
+    return (f"relay not listening on {RELAY_HOST}:{port} ({last}) — the "
+            f"relay/tunnel process is not running on this host, so "
+            f"PJRT_Client_Create's GET /init can never succeed "
+            f"(see tpu_evidence/DIAGNOSIS.md)")
+
+
 def probe_backend() -> str | None:
     """Cheap relay probes before committing to a full measurement attempt.
 
@@ -55,11 +92,19 @@ def probe_backend() -> str | None:
     full 560 s attempt on a hung init wastes the driver window (BENCH_r02
     died this way, twice). Four 120 s probes give a flaky relay more bites
     at a fraction of the cost. Returns None when a probe succeeds, else the
-    joined error string.
+    joined error string. A TCP preflight shortcuts the common failure
+    (relay process absent) with a precise diagnosis; one jax probe still
+    runs as insurance against the preflight's port assumption going stale.
     """
     errors = []
-    for attempt in range(1, PROBE_ATTEMPTS + 1):
-        _log(f"probe {attempt}/{PROBE_ATTEMPTS} (deadline {PROBE_DEADLINE_S}s)")
+    attempts = PROBE_ATTEMPTS
+    preflight_err = tcp_preflight()
+    if preflight_err is not None:
+        _log(f"preflight: {preflight_err}")
+        errors.append(preflight_err)
+        attempts = 1  # one ground-truth probe; don't burn the window
+    for attempt in range(1, attempts + 1):
+        _log(f"probe {attempt}/{attempts} (deadline {PROBE_DEADLINE_S}s)")
         t0 = time.monotonic()
         try:
             proc = subprocess.run(

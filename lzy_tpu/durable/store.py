@@ -130,23 +130,39 @@ class OperationStore:
             )
             self._conn.commit()
 
-    def complete(self, op_id: str, result: Any = None) -> None:
+    def complete(self, op_id: str, result: Any = None,
+                 if_deadline: Optional[float] = ...) -> bool:
+        """Settle a RUNNING op as DONE. ``if_deadline`` (when passed) makes
+        it a CAS on the ownership deadline, mirroring :meth:`reclaim`: an
+        executor whose record was reclaimed (its heartbeat lost the CAS)
+        must not overwrite the new owner's in-flight record. Returns
+        whether the row was settled by THIS call."""
+        sql = ("UPDATE operations SET status = ?, result = ?, updated_at = ? "
+               "WHERE id = ? AND status = ?")
+        params = [DONE, json.dumps(result), time.time(), op_id, RUNNING]
+        if if_deadline is not ...:
+            sql += " AND deadline IS ?"
+            params.append(if_deadline)
         with self._lock:
-            self._conn.execute(
-                "UPDATE operations SET status = ?, result = ?, updated_at = ? "
-                "WHERE id = ? AND status = ?",
-                (DONE, json.dumps(result), time.time(), op_id, RUNNING),
-            )
+            cur = self._conn.execute(sql, params)
             self._conn.commit()
+            return cur.rowcount == 1
 
-    def fail(self, op_id: str, error: str) -> None:
+    def fail(self, op_id: str, error: str,
+             if_deadline: Optional[float] = ...) -> bool:
+        """Settle a RUNNING op as FAILED; ``if_deadline`` as in
+        :meth:`complete`. Returns whether the row was settled by THIS
+        call."""
+        sql = ("UPDATE operations SET status = ?, error = ?, updated_at = ? "
+               "WHERE id = ? AND status = ?")
+        params = [FAILED, error, time.time(), op_id, RUNNING]
+        if if_deadline is not ...:
+            sql += " AND deadline IS ?"
+            params.append(if_deadline)
         with self._lock:
-            self._conn.execute(
-                "UPDATE operations SET status = ?, error = ?, updated_at = ? "
-                "WHERE id = ? AND status = ?",
-                (FAILED, error, time.time(), op_id, RUNNING),
-            )
+            cur = self._conn.execute(sql, params)
             self._conn.commit()
+            return cur.rowcount == 1
 
     def reclaim(self, op_id: str, old_deadline: Optional[float],
                 new_deadline: float) -> bool:

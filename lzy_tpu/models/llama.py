@@ -373,6 +373,11 @@ class LlamaStage(nn.Module):
 
 def _check_pp_config(cfg: LlamaConfig) -> int:
     """Validate a pipeline config; returns layers-per-stage."""
+    if cfg.pp_stages < 2:
+        raise ValueError(
+            f"pipeline entry points need pp_stages >= 2, got "
+            f"{cfg.pp_stages} (dense configs use the non-pp forward)"
+        )
     if cfg.n_layers % cfg.pp_stages:
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp_stages={cfg.pp_stages}"

@@ -596,9 +596,11 @@ class RpcWhiteboardClient:
 
     def register(self, *, wb_id: str, name: str, tags=(), owner: str = ""):
         # owner is ignored on purpose: in remote mode the CONTROL PLANE
-        # assigns ownership from the authenticated token, never the client
-        # retry bare: re-registering the same client-generated wb_id just
-        # rewrites the same manifest (naturally idempotent), same for finalize
+        # assigns ownership from the authenticated token, never the client.
+        # retry is safe because the SERVER dedups: a duplicate register for
+        # an id/name/owner that already exists replays the stored manifest
+        # without rewriting it (WhiteboardIndex.register), so a delayed
+        # duplicate landing after finalize cannot reset a FINALIZED board
         doc = self._client.call("WhiteboardRegister", {
             "wb_id": wb_id, "name": name, "tags": list(tags),
             "token": _token_value(self._token),

@@ -121,8 +121,10 @@ class InProcessCluster:
         from lzy_tpu.service.whiteboard_service import WhiteboardService
         from lzy_tpu.whiteboards.index import WhiteboardIndex
 
+        self.whiteboard_index = WhiteboardIndex(self.storage_client,
+                                                storage_uri)
         self.whiteboard_service = WhiteboardService(
-            WhiteboardIndex(self.storage_client, storage_uri), iam=self.iam,
+            self.whiteboard_index, iam=self.iam,
         )
         self._debug_rpc = debug_rpc
         if worker_mode == "process":

@@ -70,11 +70,17 @@ class WhiteboardService:
                 existing = self._index.get(id_=wb_id)
             except KeyError:
                 existing = None
-            if existing is not None and existing.owner not in ("", owner):
+            if existing is not None and existing.owner != owner:
                 # re-registering an id you own is an idempotent retry;
-                # re-registering someone else's is a manifest hijack
+                # re-registering someone else's is a manifest hijack — and
+                # a legacy UNOWNED board is a conflict too: silently
+                # claiming it would reset its manifest and hand this
+                # subject ownership of data they never wrote (ADVICE r3)
                 raise AuthError(
                     f"whiteboard id {wb_id!r} is owned by another subject"
+                    if existing.owner else
+                    f"whiteboard id {wb_id!r} already exists unowned; "
+                    f"pre-IAM boards cannot be claimed by re-registration"
                 )
         return self._index.register(wb_id=wb_id, name=name, tags=tags,
                                     owner=owner)

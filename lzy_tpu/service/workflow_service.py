@@ -93,14 +93,17 @@ class WorkflowService:
             return None
         return self._iam.authenticate(token)
 
-    def _authz(self, token, permission, execution_id=None) -> None:
+    def _authz(self, token, permission, execution_id=None):
+        """Authenticate + authorize; returns the subject (None w/o IAM) so
+        callers can scope idempotency records to the caller."""
         if self._iam is None:
-            return
+            return None
         subject = self._iam.authenticate(token)
         owner = None
         if execution_id is not None:
             owner = self._execution(execution_id).get("user")
         self._iam.authorize(subject, permission, resource_owner=owner)
+        return subject
 
     # -- idempotent mutations (IdempotencyUtils parity) ------------------------
 
@@ -109,7 +112,7 @@ class WorkflowService:
     IDEM_INFLIGHT_TTL_S = 120.0
 
     def _idempotent(self, key: Optional[str], kind: str, fn,
-                    wait_s: float = 10.0):
+                    wait_s: float = 10.0, scope: str = ""):
         """Run ``fn`` exactly once per idempotency key. A duplicate request
         (same key — e.g. a client retry after a lost reply) replays the
         recorded outcome instead of re-executing; a concurrent duplicate
@@ -117,26 +120,73 @@ class WorkflowService:
         a control-plane crash is taken over (deadline CAS) so the retry
         that follows a restart still succeeds. Mirrors the reference's
         server-side dedup (``IdempotencyUtils.java``) over the store's
-        UNIQUE idempotency index (``durable/store.py:34``)."""
+        UNIQUE idempotency index (``durable/store.py:34``).
+
+        ``scope`` (the authenticated subject id) partitions the key space
+        per caller: subject B presenting subject A's key must execute its
+        own mutation, not silently replay A's recorded outcome (and leak
+        A's execution id) — client keys are unique per client, not
+        globally, so cross-subject collision is a confused-deputy bug."""
         if key is None:
             return fn()
+        if scope:
+            key = f"{scope}\x1f{key}"
+        import threading
+
         from lzy_tpu.durable.store import RUNNING
 
-        def run_and_record(record_id: str):
+        def run_and_record(record_id: str, owned_deadline: float):
+            # Heartbeat while fn runs: a mutation legitimately slower than
+            # the TTL (e.g. a slow VM teardown) must not look like a crash
+            # orphan — without this a concurrent retry could reclaim the
+            # record and re-execute side effects while the original thread
+            # is still running (ADVICE r3). The CAS-refresh also detects
+            # the converse: if someone DID reclaim us, the heartbeat loses
+            # the CAS and stops, leaving completion to the new owner.
+            stop = threading.Event()
+            deadline_box = [owned_deadline]
+
+            def heartbeat() -> None:
+                while not stop.wait(self.IDEM_INFLIGHT_TTL_S / 3):
+                    fresh = time.time() + self.IDEM_INFLIGHT_TTL_S
+                    if self._store.reclaim(record_id, deadline_box[0], fresh):
+                        deadline_box[0] = fresh
+                    else:
+                        return                     # ownership lost
+            beat = threading.Thread(target=heartbeat, daemon=True,
+                                    name=f"idem-heartbeat-{kind}")
+            beat.start()
+
+            def settle(settle_fn) -> None:
+                # quiesce the heartbeat FIRST so deadline_box is final,
+                # then settle with a CAS on the owned deadline: if another
+                # plane reclaimed the record (our heartbeat stalled past
+                # the TTL), the record now belongs to the re-execution —
+                # recording our outcome over it would let one key yield
+                # two different results depending on who replays
+                stop.set()
+                beat.join(5.0)
+                if not settle_fn(if_deadline=deadline_box[0]):
+                    _LOG.warning(
+                        "idempotent %s (key %s) was reclaimed while this "
+                        "executor ran; its outcome is recorded by the new "
+                        "owner", kind, key)
             try:
                 result = fn()
             except BaseException as e:            # noqa: BLE001 — replayed
-                self._store.fail(record_id, f"{type(e).__name__}: {e}")
+                settle(lambda **kw: self._store.fail(
+                    record_id, f"{type(e).__name__}: {e}", **kw))
                 raise
-            self._store.complete(record_id, result)
+            settle(lambda **kw: self._store.complete(record_id, result, **kw))
             return result
 
         op_id = gen_id(f"idem-{kind}")
+        first_deadline = time.time() + self.IDEM_INFLIGHT_TTL_S
         rec = self._store.create(op_id, f"idem.{kind}", {},
                                  idempotency_key=key,
-                                 deadline=time.time() + self.IDEM_INFLIGHT_TTL_S)
+                                 deadline=first_deadline)
         if rec.id == op_id:                       # we own the key: execute
-            return run_and_record(op_id)
+            return run_and_record(op_id, first_deadline)
         if rec.kind != f"idem.{kind}":
             # a key reused across different methods must not silently replay
             # the other call's result as this call's (reference
@@ -147,13 +197,13 @@ class WorkflowService:
         wait_deadline = time.time() + wait_s
         while rec.status == RUNNING:
             if rec.deadline is not None and time.time() > rec.deadline:
-                if self._store.reclaim(
-                        rec.id, rec.deadline,
-                        time.time() + self.IDEM_INFLIGHT_TTL_S):
+                takeover_deadline = time.time() + self.IDEM_INFLIGHT_TTL_S
+                if self._store.reclaim(rec.id, rec.deadline,
+                                       takeover_deadline):
                     _LOG.warning(
                         "taking over orphaned idempotent %s (key %s)",
                         kind, key)
-                    return run_and_record(rec.id)
+                    return run_and_record(rec.id, takeover_deadline)
             elif time.time() > wait_deadline:
                 raise RuntimeError(
                     f"request with idempotency key {key!r} still in flight")
@@ -171,25 +221,26 @@ class WorkflowService:
                        token: Optional[str] = None,
                        client_version: Optional[str] = None,
                        idempotency_key: Optional[str] = None) -> str:
-        return self._idempotent(
-            idempotency_key, "start_workflow",
-            lambda: self._start_workflow(
-                user, workflow_name, storage_uri, execution_id,
-                token=token, client_version=client_version,
-            ),
-        )
-
-    def _start_workflow(self, user: str, workflow_name: str, storage_uri: str,
-                        execution_id: Optional[str] = None, *,
-                        token: Optional[str] = None,
-                        client_version: Optional[str] = None) -> str:
         from lzy_tpu.iam import WORKFLOW_RUN
 
+        # authz + version gate run BEFORE the idempotent wrapper, matching
+        # finish/abort/stop_graph: a duplicate StartWorkflow carrying a
+        # known idempotency key must still present a valid token rather
+        # than replay the recorded execution_id unchecked (ADVICE r3)
         self._check_version(client_version)
         subject = self._authn(token)
         if subject is not None:
             self._iam.authorize(subject, WORKFLOW_RUN)
             user = subject.id
+        return self._idempotent(
+            idempotency_key, "start_workflow",
+            lambda: self._start_workflow(
+                user, workflow_name, storage_uri, execution_id),
+            scope=subject.id if subject is not None else "",
+        )
+
+    def _start_workflow(self, user: str, workflow_name: str, storage_uri: str,
+                        execution_id: Optional[str] = None) -> str:
         execution_id = execution_id or gen_id(f"exec-{workflow_name}")
         if self._store.kv_get("executions", execution_id) is not None:
             # a client-chosen id must not overwrite (or hijack) an existing
@@ -213,18 +264,20 @@ class WorkflowService:
                         idempotency_key: Optional[str] = None) -> None:
         from lzy_tpu.iam import WORKFLOW_MANAGE
 
-        self._authz(token, WORKFLOW_MANAGE, execution_id)
+        subject = self._authz(token, WORKFLOW_MANAGE, execution_id)
         self._idempotent(idempotency_key, "finish_workflow",
-                         lambda: self._teardown(execution_id, FINISHED))
+                         lambda: self._teardown(execution_id, FINISHED),
+                         scope=subject.id if subject is not None else "")
 
     def abort_workflow(self, execution_id: str, *,
                        token: Optional[str] = None,
                        idempotency_key: Optional[str] = None) -> None:
         from lzy_tpu.iam import WORKFLOW_MANAGE
 
-        self._authz(token, WORKFLOW_MANAGE, execution_id)
+        subject = self._authz(token, WORKFLOW_MANAGE, execution_id)
         self._idempotent(idempotency_key, "abort_workflow",
-                         lambda: self._abort(execution_id))
+                         lambda: self._abort(execution_id),
+                         scope=subject.id if subject is not None else "")
 
     def _abort(self, execution_id: str) -> None:
         exec_doc = self._execution(execution_id)
@@ -257,16 +310,19 @@ class WorkflowService:
         """Compile + run a graph. Returns the graph op id, or None when every
         task was satisfied from cache ("Results of all graph operations are
         cached", ``remote/runtime.py:170-172``)."""
-        return self._idempotent(
-            idempotency_key, "execute_graph",
-            lambda: self._execute_graph(execution_id, graph_doc, token=token),
-        )
-
-    def _execute_graph(self, execution_id: str, graph_doc: Dict[str, Any], *,
-                       token: Optional[str] = None) -> Optional[str]:
         from lzy_tpu.iam import WORKFLOW_RUN
 
-        self._authz(token, WORKFLOW_RUN, execution_id)
+        # authz BEFORE the idempotent wrapper (like the other mutations):
+        # a keyed duplicate must re-present a valid token, not replay
+        subject = self._authz(token, WORKFLOW_RUN, execution_id)
+        return self._idempotent(
+            idempotency_key, "execute_graph",
+            lambda: self._execute_graph(execution_id, graph_doc),
+            scope=subject.id if subject is not None else "",
+        )
+
+    def _execute_graph(self, execution_id: str,
+                       graph_doc: Dict[str, Any]) -> Optional[str]:
         exec_doc = self._execution(execution_id)
         if exec_doc["status"] != ACTIVE:
             raise RuntimeError(f"execution {execution_id} is {exec_doc['status']}")
@@ -318,9 +374,10 @@ class WorkflowService:
                    idempotency_key: Optional[str] = None) -> None:
         from lzy_tpu.iam import WORKFLOW_MANAGE
 
-        self._authz(token, WORKFLOW_MANAGE, execution_id)
+        subject = self._authz(token, WORKFLOW_MANAGE, execution_id)
         self._idempotent(idempotency_key, "stop_graph",
-                         lambda: self._ge.stop(graph_op_id))
+                         lambda: self._ge.stop(graph_op_id),
+                         scope=subject.id if subject is not None else "")
 
     # -- GC (lzy-service GarbageCollector parity: reap abandoned executions) ---
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import datetime
 import json
+import threading
 from typing import TYPE_CHECKING, Any, Dict, List, Optional, Sequence
 from urllib.parse import quote
 
@@ -75,6 +76,13 @@ class WhiteboardIndex:
     def __init__(self, client: StorageClient, root_uri: str):
         self._client = client
         self._root = join_uri(root_uri, "whiteboards")
+        # register/finalize are read-modify-write over object storage,
+        # which has no compare-and-swap: serialize them in-process so two
+        # concurrent RPC threads can't both pass the exists/conflict check
+        # and last-writer-wins a manifest (the control plane is the single
+        # writer for a store — docs/deployment.md — so an in-process lock
+        # is the right scope)
+        self._mutate_lock = threading.Lock()
 
     @classmethod
     def for_lzy(cls, lzy: "Lzy"):
@@ -97,6 +105,32 @@ class WhiteboardIndex:
 
     def register(self, *, wb_id: str, name: str, tags: Sequence[str],
                  owner: str = "") -> WhiteboardManifest:
+        # Duplicate register (a client retry, possibly delayed past
+        # finalize — e.g. DEADLINE_EXCEEDED where the server applied the
+        # first attempt) must be a no-op, not a manifest rewrite: blindly
+        # re-writing would reset a FINALIZED whiteboard to CREATED and
+        # drop its fields (ADVICE r3). Same id + same name + same owner
+        # replays the existing manifest; anything else is a conflict.
+        with self._mutate_lock:
+            return self._register_locked(wb_id=wb_id, name=name, tags=tags,
+                                         owner=owner)
+
+    def _register_locked(self, *, wb_id: str, name: str,
+                         tags: Sequence[str],
+                         owner: str) -> WhiteboardManifest:
+        try:
+            existing = self.get(id_=wb_id)
+        except KeyError:
+            existing = None
+        if existing is not None:
+            if (existing.name == name and (existing.owner or "") == owner
+                    and sorted(existing.tags) == sorted(tags)):
+                return existing
+            raise ValueError(
+                f"whiteboard {wb_id!r} already registered as "
+                f"name={existing.name!r} owner={existing.owner!r} "
+                f"tags={existing.tags!r}; refusing to overwrite with "
+                f"name={name!r} owner={owner!r} tags={list(tags)!r}")
         doc = {
             "id": wb_id,
             "name": name,
@@ -111,6 +145,11 @@ class WhiteboardIndex:
         return WhiteboardManifest(doc)
 
     def finalize(self, wb_id: str, fields: Dict[str, Dict[str, Any]]) -> None:
+        with self._mutate_lock:
+            self._finalize_locked(wb_id, fields)
+
+    def _finalize_locked(self, wb_id: str,
+                         fields: Dict[str, Dict[str, Any]]) -> None:
         manifest = self.get(id_=wb_id)
         manifest.doc["fields"] = fields
         manifest.doc["status"] = FINALIZED

@@ -104,22 +104,77 @@ class TestExactlyOnce:
             runs["n"] += 1
             return orig(*args, **kwargs)
 
+        # occupy an execution id so the keyed attempt fails INSIDE the
+        # deduped fn (authz/version failures happen before dedup by design
+        # — see start_workflow — so they are re-checked, not replayed)
+        taken = svc.start_workflow("u", "wf", cluster.storage_uri,
+                                   execution_id="exec-taken",
+                                   client_version="0.1.0")
         svc._start_workflow = counting
         try:
-            with pytest.raises(RuntimeError, match="unsupported client"):
+            with pytest.raises(RuntimeError, match="already exists"):
                 svc.start_workflow("u", "wf", cluster.storage_uri,
-                                   client_version="0.0.1",
+                                   execution_id="exec-taken",
+                                   client_version="0.1.0",
                                    idempotency_key="k-fail")
             # the retry with the same key replays the recorded error without
             # re-executing (exactly-once also for failed outcomes)
-            with pytest.raises(RuntimeError, match="unsupported client"):
+            with pytest.raises(RuntimeError, match="already exists"):
                 svc.start_workflow("u", "wf", cluster.storage_uri,
-                                   client_version="0.0.1",
+                                   execution_id="exec-taken",
+                                   client_version="0.1.0",
                                    idempotency_key="k-fail")
         finally:
             svc._start_workflow = orig
         assert runs["n"] == 1
-        assert cluster.store.kv_list("executions") == {}
+        assert list(cluster.store.kv_list("executions")) == [taken]
+
+    def test_version_gate_rechecked_not_replayed(self, cluster):
+        """Authz + version gating run BEFORE the idempotent wrapper
+        (ADVICE r3): a duplicate carrying a known key must not bypass
+        them, and a gate failure is re-checked fresh on every attempt."""
+        svc = cluster.workflow_service
+        with pytest.raises(RuntimeError, match="unsupported client"):
+            svc.start_workflow("u", "wf", cluster.storage_uri,
+                               client_version="0.0.1",
+                               idempotency_key="k-gate")
+        # same key, fixed client: the gate passes and the call EXECUTES
+        # (the failed attempt never reached the dedup record)
+        execution_id = svc.start_workflow("u", "wf", cluster.storage_uri,
+                                          client_version="0.1.0",
+                                          idempotency_key="k-gate")
+        assert execution_id in cluster.store.kv_list("executions")
+
+    def test_cross_subject_key_does_not_replay(self, tmp_path):
+        """Idempotency records are scoped per authenticated subject: B
+        presenting A's key must run B's own mutation, not silently replay
+        (and leak) A's recorded execution id (confused-deputy guard)."""
+        c = InProcessCluster(
+            db_path=str(tmp_path / "meta.db"),
+            storage_uri=f"file://{tmp_path}/storage",
+            with_iam=True,
+        )
+        try:
+            svc = c.workflow_service
+            tok_a = c.iam.create_subject("alice")
+            tok_b = c.iam.create_subject("bob")
+            exec_a = svc.start_workflow(
+                "alice", "wf", c.storage_uri, token=tok_a,
+                client_version="0.1.0", idempotency_key="shared-key")
+            exec_b = svc.start_workflow(
+                "bob", "wf", c.storage_uri, token=tok_b,
+                client_version="0.1.0", idempotency_key="shared-key")
+            assert exec_a != exec_b
+            owners = {k: v["user"]
+                      for k, v in c.store.kv_list("executions").items()}
+            assert owners[exec_a] == "alice" and owners[exec_b] == "bob"
+            # while A's own retry still replays
+            again = svc.start_workflow(
+                "alice", "wf", c.storage_uri, token=tok_a,
+                client_version="0.1.0", idempotency_key="shared-key")
+            assert again == exec_a
+        finally:
+            c.shutdown()
 
     def test_replayed_error_keeps_its_type(self, cluster):
         svc = cluster.workflow_service
@@ -167,6 +222,64 @@ class TestExactlyOnce:
         t.join(5.0)
         dup.join(5.0)
         assert results == ["slow-result", "slow-result"]
+
+    def test_slow_mutation_heartbeats_past_the_ttl(self, cluster):
+        """A mutation still executing past IDEM_INFLIGHT_TTL_S in a LIVE
+        process is slow, not crashed: the executor heartbeats the record's
+        deadline while fn runs, so a concurrent retry waits and replays
+        instead of reclaiming and double-applying (ADVICE r3)."""
+        svc = cluster.workflow_service
+        svc.IDEM_INFLIGHT_TTL_S = 0.3          # heartbeat every 0.1 s
+        runs = {"n": 0}
+        results = []
+
+        def slow():
+            runs["n"] += 1
+            time.sleep(1.0)                    # 3x the TTL
+            return "slow-result"
+
+        t = threading.Thread(
+            target=lambda: results.append(
+                svc._idempotent("k-slow", "probe", slow)),
+            daemon=True,
+        )
+        t.start()
+        time.sleep(0.15)
+        # the duplicate outlives several TTL windows; without the heartbeat
+        # it would reclaim the "orphan" and run `slow` a second time
+        dup = svc._idempotent("k-slow", "probe", slow, wait_s=5.0)
+        t.join(5.0)
+        assert dup == "slow-result"
+        assert results == ["slow-result"]
+        assert runs["n"] == 1
+
+
+class TestReclaimedWhileRunning:
+    def test_displaced_executor_does_not_overwrite_new_owner(self, cluster):
+        """If another plane reclaims our record mid-run (our heartbeat
+        stalled past the TTL), settling must CAS on the owned deadline and
+        lose: the record now belongs to the re-execution, and recording our
+        outcome over it would let one key replay two different results."""
+        svc = cluster.workflow_service
+        stolen = {}
+
+        def fn_that_gets_robbed():
+            rec = [r for r in cluster.store.running_ops()
+                   if r.idempotency_key == "k-steal"][0]
+            # simulate the other plane's takeover: deadline CAS succeeds
+            assert cluster.store.reclaim(rec.id, rec.deadline,
+                                         time.time() + 999)
+            stolen["id"] = rec.id
+            return "displaced-result"
+
+        result = svc._idempotent("k-steal", "probe", fn_that_gets_robbed)
+        # the displaced caller still gets its own outcome (its side effects
+        # did run) ...
+        assert result == "displaced-result"
+        # ... but the record stays RUNNING under the new owner's deadline,
+        # for the new owner to settle
+        rec = cluster.store.load(stolen["id"])
+        assert rec.status == "RUNNING"
 
 
 class TestOrphanedRecords:

@@ -95,6 +95,29 @@ class TestWhiteboardIam:
         again = clients["alice"].register(wb_id="wb-hijack", name="mine")
         assert again.owner == "alice"
 
+    def test_register_cannot_claim_legacy_unowned_board(self, plane):
+        """A pre-IAM (unowned) board is a conflict, not a free claim: silent
+        takeover would reset its manifest and hand the claimant ownership
+        of data they never wrote (ADVICE r3)."""
+        c, clients, _ = plane
+        # seed an unowned board straight through the index (pre-IAM write)
+        c.whiteboard_index.register(wb_id="wb-legacy", name="legacy", tags=())
+        with pytest.raises(AuthError, match="unowned"):
+            clients["alice"].register(wb_id="wb-legacy", name="legacy")
+        # the board is untouched
+        m = clients["auditor"].get(id_="wb-legacy")
+        assert m.owner == "" and m.name == "legacy"
+
+    def test_duplicate_register_after_finalize_is_a_noop(self, plane):
+        """A delayed duplicate register (e.g. a DEADLINE_EXCEEDED retry
+        that lands after finalize) replays the manifest instead of
+        resetting a FINALIZED board to CREATED (ADVICE r3)."""
+        _, clients, _ = plane
+        m = _register_finalized(clients["alice"], "dup-final")
+        again = clients["alice"].register(wb_id=m.id, name="dup-final")
+        assert again.status == "FINALIZED"
+        assert "metric" in again.fields
+
     def test_worker_tokens_rejected(self, plane):
         cluster, _, _ = plane
         from lzy_tpu.iam import WORKER

@@ -1,12 +1,20 @@
-"""Background TPU-relay evidence collector (VERDICT r2 weak #1).
+"""Background TPU-relay evidence collector (VERDICT r2 weak #1, r3 #1).
 
 The relay ("axon") can be dead for the entire driver window, erasing the
-bench number no matter how good the supervisor is. This loop runs all round
-in the background: every ~10 minutes it probes `jax.devices()` under a
-watchdog; the moment the relay answers it immediately runs the FULL bench
-(plus the on-hardware kernel tests and the flash block-size sweep) and
-writes timestamped artifacts under `tpu_evidence/` for the builder to
-commit — so a dead relay at driver time no longer erases the number.
+bench number no matter how good the supervisor is. This loop runs all
+round in the background. Round 4 upgrade: each cycle starts with a ~1 ms
+TCP preflight on the relay's `/init` port (127.0.0.1:8083 — see
+`tools/tpu_diag.py` and `tpu_evidence/DIAGNOSIS.md` for how that target
+was pinned), so a dead relay costs nothing to detect and the loop can
+poll every 2 minutes instead of burning a 120 s `jax.devices()` hang
+every 10. The moment the port answers, it verifies with a real
+`jax.devices()` probe and immediately runs the FULL bench (plus the
+on-hardware kernel tests and the flash block-size sweep), writing
+timestamped artifacts under `tpu_evidence/` for the builder to commit.
+
+A full jax probe still runs periodically even when TCP says refused
+(defense against the dial-target assumption going stale), and a full
+diagnosis (`tools/tpu_diag.py`) is re-recorded hourly.
 
 Usage:  python tools/tpu_probe_loop.py  (blocks; run in the background)
 
@@ -15,7 +23,8 @@ Artifacts (all timestamped, newest wins):
   tpu_evidence/bench_stderr.log      — raw bench stderr (staged progress)
   tpu_evidence/kernels_tpu.log       — pytest tpu_tests/ output
   tpu_evidence/tune_flash.log        — block-size sweep output
-  tpu_evidence/probe_history.jsonl   — one line per probe (up/down + latency)
+  tpu_evidence/probe_history.jsonl   — one line per probe (up/down + tcp)
+  tpu_evidence/diagnosis_*.json[l]   — instrumented init diagnosis
 """
 
 from __future__ import annotations
@@ -28,8 +37,14 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+from tpu_diag import RELAY_HOST, RELAY_PORTS, tcp_probe  # noqa: E402
+
 EVIDENCE = os.path.join(REPO, "tpu_evidence")
-PROBE_PERIOD_S = 600
+PROBE_PERIOD_S = 120          # TCP preflight is ~free; poll tightly
+FULL_PROBE_EVERY_S = 3600     # jax probe despite refused TCP (stale-target guard)
+JAX_BACKOFF_S = 600           # after a hung jax probe w/ live listener
+DIAG_EVERY_S = 3600           # re-record full diagnosis
 PROBE_DEADLINE_S = 125
 BENCH_DEADLINE_S = 1500
 KERNELS_DEADLINE_S = 1200
@@ -50,7 +65,13 @@ def append_history(rec: dict) -> None:
         f.write(json.dumps(rec) + "\n")
 
 
-def probe_once() -> bool:
+def tcp_preflight() -> dict:
+    """~1 ms relay check; 'open' means a listener accepted the connect."""
+    return tcp_probe(RELAY_HOST, RELAY_PORTS[0])
+
+
+def jax_probe() -> tuple[bool, str, float]:
+    """The expensive ground-truth probe: jax.devices() under a watchdog."""
     t0 = time.monotonic()
     try:
         proc = subprocess.run(
@@ -62,10 +83,48 @@ def probe_once() -> bool:
         up = proc.returncode == 0 and "ok" in out
     except subprocess.TimeoutExpired:
         out, up = f"hung, killed after {PROBE_DEADLINE_S}s", False
-    dt = round(time.monotonic() - t0, 1)
-    append_history({"t": now(), "up": up, "latency_s": dt, "detail": out[-200:]})
-    log(f"probe: {'UP' if up else 'down'} ({dt}s) {out[-120:]}")
-    return up
+    return up, out, round(time.monotonic() - t0, 1)
+
+
+def probe_once(force_jax: bool = False,
+               jax_allowed: bool = True) -> tuple[bool, bool]:
+    """TCP preflight first; only pay for a jax probe when the port is
+    open (or on the periodic stale-target guard). ``jax_allowed`` rate-
+    limits the expensive probe in the listener-up-but-init-hangs mode:
+    without it an open-but-wedged relay would burn a ~124 s watchdog
+    kill every cycle (~50% duty at the tightened 120 s period).
+    Returns (backend_up, ran_jax_probe)."""
+    tcp = tcp_preflight()
+    if tcp["status"] == "refused" and not force_jax:
+        append_history({"t": now(), "up": False, "latency_s": 0.0,
+                        "tcp": tcp, "detail": "tcp refused (no listener)"})
+        log(f"probe: down (tcp refused in {tcp['latency_ms']}ms)")
+        return False, False
+    if not (jax_allowed or force_jax):
+        append_history({"t": now(), "up": False, "latency_s": 0.0,
+                        "tcp": tcp,
+                        "detail": "listener present; jax probe backing off"})
+        log(f"probe: tcp={tcp['status']}, jax probe rate-limited")
+        return False, False
+    up, out, dt = jax_probe()
+    append_history({"t": now(), "up": up, "latency_s": dt, "tcp": tcp,
+                    "detail": out[-200:]})
+    log(f"probe: {'UP' if up else 'down'} ({dt}s, tcp={tcp['status']}) "
+        f"{out[-120:]}")
+    return up, True
+
+
+def record_diagnosis() -> None:
+    """Re-run the full instrumented diagnosis (appends to history)."""
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "tpu_diag.py")],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=300, cwd=REPO,
+        )
+        log("diagnosis recorded")
+    except Exception as e:  # noqa: BLE001 — evidence collection must not die
+        log(f"diagnosis failed: {e}")
 
 
 def run_logged(cmd: list, log_name: str, deadline: int) -> str:
@@ -132,8 +191,21 @@ def capture_bench() -> bool:
 def main() -> None:
     os.makedirs(EVIDENCE, exist_ok=True)
     captured_bench = captured_kernels = captured_tune = False
+    record_diagnosis()
+    last_full_probe = last_diag = time.monotonic()
+    jax_backoff_until = 0.0
     while not (captured_bench and captured_kernels and captured_tune):
-        if probe_once():
+        force_jax = time.monotonic() - last_full_probe >= FULL_PROBE_EVERY_S
+        if force_jax:
+            last_full_probe = time.monotonic()
+        up, ran_jax = probe_once(
+            force_jax=force_jax,
+            jax_allowed=time.monotonic() >= jax_backoff_until)
+        if ran_jax and not up:
+            # a failed (hung) jax probe with a live listener: back off the
+            # expensive probe; TCP keeps being watched every cycle
+            jax_backoff_until = time.monotonic() + JAX_BACKOFF_S
+        if up:
             if not captured_bench:
                 captured_bench = capture_bench()
             if captured_bench and not captured_kernels:
@@ -147,6 +219,9 @@ def main() -> None:
                     [sys.executable, "tools/tune_flash.py", "--steps", "10"],
                     "tune_flash.log", TUNE_DEADLINE_S)
                 captured_tune = "mfu" in out
+        if time.monotonic() - last_diag >= DIAG_EVERY_S:
+            last_diag = time.monotonic()
+            record_diagnosis()
         if captured_bench and captured_kernels and captured_tune:
             break
         time.sleep(PROBE_PERIOD_S)
