@@ -18,8 +18,24 @@ conda solve on every VM, the worker
 
 Shared-interpreter (thread) workers cannot safely mutate their own process,
 so they run in *validate* mode: any mismatch is an immediate, attributable
-``EnvBuildError``. ``spec.to_conda_yaml()`` remains the portable fallback
-artifact for environments that do want a full conda build.
+``EnvBuildError``.
+
+**Full conda realization** (:class:`CondaRealizer`) consumes the
+``spec.to_conda_yaml()`` artifact the way the reference's
+``CondaEnvironment.java:67-125`` does (``conda env create || conda env
+update`` at ``:112``): it materializes a named conda env from the yaml and
+returns that env's interpreter. The overlay stays the worker default —
+it skips the multi-minute solve for the common same-interpreter case —
+but when an op pins a *different python minor* (which no overlay can
+bridge; see :func:`diff_spec`), a pool whose image carries conda can
+bootstrap the env at VM-boot time::
+
+    python -m lzy_tpu.env.realize --conda-root /var/lzy/envs spec.json
+
+prints the realized interpreter path; the bootstrap then starts the
+worker under it. Gated test tier: fake-conda unit tests always run;
+``tests/test_env_realize.py`` adds a real ``conda`` e2e that skips when
+no conda binary exists on the host.
 """
 
 from __future__ import annotations
@@ -214,6 +230,109 @@ class EnvRealizer:
         return sorted(out)
 
 
+def find_conda() -> Optional[str]:
+    """First available conda-family binary (conda/mamba/micromamba)."""
+    import shutil as _shutil
+
+    for exe in ("conda", "mamba", "micromamba"):
+        path = _shutil.which(exe)
+        if path:
+            return path
+    return None
+
+
+class CondaRealizer:
+    """Materializes a full conda env from the captured spec's yaml.
+
+    The consumer of ``PythonEnvSpec.to_conda_yaml()``: where the overlay
+    path patches the worker's own interpreter, this builds a *separate*
+    interpreter — the only way to honor an op that pins a different
+    python minor. Mirrors the reference's create-or-update sequence
+    (``CondaEnvironment.java:112``: ``conda env create`` falling back to
+    ``conda env update`` when the named env already exists), keyed and
+    cached by spec fingerprint.
+    """
+
+    def __init__(self, root: str, conda_exe: Optional[str] = None):
+        self._root = root
+        self._conda = conda_exe or find_conda()
+        self._lock = threading.Lock()
+        if self._conda is None:
+            raise EnvBuildError(
+                "no conda/mamba/micromamba binary on PATH — full conda "
+                "realization needs one (the overlay path does not)")
+
+    def env_name(self, spec_doc: dict) -> str:
+        return f"lzy-{spec_fingerprint(spec_doc)}"
+
+    def realize(self, spec_doc: dict) -> str:
+        """Create-or-update the env; returns its python interpreter path."""
+        from lzy_tpu.env.python_env import PythonEnvSpec
+
+        spec = PythonEnvSpec(
+            python_version=spec_doc.get("python_version", ""),
+            packages=tuple((n, v) for n, v in spec_doc.get("packages", [])),
+            local_module_paths=(),
+        )
+        name = self.env_name(spec_doc)
+        prefix = os.path.join(self._root, name)
+        python = os.path.join(prefix, "bin", "python")
+        marker = os.path.join(prefix, ".lzy-env-ready")
+        os.makedirs(self._root, exist_ok=True)
+        # OS-level lock, not just the thread lock: the documented consumer
+        # is the VM-boot CLI, and two bootstraps racing `conda env create`
+        # on one prefix corrupt it (conda is not prefix-concurrent-safe)
+        import fcntl
+
+        lock_path = os.path.join(self._root, f"{name}.lock")
+        with self._lock, open(lock_path, "w") as lock_f:
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+            if os.path.exists(marker):
+                return python
+            yaml_path = os.path.join(self._root, f"{name}.yaml")
+            with open(yaml_path, "w") as f:
+                f.write(spec.to_conda_yaml(env_name=name))
+            create = [self._conda, "env", "create", "-y", "--prefix", prefix,
+                      "--file", yaml_path]
+            proc = subprocess.run(create, capture_output=True, text=True)
+            if proc.returncode != 0:
+                # the env may half-exist from an interrupted build: update
+                # converges it (same fallback order as the reference)
+                update = [self._conda, "env", "update", "--prefix", prefix,
+                          "--file", yaml_path, "--prune"]
+                proc = subprocess.run(update, capture_output=True, text=True)
+                if proc.returncode != 0:
+                    tail = (proc.stderr or proc.stdout or "").strip()[-2000:]
+                    raise EnvBuildError(
+                        f"conda could not realize env {name}: {tail}")
+            if not os.path.exists(python):
+                raise EnvBuildError(
+                    f"conda reported success but {python} does not exist")
+            with open(marker, "w") as f:
+                f.write(json.dumps(spec_doc))
+            return python
+
+
+def _cli(argv: Optional[List[str]] = None) -> int:
+    """``python -m lzy_tpu.env.realize --conda-root DIR spec.json`` —
+    pool-boot entrypoint: realize the spec as a conda env and print the
+    interpreter path for the bootstrap to exec the worker under."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="python -m lzy_tpu.env.realize")
+    ap.add_argument("spec", help="path to a spec_to_doc() JSON file")
+    ap.add_argument("--conda-root", required=True,
+                    help="directory to materialize conda envs under")
+    ap.add_argument("--conda-exe", default=None)
+    args = ap.parse_args(argv)
+    with open(args.spec) as f:
+        spec_doc = json.load(f)
+    python = CondaRealizer(args.conda_root,
+                           conda_exe=args.conda_exe).realize(spec_doc)
+    print(python, flush=True)
+    return 0
+
+
 class applied_overlay:
     """Context manager: make ``overlay`` the highest-priority import source
     (and visible to subprocesses via PYTHONPATH) for the op's duration."""
@@ -259,3 +378,7 @@ class applied_overlay:
             if f and f.startswith(self._overlay + os.sep):
                 sys.modules.pop(name, None)
         return False
+
+
+if __name__ == "__main__":
+    sys.exit(_cli())

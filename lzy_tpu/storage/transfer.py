@@ -108,7 +108,17 @@ def download(client: StorageClient, uri: str, dest_path: str, *,
              config: TransferConfig = DEFAULT,
              progress: Optional[Progress] = None) -> int:
     """Concurrent ranged download to ``dest_path`` (atomic: .part + rename).
-    Needs only ``read_range`` + ``size`` from the backend."""
+    Needs only ``read_range`` + ``size`` from the backend. Backends that
+    are local files in disguise can expose ``download_file`` (a kernel
+    copy — ``FsStorageClient``): ranged thread fan-out only makes sense
+    when parts ride independent network streams, not against one disk."""
+    fast = getattr(client, "download_file", None)
+    if fast is not None:
+        n = _with_retries(lambda: fast(uri, dest_path), config,
+                          f"download_file({uri})")
+        if progress is not None:
+            progress(n, n)
+        return n
     total = _with_retries(lambda: client.size(uri), config, f"size({uri})")
     meter = _ProgressMeter(total, progress)
     tmp = dest_path + ".part"
@@ -162,6 +172,13 @@ def upload(client: StorageClient, uri: str, src_path: str, *,
     half-written."""
     total = os.path.getsize(src_path)
     meter = _ProgressMeter(total, progress)
+    fast = getattr(client, "upload_file", None)
+    if fast is not None:
+        # local-fs backend: one kernel-side copy beats any part fan-out
+        n = _with_retries(lambda: fast(uri, src_path), config,
+                          f"upload_file({uri})")
+        meter.advance(total)
+        return n
     multipart = getattr(client, "multipart_upload", None)
     if multipart is not None:
         src_fd = os.open(src_path, os.O_RDONLY)

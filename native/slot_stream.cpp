@@ -25,6 +25,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <string>
+#include <sys/sendfile.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <thread>
@@ -112,15 +113,38 @@ void serve_conn(Server* srv, int conn) {
     return;
   }
   if (offset < total) {
-    ::lseek(fd, static_cast<off_t>(offset), SEEK_SET);
-    std::vector<char> buf(kChunk);
+    // zero-copy hot path: sendfile() moves file pages straight into the
+    // socket without a userspace bounce (this is where the native engine
+    // earns its keep over a python read/sendall loop); fall back to the
+    // copying loop only if the kernel/filesystem refuses
+    off_t off = static_cast<off_t>(offset);
     uint64_t remaining = total - offset;
+    bool fallback = false;
     while (remaining > 0) {
-      size_t want = remaining < kChunk ? remaining : kChunk;
-      ssize_t r = ::read(fd, buf.data(), want);
-      if (r <= 0) break;
-      if (!write_exact(conn, buf.data(), static_cast<size_t>(r))) break;
-      remaining -= static_cast<uint64_t>(r);
+      size_t want = remaining < (8 * kChunk) ? remaining : (8 * kChunk);
+      ssize_t r = ::sendfile(conn, fd, &off, want);
+      if (r > 0) {
+        remaining -= static_cast<uint64_t>(r);
+        continue;
+      }
+      if (r < 0 && errno == EINTR) continue;
+      if (r < 0 && (errno == EINVAL || errno == ENOSYS) &&
+          remaining == total - offset) {
+        fallback = true;  // first call refused: not sendfile-capable
+      }
+      break;
+    }
+    if (fallback) {
+      ::lseek(fd, static_cast<off_t>(offset), SEEK_SET);
+      std::vector<char> buf(kChunk);
+      remaining = total - offset;
+      while (remaining > 0) {
+        size_t want = remaining < kChunk ? remaining : kChunk;
+        ssize_t r = ::read(fd, buf.data(), want);
+        if (r <= 0) break;
+        if (!write_exact(conn, buf.data(), static_cast<size_t>(r))) break;
+        remaining -= static_cast<uint64_t>(r);
+      }
     }
   }
   ::close(fd);
@@ -248,20 +272,86 @@ long long lzy_slots_pull(const char* host, int port, const char* remote_name,
   ::lseek(out, static_cast<off_t>(offset), SEEK_SET);
   ::ftruncate(out, static_cast<off_t>(offset));
 
-  std::vector<char> buf(kChunk);
   uint64_t received = off;
   uint64_t budget =
       max_bytes > 0 ? static_cast<uint64_t>(max_bytes) : UINT64_MAX;
-  while (received < total && budget > 0) {
-    uint64_t left = total - received;
-    size_t want = left < kChunk ? left : kChunk;
-    if (want > budget) want = budget;
-    ssize_t r = ::read(fd, buf.data(), want);
-    if (r < 0 && errno == EINTR) continue;
-    if (r <= 0) break;
-    if (!write_exact(out, buf.data(), static_cast<size_t>(r))) break;
-    received += static_cast<uint64_t>(r);
-    budget -= static_cast<uint64_t>(r);
+  // zero-copy receive: socket → pipe → file via splice(), so payload
+  // bytes never cross into userspace; mirror of the server's sendfile.
+  // Falls back to the read/write loop if splice is refused up front.
+  int pipefd[2] = {-1, -1};
+  bool splice_ok = ::pipe(pipefd) == 0;
+  if (splice_ok) {
+#ifdef F_SETPIPE_SZ
+    ::fcntl(pipefd[1], F_SETPIPE_SZ, static_cast<int>(kChunk));
+#endif
+    while (received < total && budget > 0) {
+      uint64_t left = total - received;
+      size_t want = left < kChunk ? left : kChunk;
+      if (want > budget) want = static_cast<size_t>(budget);
+      ssize_t n = ::splice(fd, nullptr, pipefd[1], nullptr, want,
+                           SPLICE_F_MOVE | SPLICE_F_MORE);
+      if (n < 0 && errno == EINTR) continue;
+      if (n < 0 && (errno == EINVAL || errno == ENOSYS) && received == off) {
+        splice_ok = false;  // first call refused: fall back below
+        break;
+      }
+      if (n <= 0) break;
+      size_t pending = static_cast<size_t>(n);
+      bool drained = true;
+      while (pending > 0) {
+        ssize_t w =
+            ::splice(pipefd[0], nullptr, out, nullptr, pending, SPLICE_F_MOVE);
+        if (w < 0 && errno == EINTR) continue;
+        if (w < 0 && (errno == EINVAL || errno == ENOSYS)) {
+          // dest fs refuses splice-from-pipe (FUSE etc.): the bytes are
+          // already consumed from the socket, so drain the pipe through
+          // userspace instead of discarding them, then keep going in
+          // copying mode for the rest of the stream
+          std::vector<char> spill(kChunk);
+          while (pending > 0) {
+            size_t want = pending < kChunk ? pending : kChunk;
+            ssize_t r2 = ::read(pipefd[0], spill.data(), want);
+            if (r2 < 0 && errno == EINTR) continue;
+            if (r2 <= 0 || !write_exact(out, spill.data(),
+                                        static_cast<size_t>(r2))) {
+              drained = false;
+              break;
+            }
+            pending -= static_cast<size_t>(r2);
+          }
+          if (drained) {
+            received += static_cast<uint64_t>(n);
+            budget -= static_cast<uint64_t>(n);
+            splice_ok = false;  // finish via the read/write loop below
+          }
+          break;
+        }
+        if (w <= 0) {
+          drained = false;
+          break;
+        }
+        pending -= static_cast<size_t>(w);
+      }
+      if (!drained || !splice_ok) break;
+      received += static_cast<uint64_t>(n);
+      budget -= static_cast<uint64_t>(n);
+    }
+    ::close(pipefd[0]);
+    ::close(pipefd[1]);
+  }
+  if (!splice_ok) {
+    std::vector<char> buf(kChunk);
+    while (received < total && budget > 0) {
+      uint64_t left = total - received;
+      size_t want = left < kChunk ? left : kChunk;
+      if (want > budget) want = static_cast<size_t>(budget);
+      ssize_t r = ::read(fd, buf.data(), want);
+      if (r < 0 && errno == EINTR) continue;
+      if (r <= 0) break;
+      if (!write_exact(out, buf.data(), static_cast<size_t>(r))) break;
+      received += static_cast<uint64_t>(r);
+      budget -= static_cast<uint64_t>(r);
+    }
   }
   ::close(out);
   ::close(fd);

@@ -43,12 +43,22 @@ def sha256_file(path) -> str:
     return h.hexdigest()
 
 
+def ranged_only(client):
+    """Hide the local-fs kernel-copy fast path so a test exercises the
+    generic ranged machinery (the path network object stores take)."""
+    client.upload_file = None
+    client.download_file = None
+    return client
+
+
 class FlakyClient(FsStorageClient):
-    """Fails the first N calls of read_range/size to exercise retries."""
+    """Fails the first N calls of read_range/size to exercise retries.
+    Fast paths are hidden: the injected failures live in the ranged path."""
 
     def __init__(self, fail_first: int):
         self._failures_left = fail_first
         self._lock = threading.Lock()
+        ranged_only(self)
 
     def _maybe_fail(self, what: str):
         with self._lock:
@@ -65,7 +75,7 @@ class TestRoundTrip:
     def test_fs_multipart_round_trip_64mb(self, tmp_path):
         src = tmp_path / "src.bin"
         digest = make_blob(src, 64)                  # 64 parts of 1 MB
-        client = FsStorageClient()
+        client = ranged_only(FsStorageClient())
         uri = f"file://{tmp_path}/store/blob.bin"
 
         events = []
@@ -92,6 +102,23 @@ class TestRoundTrip:
                                            max_workers=4, retries=2,
                                            backoff_s=0.01))
         assert n == len(data) and dest.read_bytes() == data
+
+    def test_fs_fast_path_round_trip(self, tmp_path):
+        """On a local fs backend the engine takes the kernel-copy fast
+        path (upload_file/download_file) by default; same bytes, atomic
+        at the destination."""
+        src = tmp_path / "src.bin"
+        data = os.urandom(5 * 1024 * 1024 + 13)
+        src.write_bytes(data)
+        client = FsStorageClient()
+        assert client.upload_file is not None     # fast path present
+        uri = f"file://{tmp_path}/store/fast.bin"
+        n = upload(client, uri, str(src), config=SMALL_CFG)
+        assert n == len(data)
+        dest = tmp_path / "fast-out.bin"
+        n2 = download(client, uri, str(dest), config=SMALL_CFG)
+        assert n2 == len(data) and dest.read_bytes() == data
+        assert not os.path.exists(str(dest) + ".part")
 
     def test_zero_byte_object(self, tmp_path):
         client = FsStorageClient()
@@ -139,7 +166,7 @@ class TestRetries:
         assert not (tmp_path / "out.bin.part").exists()
 
     def test_failed_upload_leaves_no_partial_object(self, tmp_path):
-        client = FsStorageClient()
+        client = ranged_only(FsStorageClient())
         src = tmp_path / "src.bin"
         src.write_bytes(os.urandom(2 * 1024 * 1024))
         uri = f"file://{tmp_path}/store/obj.bin"
