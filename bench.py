@@ -281,7 +281,7 @@ def run() -> None:
     _log(f"model ready: {n_params/1e6:.0f}M params, batch {batch_size} x seq {seq_len}")
 
     tx = optax.adamw(3e-4)
-    loss_fn = llama.make_loss_fn(cfg)
+    loss_fn = llama.make_loss_fn(cfg, mesh)
     step, shard_state, _ = make_train_step(
         loss_fn, tx, mesh=mesh, param_logical_axes=axes,
         batch_logical_axes=("batch", "seq"),
@@ -401,7 +401,7 @@ def variant_measurement(jax, cfg, mesh, n_params, tag: str, overrides: dict,
         boxed, axes = llama.init_params(vcfg, jax.random.PRNGKey(0))
         tx = optax.adamw(3e-4)
         step, shard_state, _ = make_train_step(
-            llama.make_loss_fn(vcfg), tx, mesh=mesh,
+            llama.make_loss_fn(vcfg, mesh), tx, mesh=mesh,
             param_logical_axes=axes, batch_logical_axes=("batch", "seq"),
         )
         state = shard_state(TrainState.create(unbox(boxed), tx))

@@ -292,7 +292,9 @@ class CondaRealizer:
             yaml_path = os.path.join(self._root, f"{name}.yaml")
             with open(yaml_path, "w") as f:
                 f.write(spec.to_conda_yaml(env_name=name))
-            create = [self._conda, "env", "create", "-y", "--prefix", prefix,
+            # no -y: `conda env create` never prompts, and older condas
+            # reject the flag on the env subcommand
+            create = [self._conda, "env", "create", "--prefix", prefix,
                       "--file", yaml_path]
             proc = subprocess.run(create, capture_output=True, text=True)
             if proc.returncode != 0:

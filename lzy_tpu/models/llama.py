@@ -27,6 +27,7 @@ from typing import Any, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh
 
 from lzy_tpu.models.common import cross_entropy_loss
 
@@ -290,6 +291,23 @@ class DecoderLayer(nn.Module):
         return x + Mlp(cfg, name="mlp")(h)
 
 
+def _embed_lookup(table, tokens, *, one_hot: bool):
+    """Token embedding lookup.
+
+    On sharded meshes the gather's transpose (scatter-add into the
+    vocab/embed-sharded table) forces SPMD into an 'Involuntary full
+    rematerialization' of the cotangent (MULTICHIP_r03 warnings); the
+    TPU-native form is a one-hot einsum (maxtext's iota-embed trick):
+    both directions are then plain dots the partitioner shards with
+    clean collectives, and XLA fuses the iota-compare operand so the
+    [B,T,V] one-hot is never materialized. Plain gather stays for the
+    meshless path (single-chip decode), where it's strictly cheaper."""
+    if not one_hot:
+        return table[tokens]
+    hot = jax.nn.one_hot(tokens, table.shape[0], dtype=table.dtype)
+    return jnp.einsum("btv,vd->btd", hot, table)
+
+
 class Llama(nn.Module):
     cfg: LlamaConfig
 
@@ -303,7 +321,8 @@ class Llama(nn.Module):
             ),
             (cfg.vocab_size, cfg.d_model), cfg.param_dtype,
         )
-        x = emb.astype(cfg.dtype)[tokens]
+        x = _embed_lookup(emb.astype(cfg.dtype), tokens,
+                          one_hot=mesh is not None)
         if segments is None:
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1]), tokens.shape
@@ -352,10 +371,15 @@ class LlamaStage(nn.Module):
 
     Every stage runs the same module shape with per-stage weights — the
     constraint ``parallel.pipeline.pipeline_apply`` streams microbatches
-    through (stage i holds layers [i*k, (i+1)*k))."""
+    through (stage i holds layers [i*k, (i+1)*k)). ``mesh`` (static)
+    flows to the layers so sequence-parallel attention composes with the
+    pipeline: the ring's shard_map nests partial-manual over ``sp``
+    inside the pipeline's partial-manual ``pp`` region (ring.py handles
+    the nested case against the context mesh)."""
 
     cfg: LlamaConfig
     n_layers: int
+    mesh: Optional[Mesh] = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -367,7 +391,7 @@ class LlamaStage(nn.Module):
                 policy=jax.checkpoint_policies.nothing_saveable,
             )
         for i in range(self.n_layers):
-            x = layer(cfg, name=f"layer_{i}")(x, positions)
+            x = layer(cfg, name=f"layer_{i}")(x, positions, self.mesh)
         return x
 
 
@@ -384,7 +408,6 @@ def _check_pp_config(cfg: LlamaConfig) -> int:
         )
     unsupported = [
         name for name, on in [
-            ("use_ring_attention", cfg.use_ring_attention),
             ("use_ulysses_attention", cfg.use_ulysses_attention),
             ("n_experts", cfg.n_experts > 0),
             ("decode", cfg.decode),
@@ -393,7 +416,8 @@ def _check_pp_config(cfg: LlamaConfig) -> int:
     if unsupported:
         raise ValueError(
             f"pp_stages>1 does not compose with {unsupported} (pipeline the "
-            f"dense decoder; decode via unstack_pp_params + the dense tree)"
+            f"dense decoder; decode via unstack_pp_params + the dense tree). "
+            f"Ring sequence parallelism DOES compose (pp x sp)."
         )
     return cfg.n_layers // cfg.pp_stages
 
@@ -456,14 +480,53 @@ def pp_forward(params, tokens: jax.Array, cfg: LlamaConfig, mesh,
     x = params["embed_tokens"].astype(cfg.dtype)[tokens]
     mb = b // n_micro
     xm = x.reshape(n_micro, mb, t, x.shape[-1])
-    positions = jnp.broadcast_to(jnp.arange(t), (mb, t))
 
-    stage = LlamaStage(cfg, k)
+    # pp × sp: with ring attention on an sp-bearing mesh, the pipeline's
+    # manual region covers {pp, sp} and activations enter seq-sharded —
+    # the stage then computes its chunk's ABSOLUTE positions from its sp
+    # rank (RoPE must see global offsets, not per-chunk zeros)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    seq_axis = None
+    if cfg.use_ring_attention:
+        if "sp" not in mesh.shape or mesh.shape["sp"] < 2:
+            raise ValueError(
+                "pp_stages>1 with use_ring_attention needs an 'sp' axis of "
+                "size >= 2 on the mesh (the ring runs against the manual "
+                "sp axis inside the pipeline); add sp to the mesh or drop "
+                "use_ring_attention")
+        seq_axis = "sp"
+        if t % mesh.shape["sp"]:
+            raise ValueError(
+                f"seq {t} not divisible by sp={mesh.shape['sp']}")
+    # The microbatch reshape mangles the tokens' batch sharding into a 2D
+    # split of the leading dims; SPMD can't convert that to the layout it
+    # wants at the pipeline boundary without an 'Involuntary full
+    # rematerialization'. The activations cross that boundary (replicated
+    # except for the manual sp chunking) regardless, so lay them out
+    # explicitly — a voluntary all-gather instead of an involuntary one.
+    boundary = P(None, None, seq_axis, None)
+    xm = jax.lax.with_sharding_constraint(xm, NamedSharding(mesh, boundary))
+
+    stage = LlamaStage(cfg, k, mesh=mesh)
 
     def stage_fn(p, h):
+        t_local = h.shape[1]
+        if seq_axis is not None:
+            start = jax.lax.axis_index(seq_axis) * t_local
+        else:
+            start = 0
+        positions = jnp.broadcast_to(start + jnp.arange(t_local),
+                                     (h.shape[0], t_local))
         return stage.apply({"params": p}, h, positions)
 
-    x = pipeline_apply(stage_fn, params["stages"], xm, mesh=mesh, axis=axis)
+    x = pipeline_apply(stage_fn, params["stages"], xm, mesh=mesh, axis=axis,
+                       seq_axis=seq_axis)
+    # same voluntary trick on the way out: the constraint transposes to
+    # itself, so the BACKWARD cotangent (embed-sharded by the head matmul)
+    # is gathered explicitly at the boundary instead of via SPMD's
+    # last-resort full rematerialization
+    x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, boundary))
     x = x.reshape(b, t, -1)
     x = RMSNorm(cfg.norm_eps, cfg.param_dtype).apply(
         {"params": params["final_norm"]}, x

@@ -30,12 +30,18 @@ def pipeline_apply(
     *,
     mesh: Mesh,
     axis: str = "pp",
+    seq_axis: str = None,
 ) -> jax.Array:
     """Run ``x`` through ``n_stages`` sequential applications of ``stage_fn``.
 
     - ``stage_params``: pytree whose leaves have leading dim ``n_stages``
       (sharded over ``axis``); stage ``i`` uses leaf ``[i]``.
     - ``x``: ``[n_micro, micro_batch, ...]`` microbatched input (replicated).
+    - ``seq_axis``: composes the pipeline with ring sequence parallelism:
+      the manual region covers ``{axis, seq_axis}`` and ``x``'s dim 2 (the
+      sequence) enters sharded over ``seq_axis``, so a ring-attention body
+      inside ``stage_fn`` runs directly against the manual axis (nested
+      shard_maps cannot re-bind an axis — both partitioners reject it).
 
     Returns ``[n_micro, micro_batch, ...]`` outputs, equal to applying the
     stages sequentially to each microbatch.
@@ -50,6 +56,16 @@ def pipeline_apply(
         # params_local leaves: [1, ...] — this device's stage
         params = jax.tree_util.tree_map(lambda a: a[0], params_local)
         rank = lax.axis_index(axis)
+        if seq_axis is not None:
+            # params are pp-varying but the activations are (pp, sp)-
+            # varying; the implicit pvary that unifies them would happen
+            # AFTER the model's bf16 cast, and its psum transpose on bf16
+            # grads crashes XLA:CPU's AllReducePromotion (same bug as the
+            # f32 boundary note below). Pre-vary in param dtype (f32)
+            # so the backward's sp-psum of param grads stays f32.
+            sp_vary = lax.axis_index(seq_axis) * 0
+            params = jax.tree_util.tree_map(
+                lambda a: a + sp_vary.astype(a.dtype), params)
         total = n_micro + n_stages - 1
 
         # the carry is device-varying over pp (each rank banks different
@@ -63,10 +79,15 @@ def pipeline_apply(
         # x_all enters f32 (see the boundary note below) and becomes the
         # compute dtype here; adding zero_v also makes it pp-varying so the
         # tick's where(rank==0, inject, buf) needs no implicit pvary.
-        zero_v = (rank * 0).astype(dtype)
+        vary = rank * 0
+        if seq_axis is not None:
+            # the seq-sharded input is seq_axis-varying; the zero-inits and
+            # injected microbatches must carry the same vma type
+            vary = vary + lax.axis_index(seq_axis) * 0
+        zero_v = vary.astype(dtype)
         # varying-making add BEFORE the downcast: the implicit pvary (and
         # its psum transpose in the backward) must see f32, not bf16
-        x_all = (x_all + (rank * 0).astype(x_all.dtype)).astype(dtype)
+        x_all = (x_all + vary.astype(x_all.dtype)).astype(dtype)
         micro_shape = x_all.shape[1:]
         outs0 = jnp.zeros((n_micro,) + micro_shape, dtype) + zero_v
         buf0 = jnp.zeros(micro_shape, dtype) + zero_v
@@ -105,11 +126,16 @@ def pipeline_apply(
     # crashes cloning that body for promoted (bf16) types — f32 is never
     # promoted. Inside, compute stays in x.dtype; one boundary-sized f32
     # collective is noise next to the pipeline itself.
+    manual = {axis}
+    x_spec = P()
+    if seq_axis is not None:
+        manual = {axis, seq_axis}
+        x_spec = P(None, None, seq_axis)
     out = shard_map(
         local,
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
-        axis_names={axis},
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+        axis_names=manual,
     )(stage_params, x.astype(jnp.float32))
     return out.astype(dtype)

@@ -131,9 +131,15 @@ def ring_attention(
             return (o, m, l, k_nxt, v_nxt, seg_nxt), None
 
         b, h, _, d = q_blk.shape
-        o0 = jnp.zeros((b, h, tq, d), jnp.float32)
-        m0 = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
-        l0 = jnp.zeros((b, h, tq), jnp.float32)
+        # zero that carries q's varying-manual-axes type: when this body
+        # runs inside an outer manual region (the pp pipeline), the scan's
+        # carry inits must match the (pp, sp)-varying outputs or the scan
+        # type check rejects the mix (standalone shard_map sets
+        # check_vma=False, but the pipeline's region checks)
+        zv = (q_blk[0, 0, 0, 0] * 0).astype(jnp.float32)
+        o0 = jnp.zeros((b, h, tq, d), jnp.float32) + zv
+        m0 = jnp.full((b, h, tq), _NEG_INF, jnp.float32) + zv
+        l0 = jnp.zeros((b, h, tq), jnp.float32) + zv
         (o, m, l, _, _, _), _ = lax.scan(
             body, (o0, m0, l0, k_blk, v_blk, seg_blk), jnp.arange(n)
         )
@@ -146,6 +152,21 @@ def ring_attention(
     else:
         fn, in_specs, args = (local_fn, (q_spec, q_spec, q_spec, seg_spec),
                               (q, k, v, segment_ids))
+    ctx = jax.sharding.get_abstract_mesh()
+    if ctx is not None and not ctx.empty and axis in ctx.manual_axes:
+        if segment_ids is not None:
+            raise ValueError(
+                "packed segments do not compose with ring attention inside "
+                "an already-manual region (document_starts would renumber "
+                "per-chunk); unpack or drop sp from the pipeline mesh")
+        # Composition with the pp pipeline: we are ALREADY inside a manual
+        # region that includes the ring axis (pipeline_apply manualizes
+        # {pp, sp} when the stages ring — see its seq_axis param), so the
+        # inputs are the per-rank chunks and the ring recurrence runs
+        # directly. A nested shard_map here is not an option: both
+        # partitioners reject re-binding an axis a parent manual region
+        # holds (sdy verifier error; GSPMD crash).
+        return fn(*args)
     return shard_map(
         fn, mesh=mesh, in_specs=in_specs, out_specs=q_spec, check_vma=False,
     )(*args)

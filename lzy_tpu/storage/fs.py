@@ -59,6 +59,8 @@ class FsStorageClient(StorageClient):
         with open(src_path, "rb") as fsrc, open(dst_path, "wb") as fdst:
             left = os.fstat(fsrc.fileno()).st_size
             try:
+                if not hasattr(os, "copy_file_range"):
+                    raise OSError("no copy_file_range on this platform")
                 while left > 0:
                     n = os.copy_file_range(fsrc.fileno(), fdst.fileno(), left)
                     if n == 0:

@@ -220,5 +220,75 @@ class TestLlamaPipeline:
             )
         with pytest.raises(ValueError, match="compose"):
             llama.init_params(
-                self._cfg(use_ring_attention=True), jax.random.PRNGKey(0)
+                self._cfg(use_ulysses_attention=True), jax.random.PRNGKey(0)
             )
+
+
+class TestLlamaPipelineWithRing:
+    """pp × ring sequence parallelism on one mesh (VERDICT r3 #7 — the
+    BASELINE config-4 spirit: pipelined long-context training). The
+    pipeline's manual region covers {pp, sp}; the ring recurrence runs
+    directly against the manual sp axis (nested shard_maps cannot re-bind
+    an axis — both partitioners reject it)."""
+
+    def _cfg(self, **kw):
+        kw.setdefault("pp_stages", 2)
+        kw.setdefault("use_ring_attention", True)
+        return dataclasses.replace(LlamaConfig.tiny(vocab_size=256), **kw)
+
+    def test_pp_ring_forward_matches_dense(self):
+        cfg = self._cfg(dtype=jnp.float32)
+        mesh = mesh_for(8, pp=2, sp=4)
+        params, _ = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size
+        )
+        pp_logits = llama.pp_forward(params, tokens, cfg, mesh)
+        dense_cfg = dataclasses.replace(
+            cfg, pp_stages=0, use_ring_attention=False)
+        dense_logits = Llama(dense_cfg).apply(
+            {"params": llama.unstack_pp_params(cfg, params)}, tokens
+        )
+        np.testing.assert_allclose(
+            np.asarray(pp_logits), np.asarray(dense_logits),
+            atol=2e-4, rtol=2e-4,
+        )
+
+    def test_pp_ring_fsdp_trains(self):
+        cfg = self._cfg()
+        mesh = mesh_for(8, pp=2, sp=2, fsdp=2)
+        params, axes = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tx = optax.adamw(1e-2)
+        step, shard_state, _ = make_train_step(
+            llama.make_loss_fn(cfg, mesh), tx, mesh=mesh,
+            param_logical_axes=axes, batch_logical_axes=("batch", "seq"),
+        )
+        state = shard_state(TrainState.create(params, tx))
+        batch = {
+            "tokens": jax.random.randint(
+                jax.random.PRNGKey(2), (8, 32), 0, cfg.vocab_size
+            )
+        }
+        losses = []
+        for _ in range(5):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0], losses
+
+    def test_ring_without_sp_axis_rejected_clearly(self):
+        """A pp+ring config on a mesh with no usable sp axis must fail at
+        pp_forward with a clear error, not a KeyError deep in tracing."""
+        cfg = self._cfg(dtype=jnp.float32)
+        mesh = mesh_for(2, pp=2)                      # no sp axis
+        params, _ = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.zeros((4, 16), jnp.int32)
+        with pytest.raises(ValueError, match="needs an 'sp' axis"):
+            llama.pp_forward(params, tokens, cfg, mesh)
+
+    def test_seq_not_divisible_by_sp_rejected(self):
+        cfg = self._cfg(dtype=jnp.float32)
+        mesh = mesh_for(8, pp=2, sp=4)
+        params, _ = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.zeros((4, 30), jnp.int32)   # 30 % 4 != 0
+        with pytest.raises(ValueError, match="not divisible by sp"):
+            llama.pp_forward(params, tokens, cfg, mesh)
