@@ -126,7 +126,24 @@ def cmd_serve_console(args) -> None:
     from lzy_tpu.service.console import StatusConsole
 
     store = OperationStore(args.db)
-    console = StatusConsole(store, port=args.port, bind_host=args.bind)
+    # keys/tasks routes ride the store's IAM state when it exists (the
+    # same subjects `python -m lzy_tpu auth` manages) — but only when no
+    # LIVE control plane holds the store's leader lease: the mutating key
+    # routes from a second process would race the plane's own IAM writes
+    # (exactly one writer per store; docs/deployment.md)
+    from lzy_tpu.iam import IamService
+
+    iam = None
+    if any(k.startswith("subject:") for k in store.kv_list("iam")):
+        holder = store.lease_holder("control-plane")
+        if holder is None:
+            iam = IamService(store)
+        else:
+            print(f"store is driven by live control plane {holder[0]}; "
+                  f"keys/tasks routes disabled here — manage subjects "
+                  f"through that plane (read-only status still served)")
+    console = StatusConsole(store, port=args.port, bind_host=args.bind,
+                            iam=iam)
     print(f"console on http://{console.address}/ (Ctrl-C to stop)")
     try:
         import threading

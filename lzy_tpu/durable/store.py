@@ -16,7 +16,7 @@ import json
 import sqlite3
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 RUNNING = "RUNNING"
 DONE = "DONE"
@@ -42,6 +42,11 @@ CREATE TABLE IF NOT EXISTS kv (
     k TEXT NOT NULL,
     v TEXT NOT NULL,
     PRIMARY KEY (ns, k)
+);
+CREATE TABLE IF NOT EXISTS leases (
+    name TEXT PRIMARY KEY,
+    owner TEXT NOT NULL,
+    expires_at REAL NOT NULL
 );
 """
 
@@ -230,3 +235,62 @@ class OperationStore:
                 "SELECT k, v FROM kv WHERE ns = ?", (ns,)
             ).fetchall()
         return {k: json.loads(v) for k, v in rows}
+
+    # -- leases (leader election over the shared store) ------------------------
+    # The reference runs every service replicated against Postgres with
+    # leader-leased GC (lzy-service GarbageCollector); the analog here is a
+    # CAS lease row in the shared store: exactly one control-plane process
+    # holds the named lease, renews it while alive, and a standby (or a
+    # replacement after a crash) takes over only once it expires.
+
+    def try_acquire_lease(self, name: str, owner: str, ttl_s: float) -> bool:
+        """Acquire if free, expired, or already ours. Returns ownership."""
+        now = time.time()
+        with self._lock:
+            cur = self._conn.execute(
+                "UPDATE leases SET owner = ?, expires_at = ? "
+                "WHERE name = ? AND (owner = ? OR expires_at < ?)",
+                (owner, now + ttl_s, name, owner, now),
+            )
+            if cur.rowcount == 0:
+                try:
+                    self._conn.execute(
+                        "INSERT INTO leases (name, owner, expires_at) "
+                        "VALUES (?, ?, ?)",
+                        (name, owner, now + ttl_s),
+                    )
+                except sqlite3.IntegrityError:
+                    self._conn.commit()
+                    return False          # raced another acquirer; it won
+            self._conn.commit()
+            return True
+
+    def renew_lease(self, name: str, owner: str, ttl_s: float) -> bool:
+        """Extend our lease; False means it was lost (expired + taken)."""
+        with self._lock:
+            cur = self._conn.execute(
+                "UPDATE leases SET expires_at = ? "
+                "WHERE name = ? AND owner = ?",
+                (time.time() + ttl_s, name, owner),
+            )
+            self._conn.commit()
+            return cur.rowcount == 1
+
+    def release_lease(self, name: str, owner: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM leases WHERE name = ? AND owner = ?",
+                (name, owner),
+            )
+            self._conn.commit()
+
+    def lease_holder(self, name: str) -> Optional[Tuple[str, float]]:
+        """(owner, expires_at) of a live lease, or None."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT owner, expires_at FROM leases WHERE name = ?",
+                (name,),
+            ).fetchone()
+        if row is None or row[1] < time.time():
+            return None
+        return row[0], row[1]

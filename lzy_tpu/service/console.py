@@ -9,6 +9,16 @@ including one running in a TPU pod, without shipping a JS toolchain.
 
 Routes: ``/`` (overview, auto-refresh), ``/api/<view>`` (JSON),
 ``/healthz``, ``/metrics`` (Prometheus text).
+
+With ``iam=`` wired, the console also covers the reference site's
+``Auth``/``Keys``/``Tasks`` routes (``lzy/site/.../routes/{Auth,Keys,
+Tasks}.java``) in token form — no OAuth dance, the bearer token IS the
+login: ``GET /api/tasks`` (caller's executions + graphs),
+``GET /api/keys`` (own subject; all for INTERNAL),
+``POST /api/keys/rotate`` (self-service credential rotation — the analog
+of a user replacing their key), and INTERNAL-only ``POST /api/keys`` /
+``DELETE /api/keys/<id>`` (operator subject management). Tokens ride
+``Authorization: Bearer`` (query ``?token=`` accepted for curl).
 """
 
 from __future__ import annotations
@@ -65,12 +75,15 @@ class StatusConsole:
     """Serves the console over the deployment's metadata store."""
 
     def __init__(self, store, port: int = 0, bind_host: str = "127.0.0.1",
-                 refresh_s: int = 5):
-        """The console is UNAUTHENTICATED (an operator tool for the control-
-        plane host), so it binds loopback by default; expose it network-wide
-        only deliberately (``bind_host="0.0.0.0"``) behind your own auth
-        proxy — the token-scoped alternative is the GetStatus RPC."""
+                 refresh_s: int = 5, iam=None):
+        """The status pages are UNAUTHENTICATED (an operator tool for the
+        control-plane host), so it binds loopback by default; expose it
+        network-wide only deliberately (``bind_host="0.0.0.0"``) behind
+        your own auth proxy — the token-scoped alternative is the
+        GetStatus RPC. The keys/tasks routes need ``iam`` and a bearer
+        token regardless of bind address."""
         self._store = store
+        self._iam = iam
         self._bind_host = bind_host
         self._refresh_s = refresh_s
         console = self
@@ -79,9 +92,9 @@ class StatusConsole:
             def log_message(self, fmt, *args):  # noqa: N802
                 _LOG.debug("console: " + fmt, *args)
 
-            def do_GET(self):  # noqa: N802
+            def _safely(self, fn):
                 try:
-                    console._route(self)
+                    fn(self)
                 except BrokenPipeError:
                     pass
                 except Exception as e:  # noqa: BLE001 — console must not die
@@ -91,6 +104,15 @@ class StatusConsole:
                     except Exception:
                         pass
 
+            def do_GET(self):  # noqa: N802
+                self._safely(console._route)
+
+            def do_POST(self):  # noqa: N802
+                self._safely(console._route_mutate)
+
+            def do_DELETE(self):  # noqa: N802
+                self._safely(console._route_mutate)
+
         self._httpd = ThreadingHTTPServer((bind_host, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -99,11 +121,73 @@ class StatusConsole:
 
     # -- routing ---------------------------------------------------------------
 
+    # -- auth helpers (iam-gated routes) ---------------------------------------
+
+    def _bearer(self, req: BaseHTTPRequestHandler) -> Optional[str]:
+        auth = req.headers.get("Authorization", "")
+        if auth.startswith("Bearer "):
+            return auth[len("Bearer "):].strip()
+        from urllib.parse import parse_qs, urlparse
+
+        qs = parse_qs(urlparse(req.path).query)
+        return (qs.get("token") or [None])[0]
+
+    def _subject(self, req: BaseHTTPRequestHandler):
+        """Authenticated subject or None-with-response-sent."""
+        if self._iam is None:
+            self._json(req, 404, {"error": "iam not enabled on this plane"})
+            return None
+        try:
+            return self._iam.authenticate(self._bearer(req))
+        except Exception as e:  # noqa: BLE001 — surface as 401, not a 500
+            self._json(req, 401, {"error": str(e)})
+            return None
+
+    def _json(self, req, code: int, doc: Dict[str, Any]) -> None:
+        self._send(req, code, "application/json", json.dumps(doc).encode())
+
+    def _subject_docs(self, only: Optional[str] = None) -> List[Dict[str, Any]]:
+        out = []
+        for key, doc in sorted(self._store.kv_list("iam").items()):
+            if not key.startswith("subject:"):
+                continue
+            sid = key[len("subject:"):]
+            if only is not None and sid != only:
+                continue
+            out.append({"id": sid, "kind": doc.get("kind"),
+                        "role": doc.get("role"),
+                        "generation": doc.get("gen", 0)})
+        return out
+
     def _route(self, req: BaseHTTPRequestHandler) -> None:
         path = req.path.split("?", 1)[0].rstrip("/") or "/"
         if path == "/":
             self._send(req, 200, "text/html; charset=utf-8",
                        self._render_home().encode())
+        elif path == "/api/tasks":
+            # Tasks.java semantics: the CALLER's work, scoped by token
+            subject = self._subject(req)
+            if subject is None:
+                return
+            from lzy_tpu.iam import INTERNAL
+
+            user = None if subject.role == INTERNAL else subject.id
+            self._json(req, 200, {
+                "executions": status_views.collect(
+                    self._store, "executions", user=user),
+                "graphs": status_views.collect(
+                    self._store, "graphs", user=user),
+            })
+        elif path == "/api/keys":
+            # Keys.java semantics: your own credential entry; all of them
+            # for operators
+            subject = self._subject(req)
+            if subject is None:
+                return
+            from lzy_tpu.iam import INTERNAL
+
+            only = None if subject.role == INTERNAL else subject.id
+            self._json(req, 200, {"subjects": self._subject_docs(only)})
         elif path.startswith("/api/"):
             view = path[len("/api/"):]
             try:
@@ -121,6 +205,66 @@ class StatusConsole:
                        REGISTRY.exposition().encode())
         else:
             self._send(req, 404, "text/plain", b"not found")
+
+    def _route_mutate(self, req: BaseHTTPRequestHandler) -> None:
+        """POST/DELETE key management (reference Keys.java + site admin).
+
+        - ``POST /api/keys/rotate``: self-service — invalidate every
+          outstanding token for the CALLER and return a fresh one (the
+          analog of a user replacing their key).
+        - ``POST /api/keys`` {"subject_id", "role"?, "kind"?}: create a
+          subject, returning its bearer token (INTERNAL only).
+        - ``DELETE /api/keys/<id>``: remove a subject (INTERNAL only).
+        """
+        path = req.path.split("?", 1)[0].rstrip("/")
+        subject = self._subject(req)
+        if subject is None:
+            return
+        from lzy_tpu.iam import INTERNAL
+
+        if req.command == "POST" and path == "/api/keys/rotate":
+            token = self._iam.rotate_subject(subject.id)
+            self._json(req, 200, {"subject_id": subject.id, "token": token})
+            return
+        if subject.role != INTERNAL:
+            self._json(req, 403, {"error": "subject management needs the "
+                                           "INTERNAL role"})
+            return
+        if req.command == "POST" and path == "/api/keys":
+            length = int(req.headers.get("Content-Length") or 0)
+            try:
+                doc = json.loads(req.rfile.read(length) or b"{}")
+                subject_id = doc["subject_id"]
+            except (ValueError, KeyError, TypeError):
+                self._json(req, 400,
+                           {"error": "body must be a JSON object with "
+                                     "subject_id"})
+                return
+            if self._subject_docs(subject_id):
+                # re-creating would silently reset the token generation to
+                # 0 (re-validating revoked tokens) and overwrite role/kind
+                self._json(req, 409,
+                           {"error": f"subject {subject_id!r} already "
+                                     f"exists; rotate or delete it instead"})
+                return
+            try:
+                token = self._iam.create_subject(
+                    subject_id, kind=doc.get("kind", "USER"),
+                    role=doc.get("role", "OWNER"))
+            except ValueError as e:
+                self._json(req, 400, {"error": str(e)})
+                return
+            self._json(req, 201, {"subject_id": subject_id, "token": token})
+        elif req.command == "DELETE" and path.startswith("/api/keys/"):
+            subject_id = path[len("/api/keys/"):]
+            if not self._subject_docs(subject_id):
+                self._json(req, 404,
+                           {"error": f"unknown subject {subject_id!r}"})
+                return
+            self._iam.remove_subject(subject_id)
+            self._json(req, 200, {"removed": subject_id})
+        else:
+            self._json(req, 404, {"error": "not found"})
 
     @staticmethod
     def _send(req: BaseHTTPRequestHandler, code: int, ctype: str,

@@ -11,6 +11,8 @@ local single-machine deployment mode, not just a test rig.
 
 from __future__ import annotations
 
+import os
+import time
 from typing import List, Optional, Sequence
 
 from lzy_tpu.channels.manager import ChannelManager
@@ -24,6 +26,10 @@ from lzy_tpu.service.workflow_service import WorkflowService
 from lzy_tpu.storage import DefaultStorageRegistry, StorageConfig
 from lzy_tpu.storage.registry import client_for
 from lzy_tpu.types import PoolSpec, TpuPoolSpec, VmSpec
+
+
+class LeaderLeaseHeld(RuntimeError):
+    """Another control-plane process holds this store's leader lease."""
 
 DEFAULT_POOLS: List[PoolSpec] = [
     # CPU default mirrors the reference's 4 vCPU / 32 GB pool
@@ -57,10 +63,53 @@ class InProcessCluster:
         gc_period_s: Optional[float] = None,   # background GC timer
         execution_ttl_s: float = 86_400.0,     # stale-execution reap age
         backend=None,                     # explicit VmBackend (e.g. GKE)
+        leader_lease_ttl_s: float = 30.0,      # control-plane leader lease
     ):
         self._rpc_port = rpc_port
         self.storage_uri = storage_uri
         self.store = OperationStore(db_path)
+        # Exactly one control-plane process may drive a given metadata
+        # store: the mutating paths are in-process read-modify-write (the
+        # reference runs replicated services against Postgres with leader-
+        # leased GC; the analog here is a CAS lease row in the shared
+        # store). A second plane on the same db fails LOUDLY at boot
+        # instead of corrupting, and can take over once the lease expires
+        # (crash) or is released (clean shutdown). See docs/deployment.md.
+        import uuid as _uuid
+
+        self._lease_owner = f"plane-{os.getpid()}-{_uuid.uuid4().hex[:8]}"
+        self._lease_ttl = leader_lease_ttl_s
+        self._lease_stop = None
+        self.fenced = False
+        if db_path != ":memory:":
+            if not self.store.try_acquire_lease(
+                    "control-plane", self._lease_owner, self._lease_ttl):
+                holder = self.store.lease_holder("control-plane")
+                self.store.close()
+                raise LeaderLeaseHeld(
+                    f"metadata store {db_path!r} is already driven by "
+                    f"control plane {holder[0] if holder else '?'} (lease "
+                    f"expires in "
+                    f"{holder[1] - time.time():.0f}s); exactly one plane "
+                    f"per store — stop it, or wait for its lease to lapse"
+                    if holder else
+                    f"could not acquire the control-plane lease on "
+                    f"{db_path!r}")
+            import threading as _threading
+
+            self._lease_stop = _threading.Event()
+
+            def renew_loop():
+                while not self._lease_stop.wait(self._lease_ttl / 3):
+                    if not self.store.renew_lease(
+                            "control-plane", self._lease_owner,
+                            self._lease_ttl):
+                        self._fence()
+                        return
+
+            self._lease_thread = _threading.Thread(
+                target=renew_loop, name="leader-lease", daemon=True)
+            self._lease_thread.start()
         self.executor = OperationsExecutor(self.store, workers=workers)
         self.channels = ChannelManager(store=self.store)
         self.serializers = default_registry()
@@ -199,7 +248,35 @@ class InProcessCluster:
         (``LzyService.restartNotCompletedOps`` parity)."""
         return self.executor.restore()
 
+    def _fence(self) -> None:
+        """Leader lease lost (we stalled past the TTL and a successor took
+        over): stop mutating the shared store NOW. Detection without
+        enforcement would be split-brain — the successor is already
+        reclaiming our durable ops, so our RPC surface, executor and GC
+        must go dark; in-flight work is the successor's to re-drive."""
+        import logging
+
+        logging.getLogger(__name__).error(
+            "control-plane lease lost — another plane took over; fencing: "
+            "stopping RPC server, executor and GC on this plane")
+        self.fenced = True
+        if self._gc_stop is not None:
+            self._gc_stop.set()
+        try:
+            if self.rpc_server is not None:
+                self.rpc_server.stop()
+        except Exception:  # noqa: BLE001 — fencing is best-effort teardown
+            logging.getLogger(__name__).exception("fencing: rpc stop failed")
+        try:
+            self.executor.shutdown()
+        except Exception:  # noqa: BLE001 — fencing is best-effort teardown
+            logging.getLogger(__name__).exception(
+                "fencing: executor stop failed")
+
     def shutdown(self) -> None:
+        if self._lease_stop is not None:
+            self._lease_stop.set()
+            self._lease_thread.join(timeout=5.0)
         if self._gc_stop is not None:
             # stop AND join: an in-flight tick must not race VM destruction
             # below or outlive the store it reads
@@ -219,4 +296,13 @@ class InProcessCluster:
         if self.rpc_server is not None:
             self.rpc_server.stop()
         self.executor.shutdown()
+        if self._lease_stop is not None:
+            # clean handover: release so a successor boots immediately
+            # instead of waiting out the TTL. LAST mutation before close —
+            # releasing any earlier would let the successor start writing
+            # while this plane's GC/VM/executor teardown is still mutating
+            try:
+                self.store.release_lease("control-plane", self._lease_owner)
+            except Exception:  # noqa: BLE001 — store may already be closed
+                pass
         self.store.close()

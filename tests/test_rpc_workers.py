@@ -126,7 +126,8 @@ def test_worker_reregisters_with_rebooted_control_plane(tmp_path):
     storage = f"file://{tmp_path}/storage"
     c1 = InProcessCluster(db_path=db, storage_uri=storage,
                           worker_mode="process",
-                          worker_pythonpath=TESTS_DIR, poll_period_s=0.1)
+                          worker_pythonpath=TESTS_DIR, poll_period_s=0.1,
+                          leader_lease_ttl_s=0.3)
     lzy1 = c1.lzy()
     wf = lzy1.workflow("reboot-wf")
     wf.__enter__()
@@ -140,8 +141,10 @@ def test_worker_reregisters_with_rebooted_control_plane(tmp_path):
         # worker process survives); bypass harness.shutdown's VM destruction
         c1.rpc_server.stop()
         c1.executor.shutdown()
+        c1._lease_stop.set()            # crash = renewal stops too
         c1.store.close()
 
+    time.sleep(0.4)                      # let the dead plane's lease lapse
     # reboot on the SAME port; the worker's next heartbeats reconnect it
     c2 = InProcessCluster(db_path=db, storage_uri=storage,
                           worker_mode="process",
@@ -218,7 +221,8 @@ def test_task_survives_control_plane_reboot_mid_execution(tmp_path):
     storage = f"file://{tmp_path}/storage"
     c1 = InProcessCluster(db_path=db, storage_uri=storage,
                           worker_mode="process",
-                          worker_pythonpath=TESTS_DIR, poll_period_s=0.1)
+                          worker_pythonpath=TESTS_DIR, poll_period_s=0.1,
+                          leader_lease_ttl_s=0.3)
     c2 = None
     try:
         lzy1 = c1.lzy()
@@ -253,8 +257,10 @@ def test_task_survives_control_plane_reboot_mid_execution(tmp_path):
         # control plane dies mid-execution (worker processes survive)
         c1.rpc_server.stop()
         c1.executor.shutdown()
+        c1._lease_stop.set()            # crash = renewal stops too
         c1.store.close()
 
+        time.sleep(0.4)                  # let the dead plane's lease lapse
         c2 = InProcessCluster(db_path=db, storage_uri=storage,
                               worker_mode="process",
                               worker_pythonpath=TESTS_DIR, poll_period_s=0.1,
