@@ -134,16 +134,20 @@ def cmd_serve_console(args) -> None:
     from lzy_tpu.iam import IamService
 
     iam = None
+    guard = None
     if any(k.startswith("subject:") for k in store.kv_list("iam")):
-        holder = store.lease_holder("control-plane")
-        if holder is None:
-            iam = IamService(store)
-        else:
-            print(f"store is driven by live control plane {holder[0]}; "
-                  f"keys/tasks routes disabled here — manage subjects "
-                  f"through that plane (read-only status still served)")
+        iam = IamService(store)
+
+        def guard():
+            # re-checked per request, not at boot: a plane that starts
+            # AFTER this console must immediately win the mutation path
+            holder = store.lease_holder("control-plane")
+            if holder is not None:
+                return (f"store is driven by live control plane "
+                        f"{holder[0]}; manage subjects through that plane")
+            return None
     console = StatusConsole(store, port=args.port, bind_host=args.bind,
-                            iam=iam)
+                            iam=iam, mutation_guard=guard)
     print(f"console on http://{console.address}/ (Ctrl-C to stop)")
     try:
         import threading

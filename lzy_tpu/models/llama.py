@@ -406,18 +406,18 @@ def _check_pp_config(cfg: LlamaConfig) -> int:
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp_stages={cfg.pp_stages}"
         )
-    unsupported = [
-        name for name, on in [
-            ("use_ulysses_attention", cfg.use_ulysses_attention),
-            ("n_experts", cfg.n_experts > 0),
-            ("decode", cfg.decode),
-        ] if on
-    ]
-    if unsupported:
+    if cfg.decode:
         raise ValueError(
-            f"pp_stages>1 does not compose with {unsupported} (pipeline the "
-            f"dense decoder; decode via unstack_pp_params + the dense tree). "
-            f"Ring sequence parallelism DOES compose (pp x sp)."
+            "pp_stages>1 does not compose with decode (pipeline is for "
+            "training; decode via unstack_pp_params + the dense tree). "
+            "Ring/Ulysses sequence parallelism and MoE DO compose with pp."
+        )
+    if cfg.n_experts > 0 and (cfg.use_ring_attention
+                              or cfg.use_ulysses_attention):
+        raise ValueError(
+            "pp_stages>1 composes with MoE or with sequence parallelism, "
+            "not both at once (the MoE aux loss is not yet sp-reduced "
+            "inside the pipeline region)"
         )
     return cfg.n_layers // cfg.pp_stages
 
@@ -488,17 +488,23 @@ def pp_forward(params, tokens: jax.Array, cfg: LlamaConfig, mesh,
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     seq_axis = None
-    if cfg.use_ring_attention:
+    if cfg.use_ring_attention or cfg.use_ulysses_attention:
+        which = ("use_ring_attention" if cfg.use_ring_attention
+                 else "use_ulysses_attention")
         if "sp" not in mesh.shape or mesh.shape["sp"] < 2:
             raise ValueError(
-                "pp_stages>1 with use_ring_attention needs an 'sp' axis of "
-                "size >= 2 on the mesh (the ring runs against the manual "
-                "sp axis inside the pipeline); add sp to the mesh or drop "
-                "use_ring_attention")
+                f"pp_stages>1 with {which} needs an 'sp' axis of size >= 2 "
+                f"on the mesh (sequence parallelism runs against the manual "
+                f"sp axis inside the pipeline); add sp to the mesh or drop "
+                f"{which}")
         seq_axis = "sp"
         if t % mesh.shape["sp"]:
             raise ValueError(
                 f"seq {t} not divisible by sp={mesh.shape['sp']}")
+        if cfg.use_ulysses_attention and cfg.n_heads % mesh.shape["sp"]:
+            raise ValueError(
+                f"ulysses needs n_heads={cfg.n_heads} divisible by "
+                f"sp={mesh.shape['sp']}")
     # The microbatch reshape mangles the tokens' batch sharding into a 2D
     # split of the leading dims; SPMD can't convert that to the layout it
     # wants at the pipeline boundary without an 'Involuntary full
@@ -509,6 +515,7 @@ def pp_forward(params, tokens: jax.Array, cfg: LlamaConfig, mesh,
     xm = jax.lax.with_sharding_constraint(xm, NamedSharding(mesh, boundary))
 
     stage = LlamaStage(cfg, k, mesh=mesh)
+    with_aux = cfg.n_experts > 0
 
     def stage_fn(p, h):
         t_local = h.shape[1]
@@ -518,10 +525,21 @@ def pp_forward(params, tokens: jax.Array, cfg: LlamaConfig, mesh,
             start = 0
         positions = jnp.broadcast_to(start + jnp.arange(t_local),
                                      (h.shape[0], t_local))
+        if with_aux:
+            y, sown = stage.apply({"params": p}, h, positions,
+                                  mutable=["losses"])
+            aux = sum(jax.tree_util.tree_leaves(sown.get("losses", {})),
+                      jnp.zeros((), jnp.float32))
+            return y, aux
         return stage.apply({"params": p}, h, positions)
 
-    x = pipeline_apply(stage_fn, params["stages"], xm, mesh=mesh, axis=axis,
-                       seq_axis=seq_axis)
+    aux = jnp.zeros((), jnp.float32)
+    out = pipeline_apply(stage_fn, params["stages"], xm, mesh=mesh, axis=axis,
+                         seq_axis=seq_axis, with_aux=with_aux)
+    if with_aux:
+        x, aux = out
+    else:
+        x = out
     # same voluntary trick on the way out: the constraint transposes to
     # itself, so the BACKWARD cotangent (embed-sharded by the head matmul)
     # is gathered explicitly at the boundary instead of via SPMD's
@@ -533,11 +551,15 @@ def pp_forward(params, tokens: jax.Array, cfg: LlamaConfig, mesh,
     )
     head = params["embed_tokens"] if cfg.tie_embeddings else params["lm_head"]
     if cfg.fused_ce:
-        return x.astype(cfg.dtype), head.astype(cfg.dtype)
-    return jnp.einsum(
-        "bte,ve->btv", x.astype(cfg.dtype), head.astype(cfg.dtype),
-        preferred_element_type=jnp.float32,
-    )
+        out = (x.astype(cfg.dtype), head.astype(cfg.dtype))
+    else:
+        out = jnp.einsum(
+            "bte,ve->btv", x.astype(cfg.dtype), head.astype(cfg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+    # MoE configs also return the stages' summed load-balancing aux loss
+    # (accumulated bubble-masked inside the pipeline)
+    return (out, aux) if with_aux else out
 
 
 def unstack_pp_params(cfg: LlamaConfig, params):
@@ -579,9 +601,12 @@ def make_loss_fn(cfg: LlamaConfig, mesh=None):
             if batch.get("segments") is not None:
                 raise ValueError("packed segments do not compose with pp yet")
             out = pp_forward(params, tokens, cfg, mesh)
+            aux = 0.0
+            if cfg.n_experts > 0:
+                out, aux = out
             mask = batch.get("mask")
             shifted_mask = mask[:, 1:] if mask is not None else None
-            return _lm_loss(cfg, out, tokens, shifted_mask)
+            return _lm_loss(cfg, out, tokens, shifted_mask) + aux
 
         return pp_loss_fn
     model = Llama(cfg)

@@ -31,7 +31,8 @@ def pipeline_apply(
     mesh: Mesh,
     axis: str = "pp",
     seq_axis: str = None,
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Run ``x`` through ``n_stages`` sequential applications of ``stage_fn``.
 
     - ``stage_params``: pytree whose leaves have leading dim ``n_stages``
@@ -42,10 +43,20 @@ def pipeline_apply(
       sequence) enters sharded over ``seq_axis``, so a ring-attention body
       inside ``stage_fn`` runs directly against the manual axis (nested
       shard_maps cannot re-bind an axis — both partitioners reject it).
+    - ``with_aux``: ``stage_fn`` returns ``(y, aux_scalar)`` (e.g. MoE
+      load-balancing losses); the pipeline sums aux over stages and
+      AVERAGES over microbatches, masking out the fill/drain bubble ticks
+      where a stage chews on garbage (their aux must not leak into the
+      loss). Returns ``(outs, aux)``.
 
     Returns ``[n_micro, micro_batch, ...]`` outputs, equal to applying the
-    stages sequentially to each microbatch.
+    stages sequentially to each microbatch (plus aux when ``with_aux``).
     """
+    if with_aux and seq_axis is not None:
+        raise ValueError(
+            "with_aux does not compose with seq_axis yet: the aux scalar "
+            "is only psummed over the pipeline axis, so per-sp-rank "
+            "partials would silently masquerade as replicated")
     n_stages = mesh.shape[axis]
     n_micro = x.shape[0]
     dtype = x.dtype
@@ -93,12 +104,23 @@ def pipeline_apply(
         buf0 = jnp.zeros(micro_shape, dtype) + zero_v
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
+        aux0 = jnp.zeros((), jnp.float32) + zero_v.astype(jnp.float32)
+
         def tick(carry, t):
-            buf_in, outs = carry
+            buf_in, outs, aux_acc = carry
             # stage 0 injects microbatch t (clamped; masked out past the end)
             inject = x_all[jnp.minimum(t, n_micro - 1)]
             cur = jnp.where(rank == 0, inject, buf_in)
-            y = stage_fn(params, cur)
+            if with_aux:
+                y, aux = stage_fn(params, cur)
+                # this rank does REAL work for microbatch t-rank only while
+                # that index is in range — fill/drain ticks chew on garbage
+                # and their aux must not leak into the loss
+                working = (t >= rank) & (t - rank < n_micro)
+                aux_acc = aux_acc + jnp.where(
+                    working, aux.astype(jnp.float32), 0.0)
+            else:
+                y = stage_fn(params, cur)
             # last stage banks finished microbatch t-(n_stages-1)
             out_idx = t - (n_stages - 1)
             valid = (rank == n_stages - 1) & (out_idx >= 0)
@@ -109,12 +131,19 @@ def pipeline_apply(
                 outs,
             )
             buf_next = lax.ppermute(y, axis, perm)
-            return (buf_next, outs), None
+            return (buf_next, outs, aux_acc), None
 
-        (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(total))
+        (_, outs, aux_acc), _ = lax.scan(
+            tick, (buf0, outs0, aux0), jnp.arange(total))
         # only the last stage banked real outputs (every other rank kept
         # zeros), so a psum replicates them to all ranks in one collective
-        return lax.psum(outs.astype(jnp.float32), axis)
+        outs = lax.psum(outs.astype(jnp.float32), axis)
+        if with_aux:
+            # sum over stages (each rank accumulated its own layers' aux),
+            # mean over microbatches — equal micro sizes make this exactly
+            # the dense full-batch aux
+            return outs, lax.psum(aux_acc, axis) / n_micro
+        return outs
 
     # only ``pp`` is manual: the other mesh axes (dp/fsdp/tp) stay auto, so
     # the stage body's matmuls are sharded by XLA from the params' own
@@ -131,11 +160,15 @@ def pipeline_apply(
     if seq_axis is not None:
         manual = {axis, seq_axis}
         x_spec = P(None, None, seq_axis)
+    out_specs = (x_spec, P()) if with_aux else x_spec
     out = shard_map(
         local,
         mesh=mesh,
         in_specs=(param_specs, x_spec),
-        out_specs=x_spec,
+        out_specs=out_specs,
         axis_names=manual,
     )(stage_params, x.astype(jnp.float32))
+    if with_aux:
+        y, aux = out
+        return y.astype(dtype), aux
     return out.astype(dtype)

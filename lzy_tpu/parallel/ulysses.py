@@ -87,6 +87,19 @@ def ulysses_attention(
     else:
         fn, in_specs, args = (local_fn, (q_spec, q_spec, q_spec, seg_spec),
                               (q, k, v, segment_ids))
+    ctx = jax.sharding.get_abstract_mesh()
+    if ctx is not None and not ctx.empty and axis in ctx.manual_axes:
+        # Composition with the pp pipeline (same shape as ring.py): we are
+        # already inside a manual region holding the sp axis, the inputs
+        # are per-rank chunks, and the all-to-alls run directly against
+        # the manual axis — a nested shard_map cannot re-bind it.
+        if segment_ids is not None:
+            raise ValueError(
+                "packed segments do not compose with ulysses attention "
+                "inside an already-manual region (document_starts would "
+                "renumber per-chunk); unpack or drop sp from the pipeline "
+                "mesh")
+        return fn(*args)
     return shard_map(
         fn, mesh=mesh, in_specs=in_specs, out_specs=q_spec, check_vma=False,
     )(*args)

@@ -75,7 +75,7 @@ class StatusConsole:
     """Serves the console over the deployment's metadata store."""
 
     def __init__(self, store, port: int = 0, bind_host: str = "127.0.0.1",
-                 refresh_s: int = 5, iam=None):
+                 refresh_s: int = 5, iam=None, mutation_guard=None):
         """The status pages are UNAUTHENTICATED (an operator tool for the
         control-plane host), so it binds loopback by default; expose it
         network-wide only deliberately (``bind_host="0.0.0.0"``) behind
@@ -84,6 +84,11 @@ class StatusConsole:
         token regardless of bind address."""
         self._store = store
         self._iam = iam
+        # optional callable run before every MUTATING route; returning a
+        # string refuses the mutation with 503 + that reason (serve-console
+        # uses it to re-check the control-plane lease at request time — a
+        # boot-time check would go stale the moment a plane starts)
+        self._mutation_guard = mutation_guard
         self._bind_host = bind_host
         self._refresh_s = refresh_s
         console = self
@@ -217,6 +222,11 @@ class StatusConsole:
         - ``DELETE /api/keys/<id>``: remove a subject (INTERNAL only).
         """
         path = req.path.split("?", 1)[0].rstrip("/")
+        if self._mutation_guard is not None:
+            refusal = self._mutation_guard()
+            if refusal:
+                self._json(req, 503, {"error": refusal})
+                return
         subject = self._subject(req)
         if subject is None:
             return

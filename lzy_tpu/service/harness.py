@@ -80,6 +80,7 @@ class InProcessCluster:
         self._lease_owner = f"plane-{os.getpid()}-{_uuid.uuid4().hex[:8]}"
         self._lease_ttl = leader_lease_ttl_s
         self._lease_stop = None
+        self._lease_acquired = False
         self.fenced = False
         if db_path != ":memory:":
             if not self.store.try_acquire_lease(
@@ -95,6 +96,32 @@ class InProcessCluster:
                     if holder else
                     f"could not acquire the control-plane lease on "
                     f"{db_path!r}")
+            self._lease_acquired = True
+        # the rest of construction runs with the lease held but the renewal
+        # thread NOT yet started (it fences through attributes assigned
+        # below); a constructor failure must release the lease or every
+        # retry in this process would see LeaderLeaseHeld forever
+        try:
+            self._init_services(
+                storage_uri=storage_uri, pools=pools, workers=workers,
+                max_running_tasks=max_running_tasks,
+                poll_period_s=poll_period_s,
+                vm_boot_delay_s=vm_boot_delay_s,
+                p2p_spill_root=p2p_spill_root, with_iam=with_iam,
+                container_runtime=container_runtime, worker_mode=worker_mode,
+                worker_pythonpath=worker_pythonpath, debug_rpc=debug_rpc,
+                gc_period_s=gc_period_s, execution_ttl_s=execution_ttl_s,
+                backend=backend,
+            )
+        except BaseException:
+            if self._lease_acquired:
+                try:
+                    self.store.release_lease("control-plane",
+                                             self._lease_owner)
+                except Exception:  # noqa: BLE001 — best-effort unwind
+                    pass
+            raise
+        if self._lease_acquired:
             import threading as _threading
 
             self._lease_stop = _threading.Event()
@@ -110,6 +137,12 @@ class InProcessCluster:
             self._lease_thread = _threading.Thread(
                 target=renew_loop, name="leader-lease", daemon=True)
             self._lease_thread.start()
+
+    def _init_services(self, *, storage_uri, pools, workers,
+                       max_running_tasks, poll_period_s, vm_boot_delay_s,
+                       p2p_spill_root, with_iam, container_runtime,
+                       worker_mode, worker_pythonpath, debug_rpc,
+                       gc_period_s, execution_ttl_s, backend):
         self.executor = OperationsExecutor(self.store, workers=workers)
         self.channels = ChannelManager(store=self.store)
         self.serializers = default_registry()
@@ -179,7 +212,7 @@ class InProcessCluster:
         if worker_mode == "process":
             from lzy_tpu.rpc import ControlPlaneServer
 
-            self.rpc_server = ControlPlaneServer(self, port=rpc_port,
+            self.rpc_server = ControlPlaneServer(self, port=self._rpc_port,
                                                  debug=debug_rpc)
         # background GC (the reference runs GarbageCollector timers inside
         # each service; here one timer covers allocator + executions)

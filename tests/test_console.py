@@ -292,17 +292,6 @@ class TestKeysAndTasksRoutes:
                               token=tokens["ops"])
         assert status == 404
 
-    def test_keys_routes_404_without_iam(self, cluster):
-        console = StatusConsole(cluster.store)
-        try:
-            status, doc = request(console, "GET", "/api/keys", token="x")
-            assert status == 404 and "iam not enabled" in doc["error"]
-        finally:
-            console.stop()
-
-
-def cluster_store(c):
-    return c.store
 
     def test_recreating_a_subject_conflicts(self, plane):
         """POST /api/keys on an existing id must 409, not silently reset
@@ -318,3 +307,15 @@ def cluster_store(c):
         status, doc = request(console, "POST", "/api/keys",
                               token=tokens["ops"], body="just-a-string")
         assert status == 400
+
+    def test_keys_routes_404_without_iam(self, cluster):
+        console = StatusConsole(cluster.store)
+        try:
+            status, doc = request(console, "GET", "/api/keys", token="x")
+            assert status == 404 and "iam not enabled" in doc["error"]
+        finally:
+            console.stop()
+
+
+def cluster_store(c):
+    return c.store

@@ -220,8 +220,70 @@ class TestLlamaPipeline:
             )
         with pytest.raises(ValueError, match="compose"):
             llama.init_params(
-                self._cfg(use_ulysses_attention=True), jax.random.PRNGKey(0)
+                self._cfg(decode=True), jax.random.PRNGKey(0)
             )
+
+
+class TestLlamaPipelineWithMoe:
+    """pp × MoE: the stages' sown load-balancing aux rides the pipeline
+    (bubble-masked, summed over stages, averaged over microbatches)."""
+
+    def test_pp_moe_matches_per_microbatch_dense(self):
+        """Exact spec: pipeline == dense applied PER MICROBATCH (MoE
+        capacity is per-group, so full-batch dense differs by design —
+        same as every GPipe×MoE system)."""
+        cfg = dataclasses.replace(
+            LlamaConfig.tiny(vocab_size=256), pp_stages=2, n_experts=4,
+            dtype=jnp.float32)
+        mesh = mesh_for(8, pp=2, fsdp=4)
+        params, _ = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size)
+        pp_logits, pp_aux = llama.pp_forward(params, tokens, cfg, mesh)
+
+        dense_cfg = dataclasses.replace(cfg, pp_stages=0)
+        dense_params = llama.unstack_pp_params(cfg, params)
+        n_micro, mb = cfg.pp_stages, tokens.shape[0] // cfg.pp_stages
+        outs, auxs = [], []
+        for i in range(n_micro):
+            lg, sown = Llama(dense_cfg).apply(
+                {"params": dense_params}, tokens[i * mb:(i + 1) * mb],
+                mutable=["losses"])
+            outs.append(lg)
+            auxs.append(sum(
+                jax.tree_util.tree_leaves(sown.get("losses", {})),
+                jnp.zeros((), jnp.float32)))
+        np.testing.assert_allclose(
+            np.asarray(pp_logits), np.asarray(jnp.concatenate(outs, 0)),
+            atol=2e-4, rtol=2e-4)
+        dense_aux = sum(float(a) for a in auxs) / n_micro
+        assert abs(float(pp_aux) - dense_aux) < 1e-6
+        assert float(pp_aux) > 0          # the aux really flowed out
+
+    def test_pp_moe_ep_fsdp_trains(self):
+        cfg = dataclasses.replace(
+            LlamaConfig.tiny(vocab_size=256), pp_stages=2, n_experts=4)
+        mesh = mesh_for(8, pp=2, ep=2, fsdp=2)
+        params, axes = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tx = optax.adamw(1e-2)
+        step, shard_state, _ = make_train_step(
+            llama.make_loss_fn(cfg, mesh), tx, mesh=mesh,
+            param_logical_axes=axes, batch_logical_axes=("batch", "seq"))
+        state = shard_state(TrainState.create(params, tx))
+        batch = {"tokens": jax.random.randint(
+            jax.random.PRNGKey(2), (8, 32), 0, cfg.vocab_size)}
+        losses = []
+        for _ in range(5):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0], losses
+
+    def test_pp_moe_with_sp_rejected_clearly(self):
+        cfg = dataclasses.replace(
+            LlamaConfig.tiny(vocab_size=256), pp_stages=2, n_experts=4,
+            use_ring_attention=True)
+        with pytest.raises(ValueError, match="not both at once"):
+            llama.init_params(cfg, jax.random.PRNGKey(0))
 
 
 class TestLlamaPipelineWithRing:
@@ -274,6 +336,39 @@ class TestLlamaPipelineWithRing:
             state, metrics = step(state, batch)
             losses.append(float(metrics["loss"]))
         assert losses[-1] < losses[0], losses
+
+    def test_pp_ulysses_forward_matches_dense(self):
+        """Ulysses composes with pp the same way ring does: the all-to-
+        alls run directly against the manual sp axis inside the stages."""
+        cfg = dataclasses.replace(
+            LlamaConfig.tiny(vocab_size=256), pp_stages=2,
+            use_ulysses_attention=True, dtype=jnp.float32)
+        mesh = mesh_for(8, pp=2, sp=4)
+        params, _ = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size
+        )
+        pp_logits = llama.pp_forward(params, tokens, cfg, mesh)
+        dense_cfg = dataclasses.replace(
+            cfg, pp_stages=0, use_ulysses_attention=False)
+        dense_logits = Llama(dense_cfg).apply(
+            {"params": llama.unstack_pp_params(cfg, params)}, tokens
+        )
+        np.testing.assert_allclose(
+            np.asarray(pp_logits), np.asarray(dense_logits),
+            atol=2e-4, rtol=2e-4,
+        )
+
+    def test_pp_ulysses_heads_divisibility_checked(self):
+        cfg = dataclasses.replace(
+            LlamaConfig.tiny(vocab_size=256), pp_stages=2,
+            use_ulysses_attention=True, dtype=jnp.float32, n_heads=6,
+            n_kv_heads=2, d_model=96)
+        mesh = mesh_for(8, pp=2, sp=4)
+        params, _ = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.zeros((4, 32), jnp.int32)
+        with pytest.raises(ValueError, match="n_heads=6 divisible"):
+            llama.pp_forward(params, tokens, cfg, mesh)
 
     def test_ring_without_sp_axis_rejected_clearly(self):
         """A pp+ring config on a mesh with no usable sp axis must fail at
