@@ -382,7 +382,7 @@ class LlamaStage(nn.Module):
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, segments=None):
         cfg = self.cfg
         layer = DecoderLayer
         if cfg.remat:
@@ -391,7 +391,8 @@ class LlamaStage(nn.Module):
                 policy=jax.checkpoint_policies.nothing_saveable,
             )
         for i in range(self.n_layers):
-            x = layer(cfg, name=f"layer_{i}")(x, positions, self.mesh)
+            x = layer(cfg, name=f"layer_{i}")(x, positions, self.mesh,
+                                              segments)
         return x
 
 
@@ -459,12 +460,18 @@ def _init_pp_params(cfg: LlamaConfig, rng: jax.Array, seq_len: int):
 
 
 def pp_forward(params, tokens: jax.Array, cfg: LlamaConfig, mesh,
-               axis: str = "pp"):
+               axis: str = "pp", segments=None):
     """Pipelined forward: embed → GPipe over the decoder stack → norm + head.
 
     Embedding/norm/head run outside the pipeline (replicated over ``pp``,
     sharded over the remaining mesh axes as usual); only the decoder stack
-    streams microbatches stage-to-stage over ``ppermute`` neighbor hops."""
+    streams microbatches stage-to-stage over ``ppermute`` neighbor hops.
+
+    ``segments``: optional ``[B, T]`` packed-document ids; each stage
+    looks up its current microbatch's segment chunk by index (the
+    pipeline passes ``micro_idx``) so attention masking and per-document
+    RoPE restarts follow their microbatch through the stages. Not yet
+    composable with sequence parallelism inside the pipeline."""
     from lzy_tpu.parallel.pipeline import pipeline_apply
 
     k = _check_pp_config(cfg)
@@ -488,6 +495,11 @@ def pp_forward(params, tokens: jax.Array, cfg: LlamaConfig, mesh,
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     seq_axis = None
+    if segments is not None and (cfg.use_ring_attention
+                                 or cfg.use_ulysses_attention):
+        raise ValueError(
+            "packed segments do not compose with sequence parallelism "
+            "inside the pipeline yet (drop sp or unpack)")
     if cfg.use_ring_attention or cfg.use_ulysses_attention:
         which = ("use_ring_attention" if cfg.use_ring_attention
                  else "use_ulysses_attention")
@@ -516,26 +528,40 @@ def pp_forward(params, tokens: jax.Array, cfg: LlamaConfig, mesh,
 
     stage = LlamaStage(cfg, k, mesh=mesh)
     with_aux = cfg.n_experts > 0
+    segs_m = None
+    if segments is not None:
+        segs_m = segments.reshape(n_micro, mb, t)
 
-    def stage_fn(p, h):
+    def stage_fn(p, h, micro_idx=None):
+        seg = None
         t_local = h.shape[1]
         if seq_axis is not None:
             start = jax.lax.axis_index(seq_axis) * t_local
+            positions = jnp.broadcast_to(start + jnp.arange(t_local),
+                                         (h.shape[0], t_local))
+        elif segs_m is not None:
+            # packed docs: this microbatch's ids ride along by index, and
+            # RoPE restarts at every document (dense-path semantics)
+            from lzy_tpu.ops.flash_attention import document_starts
+
+            seg = segs_m[micro_idx]
+            idx = jnp.arange(t_local, dtype=jnp.int32)
+            positions = idx[None, :] - document_starts(seg)
         else:
-            start = 0
-        positions = jnp.broadcast_to(start + jnp.arange(t_local),
-                                     (h.shape[0], t_local))
+            positions = jnp.broadcast_to(jnp.arange(t_local),
+                                         (h.shape[0], t_local))
         if with_aux:
-            y, sown = stage.apply({"params": p}, h, positions,
+            y, sown = stage.apply({"params": p}, h, positions, seg,
                                   mutable=["losses"])
             aux = sum(jax.tree_util.tree_leaves(sown.get("losses", {})),
                       jnp.zeros((), jnp.float32))
             return y, aux
-        return stage.apply({"params": p}, h, positions)
+        return stage.apply({"params": p}, h, positions, seg)
 
     aux = jnp.zeros((), jnp.float32)
     out = pipeline_apply(stage_fn, params["stages"], xm, mesh=mesh, axis=axis,
-                         seq_axis=seq_axis, with_aux=with_aux)
+                         seq_axis=seq_axis, with_aux=with_aux,
+                         pass_micro_index=segs_m is not None)
     if with_aux:
         x, aux = out
     else:
@@ -598,14 +624,19 @@ def make_loss_fn(cfg: LlamaConfig, mesh=None):
 
         def pp_loss_fn(params, batch):
             tokens = batch["tokens"]
-            if batch.get("segments") is not None:
-                raise ValueError("packed segments do not compose with pp yet")
-            out = pp_forward(params, tokens, cfg, mesh)
+            segments = batch.get("segments")
+            out = pp_forward(params, tokens, cfg, mesh, segments=segments)
             aux = 0.0
             if cfg.n_experts > 0:
                 out, aux = out
             mask = batch.get("mask")
             shifted_mask = mask[:, 1:] if mask is not None else None
+            if segments is not None:
+                # a position whose next token belongs to a different
+                # document must not be asked to predict it (dense-path rule)
+                same_doc = segments[:, 1:] == segments[:, :-1]
+                shifted_mask = same_doc if shifted_mask is None \
+                    else jnp.logical_and(shifted_mask, same_doc)
             return _lm_loss(cfg, out, tokens, shifted_mask) + aux
 
         return pp_loss_fn

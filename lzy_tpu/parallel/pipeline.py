@@ -32,6 +32,7 @@ def pipeline_apply(
     axis: str = "pp",
     seq_axis: str = None,
     with_aux: bool = False,
+    pass_micro_index: bool = False,
 ):
     """Run ``x`` through ``n_stages`` sequential applications of ``stage_fn``.
 
@@ -48,6 +49,12 @@ def pipeline_apply(
       AVERAGES over microbatches, masking out the fill/drain bubble ticks
       where a stage chews on garbage (their aux must not leak into the
       loss). Returns ``(outs, aux)``.
+    - ``pass_micro_index``: ``stage_fn`` is called as ``stage_fn(params,
+      h, micro_idx)`` where ``micro_idx`` is the (traced, clamped) index
+      of the microbatch this stage is processing this tick — the hook
+      for per-microbatch side inputs closed over by the caller (packed
+      segment ids, masks) that must follow their microbatch through the
+      stages.
 
     Returns ``[n_micro, micro_batch, ...]`` outputs, equal to applying the
     stages sequentially to each microbatch (plus aux when ``with_aux``).
@@ -111,16 +118,19 @@ def pipeline_apply(
             # stage 0 injects microbatch t (clamped; masked out past the end)
             inject = x_all[jnp.minimum(t, n_micro - 1)]
             cur = jnp.where(rank == 0, inject, buf_in)
+            # this rank processes microbatch t-rank (clamped into range:
+            # fill/drain ticks chew on garbage and their outputs/aux are
+            # masked out downstream)
+            micro_idx = jnp.clip(t - rank, 0, n_micro - 1)
+            call = ((lambda p, h: stage_fn(p, h, micro_idx))
+                    if pass_micro_index else stage_fn)
             if with_aux:
-                y, aux = stage_fn(params, cur)
-                # this rank does REAL work for microbatch t-rank only while
-                # that index is in range — fill/drain ticks chew on garbage
-                # and their aux must not leak into the loss
+                y, aux = call(params, cur)
                 working = (t >= rank) & (t - rank < n_micro)
                 aux_acc = aux_acc + jnp.where(
                     working, aux.astype(jnp.float32), 0.0)
             else:
-                y = stage_fn(params, cur)
+                y = call(params, cur)
             # last stage banks finished microbatch t-(n_stages-1)
             out_idx = t - (n_stages - 1)
             valid = (rank == n_stages - 1) & (out_idx >= 0)

@@ -97,31 +97,9 @@ class InProcessCluster:
                     f"could not acquire the control-plane lease on "
                     f"{db_path!r}")
             self._lease_acquired = True
-        # the rest of construction runs with the lease held but the renewal
-        # thread NOT yet started (it fences through attributes assigned
-        # below); a constructor failure must release the lease or every
-        # retry in this process would see LeaderLeaseHeld forever
-        try:
-            self._init_services(
-                storage_uri=storage_uri, pools=pools, workers=workers,
-                max_running_tasks=max_running_tasks,
-                poll_period_s=poll_period_s,
-                vm_boot_delay_s=vm_boot_delay_s,
-                p2p_spill_root=p2p_spill_root, with_iam=with_iam,
-                container_runtime=container_runtime, worker_mode=worker_mode,
-                worker_pythonpath=worker_pythonpath, debug_rpc=debug_rpc,
-                gc_period_s=gc_period_s, execution_ttl_s=execution_ttl_s,
-                backend=backend,
-            )
-        except BaseException:
-            if self._lease_acquired:
-                try:
-                    self.store.release_lease("control-plane",
-                                             self._lease_owner)
-                except Exception:  # noqa: BLE001 — best-effort unwind
-                    pass
-            raise
-        if self._lease_acquired:
+            # renewal starts IMMEDIATELY (a slow construction must not let
+            # the lease lapse mid-boot — split-brain window); _fence()
+            # guards attributes that construction has not assigned yet
             import threading as _threading
 
             self._lease_stop = _threading.Event()
@@ -137,6 +115,30 @@ class InProcessCluster:
             self._lease_thread = _threading.Thread(
                 target=renew_loop, name="leader-lease", daemon=True)
             self._lease_thread.start()
+        # a constructor failure must release the lease (and stop renewing)
+        # or every retry in this process would see LeaderLeaseHeld forever
+        try:
+            self._init_services(
+                storage_uri=storage_uri, pools=pools, workers=workers,
+                max_running_tasks=max_running_tasks,
+                poll_period_s=poll_period_s,
+                vm_boot_delay_s=vm_boot_delay_s,
+                p2p_spill_root=p2p_spill_root, with_iam=with_iam,
+                container_runtime=container_runtime, worker_mode=worker_mode,
+                worker_pythonpath=worker_pythonpath, debug_rpc=debug_rpc,
+                gc_period_s=gc_period_s, execution_ttl_s=execution_ttl_s,
+                backend=backend,
+            )
+        except BaseException:
+            if self._lease_acquired:
+                self._lease_stop.set()
+                self._lease_thread.join(timeout=5.0)
+                try:
+                    self.store.release_lease("control-plane",
+                                             self._lease_owner)
+                except Exception:  # noqa: BLE001 — best-effort unwind
+                    pass
+            raise
 
     def _init_services(self, *, storage_uri, pools, workers,
                        max_running_tasks, poll_period_s, vm_boot_delay_s,
@@ -293,15 +295,19 @@ class InProcessCluster:
             "control-plane lease lost — another plane took over; fencing: "
             "stopping RPC server, executor and GC on this plane")
         self.fenced = True
-        if self._gc_stop is not None:
+        # getattr-guarded: renewal runs from the moment the lease is taken,
+        # so a (pathological) loss DURING construction fences whatever
+        # exists so far; later-constructed components check self.fenced
+        if getattr(self, "_gc_stop", None) is not None:
             self._gc_stop.set()
         try:
-            if self.rpc_server is not None:
+            if getattr(self, "rpc_server", None) is not None:
                 self.rpc_server.stop()
         except Exception:  # noqa: BLE001 — fencing is best-effort teardown
             logging.getLogger(__name__).exception("fencing: rpc stop failed")
         try:
-            self.executor.shutdown()
+            if getattr(self, "executor", None) is not None:
+                self.executor.shutdown()
         except Exception:  # noqa: BLE001 — fencing is best-effort teardown
             logging.getLogger(__name__).exception(
                 "fencing: executor stop failed")
