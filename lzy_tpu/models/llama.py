@@ -632,11 +632,7 @@ def make_loss_fn(cfg: LlamaConfig, mesh=None):
             mask = batch.get("mask")
             shifted_mask = mask[:, 1:] if mask is not None else None
             if segments is not None:
-                # a position whose next token belongs to a different
-                # document must not be asked to predict it (dense-path rule)
-                same_doc = segments[:, 1:] == segments[:, :-1]
-                shifted_mask = same_doc if shifted_mask is None \
-                    else jnp.logical_and(shifted_mask, same_doc)
+                shifted_mask = _segment_shift_mask(segments, shifted_mask)
             return _lm_loss(cfg, out, tokens, shifted_mask) + aux
 
         return pp_loss_fn
@@ -660,14 +656,19 @@ def make_loss_fn(cfg: LlamaConfig, mesh=None):
         mask = batch.get("mask")
         shifted_mask = mask[:, 1:] if mask is not None else None
         if segments is not None:
-            # a position whose next token belongs to a different document
-            # must not be asked to predict it
-            same_doc = segments[:, 1:] == segments[:, :-1]
-            shifted_mask = same_doc if shifted_mask is None \
-                else jnp.logical_and(shifted_mask, same_doc)
+            shifted_mask = _segment_shift_mask(segments, shifted_mask)
         return _lm_loss(cfg, logits, tokens, shifted_mask) + aux
 
     return loss_fn
+
+
+def _segment_shift_mask(segments, shifted_mask):
+    """Cross-document next-token rule shared by the dense and pp losses: a
+    position whose next token belongs to a different document must not be
+    asked to predict it."""
+    same_doc = segments[:, 1:] == segments[:, :-1]
+    return same_doc if shifted_mask is None \
+        else jnp.logical_and(shifted_mask, same_doc)
 
 
 def _lm_loss(cfg: LlamaConfig, out, tokens, shifted_mask):

@@ -139,6 +139,14 @@ class InProcessCluster:
                 except Exception:  # noqa: BLE001 — best-effort unwind
                     pass
             raise
+        if self.fenced:
+            # the lease was lost WHILE construction ran: _fence() fired
+            # before these components existed, so fence again now that
+            # they do, and refuse to hand out a split-brain plane
+            self._fence()
+            raise LeaderLeaseHeld(
+                "control-plane lease lost during construction — another "
+                "plane took over; this instance is fenced")
 
     def _init_services(self, *, storage_uri, pools, workers,
                        max_running_tasks, poll_period_s, vm_boot_delay_s,
@@ -297,7 +305,8 @@ class InProcessCluster:
         self.fenced = True
         # getattr-guarded: renewal runs from the moment the lease is taken,
         # so a (pathological) loss DURING construction fences whatever
-        # exists so far; later-constructed components check self.fenced
+        # exists so far; __init__ re-checks self.fenced once construction
+        # completes and fences the rest (raising LeaderLeaseHeld)
         if getattr(self, "_gc_stop", None) is not None:
             self._gc_stop.set()
         try:
