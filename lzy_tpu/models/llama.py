@@ -46,6 +46,11 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # which activations remat KEEPS: "nothing" (max memory savings),
+    # "dots" (save matmul outputs — the standard TPU transformer policy:
+    # recompute cheap elementwise/norms, keep the MXU work), "all" is
+    # spelled remat=False
+    remat_policy: str = "nothing"
     tie_embeddings: bool = False         # Llama-3 uses an untied lm_head
     use_ring_attention: bool = False     # SP via ppermute ring over 'sp'
     use_ulysses_attention: bool = False  # SP via all-to-all head resharding
@@ -105,6 +110,20 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(x.dtype)
+
+
+def _remat_policy(name: str):
+    """Checkpoint policy by name (LlamaConfig.remat_policy)."""
+    policies = {
+        "nothing": jax.checkpoint_policies.nothing_saveable,
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    try:
+        return policies[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown remat_policy {name!r}; known: {sorted(policies)}"
+        ) from None
 
 
 class RMSNorm(nn.Module):
@@ -338,7 +357,7 @@ class Llama(nn.Module):
         if cfg.remat:
             layer = nn.remat(
                 DecoderLayer, static_argnums=(),
-                policy=jax.checkpoint_policies.nothing_saveable,
+                policy=_remat_policy(cfg.remat_policy),
             )
         for i in range(cfg.n_layers):
             x = layer(cfg, name=f"layer_{i}")(x, positions, mesh, segments)
@@ -388,7 +407,7 @@ class LlamaStage(nn.Module):
         if cfg.remat:
             layer = nn.remat(
                 DecoderLayer, static_argnums=(),
-                policy=jax.checkpoint_policies.nothing_saveable,
+                policy=_remat_policy(cfg.remat_policy),
             )
         for i in range(self.n_layers):
             x = layer(cfg, name=f"layer_{i}")(x, positions, self.mesh,

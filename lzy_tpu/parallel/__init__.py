@@ -11,6 +11,7 @@ from lzy_tpu.parallel.sharding import (
 from lzy_tpu.parallel.train import (
     PEAK_TFLOPS,
     TrainState,
+    make_eval_step,
     make_train_step,
     mfu,
     transformer_flops_per_token,
@@ -33,6 +34,7 @@ __all__ = [
     "tree_shardings",
     "PEAK_TFLOPS",
     "TrainState",
+    "make_eval_step",
     "make_train_step",
     "mfu",
     "transformer_flops_per_token",

@@ -165,6 +165,39 @@ def make_train_step(
     return _Stepper(), shard_state, batch_sharding
 
 
+def make_eval_step(
+    metric_fn: Callable[..., Any],
+    *,
+    mesh: Mesh,
+    rules: Optional[Rules] = None,
+    batch_logical_axes: Tuple[Optional[str], ...] = ("batch", "seq"),
+):
+    """Jitted evaluation counterpart of :func:`make_train_step`.
+
+    ``metric_fn(params, batch) -> scalar-or-dict`` (typically the same
+    ``make_loss_fn`` output, or a dict of metrics). Returns
+    ``eval_step(params, batch)`` jitted with the same batch sharding the
+    train step uses and replicated outputs — no optimizer state, no
+    donation (eval must never consume the live training params), so it
+    can run interleaved with training on the same sharded params.
+    """
+    batch_sharding = named_sharding(mesh, *batch_logical_axes, rules=rules)
+    replicated = NamedSharding(mesh, P())
+
+    def eval_step(params, batch):
+        out = metric_fn(params, batch)
+        if not isinstance(out, dict):
+            out = {"loss": out}
+        return out
+
+    jitted = jax.jit(
+        eval_step,
+        in_shardings=(None, batch_sharding),   # params keep their shardings
+        out_shardings=replicated,
+    )
+    return jitted
+
+
 # -- MFU accounting ------------------------------------------------------------
 
 # dense peak TFLOP/s per chip, bf16 (public figures)

@@ -144,6 +144,9 @@ class InProcessCluster:
             # before these components existed, so fence again now that
             # they do, and refuse to hand out a split-brain plane
             self._fence()
+            if getattr(self, "_gc_thread", None) is not None:
+                self._gc_thread.join(timeout=5.0)
+            self.store.close()
             raise LeaderLeaseHeld(
                 "control-plane lease lost during construction — another "
                 "plane took over; this instance is fenced")

@@ -22,10 +22,12 @@ def _blocks():
 
 
 def _runnable(block: str) -> bool:
-    # placeholder hosts or an explicit illustration marker mean "not
-    # meant to execute standalone"; a bare `...` is valid python
-    # (Ellipsis function bodies in the docs) so it does NOT exclude
-    return "<" not in block and "# illustration" not in block
+    # `<placeholder>` tokens or an explicit illustration marker mean
+    # "not meant to execute standalone"; a bare `...` is valid python
+    # (Ellipsis function bodies in the docs) and ordinary `<`
+    # comparisons must NOT exclude a block
+    return (re.search(r"<[a-z][a-z0-9_-]*>", block) is None
+            and "# illustration" not in block)
 
 
 def test_quickstart_blocks_execute_in_order(tmp_path):

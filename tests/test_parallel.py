@@ -365,3 +365,57 @@ class TestSegmentedSequenceParallel:
             state, metrics = step(state, batch)
             losses.append(float(metrics["loss"]))
         assert losses[-1] < losses[0]
+
+
+class TestEvalStep:
+    def test_eval_matches_loss_and_never_mutates_params(self):
+        import dataclasses
+
+        import optax
+
+        from lzy_tpu.models import llama
+        from lzy_tpu.models.llama import LlamaConfig
+        from lzy_tpu.parallel import (
+            TrainState, make_eval_step, make_train_step, mesh_for)
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(vocab_size=128),
+                                  dtype=jnp.float32)
+        mesh = mesh_for(8, fsdp=4, tp=2)
+        params, axes = llama.init_params(cfg, jax.random.PRNGKey(0))
+        loss_fn = llama.make_loss_fn(cfg, mesh)
+        tx = optax.adamw(1e-2)
+        step, shard_state, _ = make_train_step(
+            loss_fn, tx, mesh=mesh, param_logical_axes=axes,
+            batch_logical_axes=("batch", "seq"), donate=False)
+        state = shard_state(TrainState.create(params, tx))
+        batch = {"tokens": jax.random.randint(
+            jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size)}
+
+        eval_step = make_eval_step(loss_fn, mesh=mesh)
+        before = float(eval_step(state.params, batch)["loss"])
+        # eval over the SHARDED params equals the direct loss
+        direct = float(loss_fn(jax.device_get(state.params), batch))
+        np.testing.assert_allclose(before, direct, rtol=1e-5)
+
+        # interleave: train one step, eval again — params still usable
+        # (no donation) and the eval loss tracks training
+        state, _ = step(state, batch)
+        after = float(eval_step(state.params, batch)["loss"])
+        assert after < before
+
+    def test_eval_step_dict_metrics(self):
+        from lzy_tpu.parallel import make_eval_step, mesh_for
+
+        mesh = mesh_for(8, fsdp=-1)
+
+        def metrics(params, batch):
+            x = batch["x"]
+            return {"mean": (x * params["w"]).mean(),
+                    "max": (x * params["w"]).max()}
+
+        eval_step = make_eval_step(metrics, mesh=mesh,
+                                   batch_logical_axes=("batch",))
+        out = eval_step({"w": jnp.float32(2.0)},
+                        {"x": jnp.arange(8.0)})
+        np.testing.assert_allclose(float(out["mean"]), 7.0)
+        np.testing.assert_allclose(float(out["max"]), 14.0)
