@@ -26,7 +26,7 @@ def _runnable(block: str) -> bool:
     # "not meant to execute standalone"; a bare `...` is valid python
     # (Ellipsis function bodies in the docs) and ordinary `<`
     # comparisons must NOT exclude a block
-    return (re.search(r"<[a-z][a-z0-9_-]*>", block) is None
+    return (re.search(r"<[a-z][a-z0-9_-]*>", block, re.I) is None
             and "# illustration" not in block)
 
 
