@@ -49,3 +49,7 @@ __all__.append("CheckpointManager")
 from lzy_tpu.parallel.ulysses import ulysses_attention  # noqa: E402
 
 __all__.append("ulysses_attention")
+
+from lzy_tpu.parallel.orbax_interop import export_orbax, import_orbax  # noqa: E402
+
+__all__ += ["export_orbax", "import_orbax"]

@@ -1,0 +1,80 @@
+"""Orbax checkpoint interop: migrate state to/from the wider JAX stack.
+
+The framework's own checkpoints (``parallel/checkpoint.py``) are
+storage-native (they ride the same StorageClient as the data plane, with
+sharded multi-host save/restore and retention). Users arriving from — or
+publishing to — maxtext/t5x-style stacks speak Orbax instead; these two
+functions are the bridge, so a model trained here restores there and
+vice versa without a bespoke converter script.
+
+Orbax wants a local directory (its own atomicity protocol); remote
+storage round-trips go through the framework checkpoint format, which
+already streams to any StorageClient.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def _require_single_process(what: str) -> None:
+    """Orbax distributed saves need an all-process-visible path and
+    cross-host coordination this bridge does not set up; in a multi-host
+    run, migrate through the framework's own sharded checkpoints
+    (CheckpointManager.save_sharded) and convert on one host."""
+    if jax.process_count() > 1:
+        raise RuntimeError(
+            f"{what} is a single-process bridge; in a multi-host run use "
+            f"CheckpointManager.save_sharded and convert on one host")
+
+
+def export_orbax(state: Any, path: str, *, force: bool = False) -> str:
+    """Write ``state`` (any pytree of arrays — a TrainState, bare params)
+    as an Orbax PyTree checkpoint at ``path`` (a local directory).
+    Returns the path. Sharded ``jax.Array`` leaves are fully gathered by
+    orbax's type handlers (single-process: every shard is addressable)."""
+    import orbax.checkpoint as ocp
+
+    _require_single_process("export_orbax")
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, state, force=force)
+    return path
+
+
+def import_orbax(path: str, *, template: Optional[Any] = None,
+                 shardings: Optional[Any] = None) -> Any:
+    """Read an Orbax PyTree checkpoint from ``path``.
+
+    - ``template``: optional pytree of like-structured arrays (shape/dtype
+      targets) — pass the freshly initialized state to get leaves restored
+      as jax Arrays matching it.
+    - ``shardings``: optional pytree of ``jax.sharding.Sharding`` to place
+      restored leaves directly onto a mesh (pair with ``template``).
+    """
+    import orbax.checkpoint as ocp
+
+    _require_single_process("import_orbax")
+    if shardings is not None and template is None:
+        raise ValueError(
+            "import_orbax(shardings=...) needs template= too (the "
+            "shape/dtype targets); without it the shardings would be "
+            "silently ignored and arrays restored host-placed")
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    if template is None:
+        return ckptr.restore(path)
+    if shardings is None:
+        abstract = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), template)
+    else:
+        abstract = jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            template, shardings)
+    return ckptr.restore(
+        path, args=ocp.args.PyTreeRestore(
+            restore_args=ocp.checkpoint_utils.construct_restore_args(abstract)
+        ))
