@@ -1,0 +1,139 @@
+"""HuggingFace → lzy_tpu weight import for the Llama family.
+
+Users arriving from the reference ecosystem start from pretrained
+checkpoints; this maps a ``transformers`` Llama state dict onto this
+framework's param tree so ``Llama``/``pp_forward``/``generate`` run the
+canonical weights. It doubles as an architecture cross-check: the
+conversion test compares our forward against ``LlamaForCausalLM`` on the
+same weights (RoPE convention, GQA grouping, RMSNorm placement, SwiGLU
+order all have to agree for the logits to match).
+
+Only torch→numpy host conversion happens here (torch is the cpu wheel);
+the result is an ordinary param pytree for ``shard_tree``/``jax.device_put``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from lzy_tpu.models.llama import LlamaConfig
+
+
+def config_from_hf(hf_config) -> LlamaConfig:
+    """LlamaConfig mirroring a ``transformers.LlamaConfig``.
+
+    Raises on config features the conversion would silently get wrong:
+    rope scaling (Llama-3.1+ applies it to every position) and a
+    ``head_dim`` decoupled from ``hidden_size // num_attention_heads``.
+    """
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
+        raise ValueError(
+            f"rope_scaling={scaling!r} is not supported by this converter "
+            f"— transformers applies it to inv_freq at every position, so "
+            f"ignoring it would produce silently wrong logits")
+    derived = hf_config.hidden_size // hf_config.num_attention_heads
+    explicit = getattr(hf_config, "head_dim", None)
+    if explicit is not None and explicit != derived:
+        raise ValueError(
+            f"head_dim={explicit} decoupled from hidden_size//n_heads="
+            f"{derived} cannot be represented by LlamaConfig here")
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_key_value_heads,
+        d_ff=hf_config.intermediate_size,
+        rope_theta=float(hf_config.rope_theta),
+        norm_eps=float(hf_config.rms_norm_eps),
+        max_seq_len=hf_config.max_position_embeddings,
+        tie_embeddings=bool(hf_config.tie_word_embeddings),
+        remat=False,
+    )
+
+
+def _t(w) -> np.ndarray:
+    """torch tensor → float32 numpy (host)."""
+    return np.asarray(w.detach().cpu().float().numpy())
+
+
+def params_from_hf(model_or_state_dict, cfg: LlamaConfig,
+                   dtype=jnp.float32) -> Dict[str, Any]:
+    """Convert a ``LlamaForCausalLM`` (or its state dict) to this
+    framework's dense param tree.
+
+    Layout notes: torch ``Linear`` stores ``[out, in]`` and computes
+    ``x @ W.T``; our ``DenseGeneral`` kernels are ``[in, out]`` (q/k/v
+    reshape the out dim to ``[heads, head_dim]``), so every projection
+    transposes. HF's RoPE uses the rotate-half (non-interleaved)
+    convention — the same as ``llama._rope`` — so no permutation of the
+    head dim is needed.
+    """
+    sd = getattr(model_or_state_dict, "state_dict", lambda: model_or_state_dict)()
+    h, kv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    consumed = set()
+
+    def take(name: str):
+        consumed.add(name)
+        return _t(sd[name])
+
+    def proj(name: str, heads: int):
+        w = take(name)                         # [heads*d, D]
+        return w.T.reshape(cfg.d_model, heads, d).astype(dtype)
+
+    params: Dict[str, Any] = {
+        "embed_tokens": take("model.embed_tokens.weight").astype(dtype),
+        "final_norm": {
+            "scale": take("model.norm.weight").astype(dtype)},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = take("lm_head.weight").astype(dtype)
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        params[f"layer_{i}"] = {
+            "attn_norm": {
+                "scale": take(p + "input_layernorm.weight").astype(dtype)},
+            "mlp_norm": {
+                "scale": take(
+                    p + "post_attention_layernorm.weight").astype(dtype)},
+            "attn": {
+                "q_proj": {"kernel": proj(p + "self_attn.q_proj.weight", h)},
+                "k_proj": {"kernel": proj(p + "self_attn.k_proj.weight", kv)},
+                "v_proj": {"kernel": proj(p + "self_attn.v_proj.weight", kv)},
+                "o_proj": {"kernel": take(
+                    p + "self_attn.o_proj.weight").T.astype(dtype)},
+            },
+            "mlp": {
+                "gate_proj": {"kernel": take(
+                    p + "mlp.gate_proj.weight").T.astype(dtype)},
+                "up_proj": {"kernel": take(
+                    p + "mlp.up_proj.weight").T.astype(dtype)},
+                "down_proj": {"kernel": take(
+                    p + "mlp.down_proj.weight").T.astype(dtype)},
+            },
+        }
+    leftover = {k for k in sd if k not in consumed
+                and not (cfg.tie_embeddings and k == "lm_head.weight")
+                # persistent rotary buffers are derived, not weights
+                and "rotary_emb" not in k}
+    if leftover:
+        raise ValueError(
+            f"unconverted state-dict entries (bias terms / layout drift "
+            f"would be silently dropped): {sorted(leftover)[:6]}"
+            + ("..." if len(leftover) > 6 else ""))
+    return params
+
+
+def load_hf(model_or_path, dtype=jnp.float32):
+    """One call from a ``transformers`` model (or pretrained path) to
+    ``(cfg, params)`` ready for ``Llama(cfg).apply({"params": params}, …)``."""
+    if isinstance(model_or_path, str):
+        from transformers import LlamaForCausalLM
+
+        model_or_path = LlamaForCausalLM.from_pretrained(model_or_path)
+    cfg = config_from_hf(model_or_path.config)
+    return cfg, params_from_hf(model_or_path, cfg, dtype=dtype)
