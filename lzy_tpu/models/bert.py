@@ -88,18 +88,13 @@ class EncoderLayer(nn.Module):
             p = jax.nn.softmax(s, axis=-1).astype(cfg.dtype)
             attn = jnp.einsum("bhqk,bhkd->bhqd", p, v)
         attn = jnp.transpose(attn, (0, 2, 1, 3)).reshape(b, t, h * d)
-        attn = nn.DenseGeneral(
-            features=cfg.d_model, name="o_proj",
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ("heads_merged", "embed")
-            ),
-        )(attn)
+        attn = _dense(cfg, cfg.d_model, "o_proj",
+                      ("heads_merged", "embed"))(attn)
         x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="attn_norm")(x + attn)
 
         ff = _dense(cfg, cfg.d_ff, "ff_in", ("embed", "mlp"))(x)
-        ff = nn.gelu(ff)
+        ff = nn.gelu(ff, approximate=False)
         ff = _dense(cfg, cfg.d_model, "ff_out", ("mlp", "embed"))(ff)
         return nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype,
                             param_dtype=cfg.param_dtype, name="ff_norm")(x + ff)
@@ -141,11 +136,17 @@ class BertMlm(nn.Module):
 
         # MLM head with tied embeddings
         x = _dense(cfg, cfg.d_model, "mlm_transform", ("embed", "embed_out"))(x)
-        x = nn.gelu(x)
+        x = nn.gelu(x, approximate=False)
         x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="mlm_norm")(x)
+        mlm_bias = self.param(
+            "mlm_bias",
+            nn.with_logical_partitioning(nn.initializers.zeros, ("vocab",)),
+            (cfg.vocab_size,), cfg.param_dtype,
+        )
         return jnp.einsum("bte,ve->btv", x.astype(jnp.float32),
-                          emb.astype(jnp.float32))
+                          emb.astype(jnp.float32)) + mlm_bias.astype(
+                              jnp.float32)
 
 
 def init_params(cfg: BertConfig, rng: jax.Array, seq_len: int = 8):

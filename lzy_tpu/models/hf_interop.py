@@ -35,6 +35,11 @@ def config_from_hf(hf_config) -> LlamaConfig:
             f"rope_scaling={scaling!r} is not supported by this converter "
             f"— transformers applies it to inv_freq at every position, so "
             f"ignoring it would produce silently wrong logits")
+    act = getattr(hf_config, "hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        raise ValueError(
+            f"hidden_act={act!r} unsupported (the Llama here hardcodes "
+            f"SwiGLU/silu); converting would produce silently wrong logits")
     derived = hf_config.hidden_size // hf_config.num_attention_heads
     explicit = getattr(hf_config, "head_dim", None)
     if explicit is not None and explicit != derived:
@@ -137,3 +142,100 @@ def load_hf(model_or_path, dtype=jnp.float32):
         model_or_path = LlamaForCausalLM.from_pretrained(model_or_path)
     cfg = config_from_hf(model_or_path.config)
     return cfg, params_from_hf(model_or_path, cfg, dtype=dtype)
+
+
+# -- BERT (BASELINE config 3: multi-host BERT-base pretrain) -----------------
+
+
+def bert_config_from_hf(hf_config):
+    """BertConfig mirroring a ``transformers.BertConfig``."""
+    from lzy_tpu.models.bert import BertConfig
+
+    if getattr(hf_config, "hidden_act", "gelu") != "gelu":
+        raise ValueError(
+            f"hidden_act={hf_config.hidden_act!r} unsupported (exact gelu "
+            f"only — the BertMlm here hardcodes it)")
+    return BertConfig(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        norm_eps=float(hf_config.layer_norm_eps),
+        remat=False,
+    )
+
+
+def bert_params_from_hf(model_or_state_dict, cfg,
+                        dtype=jnp.float32) -> Dict[str, Any]:
+    """Convert a ``BertForMaskedLM`` to this framework's BertMlm tree.
+
+    HF's constant token-type-0 embedding row is folded into the position
+    embeddings (this framework drops token types; with single-segment
+    inputs the sum is identical). The tied MLM decoder bias maps to
+    ``mlm_bias``.
+    """
+    sd = getattr(model_or_state_dict, "state_dict",
+                 lambda: model_or_state_dict)()
+    h, d = cfg.n_heads, cfg.head_dim
+    consumed = set()
+
+    def take(name: str):
+        consumed.add(name)
+        return _t(sd[name])
+
+    def ln(prefix: str):
+        return {"scale": take(prefix + ".weight").astype(dtype),
+                "bias": take(prefix + ".bias").astype(dtype)}
+
+    def qkv(name: str):
+        return {"kernel": take(name + ".weight").T
+                .reshape(cfg.d_model, h, d).astype(dtype),
+                "bias": take(name + ".bias").reshape(h, d).astype(dtype)}
+
+    def linear(name: str):
+        return {"kernel": take(name + ".weight").T.astype(dtype),
+                "bias": take(name + ".bias").astype(dtype)}
+
+    if "cls.predictions.decoder.weight" in sd:
+        dec = _t(sd["cls.predictions.decoder.weight"])
+        emb = _t(sd["bert.embeddings.word_embeddings.weight"])
+        if dec.shape != emb.shape or not np.array_equal(dec, emb):
+            raise ValueError(
+                "untied MLM decoder (cls.predictions.decoder.weight differs "
+                "from the word embeddings); BertMlm ties them — converting "
+                "would produce silently wrong logits")
+    token_type0 = take("bert.embeddings.token_type_embeddings.weight")[0]
+    params: Dict[str, Any] = {
+        "tok_embed": take(
+            "bert.embeddings.word_embeddings.weight").astype(dtype),
+        "pos_embed": (take("bert.embeddings.position_embeddings.weight")
+                      + token_type0[None, :]).astype(dtype),
+        "embed_norm": ln("bert.embeddings.LayerNorm"),
+        "mlm_transform": linear("cls.predictions.transform.dense"),
+        "mlm_norm": ln("cls.predictions.transform.LayerNorm"),
+        "mlm_bias": take("cls.predictions.bias").astype(dtype),
+    }
+    for i in range(cfg.n_layers):
+        p = f"bert.encoder.layer.{i}."
+        params[f"layer_{i}"] = {
+            "q_proj": qkv(p + "attention.self.query"),
+            "k_proj": qkv(p + "attention.self.key"),
+            "v_proj": qkv(p + "attention.self.value"),
+            "o_proj": linear(p + "attention.output.dense"),
+            "attn_norm": ln(p + "attention.output.LayerNorm"),
+            "ff_in": linear(p + "intermediate.dense"),
+            "ff_out": linear(p + "output.dense"),
+            "ff_norm": ln(p + "output.LayerNorm"),
+        }
+    leftover = {k for k in sd if k not in consumed
+                # tied decoder weight + its alias; derived position ids
+                and k not in ("cls.predictions.decoder.weight",
+                              "cls.predictions.decoder.bias")
+                and "position_ids" not in k}
+    if leftover:
+        raise ValueError(
+            f"unconverted state-dict entries: {sorted(leftover)[:6]}"
+            + ("..." if len(leftover) > 6 else ""))
+    return params

@@ -129,3 +129,58 @@ class TestConversionGuards:
         hf = LlamaForCausalLM(cfg)
         with pytest.raises(ValueError, match="unconverted"):
             params_from_hf(hf, config_from_hf(cfg))
+
+
+class TestBertHfParity:
+    """BASELINE config 3's architecture verified against the canonical
+    BertForMaskedLM (token-type-0 folded into positions; tied decoder
+    bias mapped to mlm_bias)."""
+
+    def _tiny(self):
+        from transformers import BertConfig as HFBertConfig, BertForMaskedLM
+
+        cfg = HFBertConfig(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=64, type_vocab_size=2,
+            layer_norm_eps=1e-12, attn_implementation="eager")
+        torch.manual_seed(0)
+        return BertForMaskedLM(cfg).eval()
+
+    def test_logits_match_canonical_implementation(self):
+        from lzy_tpu.models.bert import BertMlm
+        from lzy_tpu.models.hf_interop import (
+            bert_config_from_hf, bert_params_from_hf)
+
+        hf = self._tiny()
+        cfg = dataclasses.replace(bert_config_from_hf(hf.config),
+                                  dtype=jnp.float32)
+        params = bert_params_from_hf(hf, cfg)
+        tokens = np.random.RandomState(1).randint(0, 256, (2, 16))
+        ours = np.asarray(BertMlm(cfg).apply(
+            {"params": params}, jnp.asarray(tokens)))
+        with torch.no_grad():
+            theirs = hf(torch.tensor(tokens)).logits.numpy()
+        np.testing.assert_allclose(ours, theirs, atol=5e-4, rtol=5e-4)
+
+    def test_padding_mask_semantics_match(self):
+        from lzy_tpu.models.bert import BertMlm
+        from lzy_tpu.models.hf_interop import (
+            bert_config_from_hf, bert_params_from_hf)
+
+        hf = self._tiny()
+        cfg = dataclasses.replace(bert_config_from_hf(hf.config),
+                                  dtype=jnp.float32)
+        params = bert_params_from_hf(hf, cfg)
+        tokens = np.random.RandomState(2).randint(0, 256, (1, 12))
+        mask = np.ones((1, 12), np.int64)
+        mask[:, 9:] = 0                      # padded tail
+        ours = np.asarray(BertMlm(cfg).apply(
+            {"params": params}, jnp.asarray(tokens),
+            jnp.asarray(mask.astype(bool))))
+        with torch.no_grad():
+            theirs = hf(torch.tensor(tokens),
+                        attention_mask=torch.tensor(mask)).logits.numpy()
+        # compare the REAL positions (HF still computes padded ones)
+        np.testing.assert_allclose(ours[:, :9], theirs[:, :9],
+                                   atol=5e-4, rtol=5e-4)
