@@ -1,0 +1,61 @@
+"""Text → token files: the bridge from raw corpora to the native loader.
+
+``write_token_file`` wants one flat token array; real corpora are text.
+This streams documents through any HuggingFace-style tokenizer (anything
+with ``encode``/``eos_token_id``) and appends an EOS after every
+document — exactly the boundary marker ``TokenFile.lm_source(eos_id=...)``
+turns into packed-document segment ids downstream.
+
+One in-memory pass: the corpus must fit in RAM as int64 (8 bytes/token);
+shard pretraining-scale corpora across multiple calls/files and list
+them all in the data pipeline.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Iterable, Optional, Union
+
+import numpy as np
+
+from lzy_tpu.data.token_file import write_token_file
+
+
+def tokenize_corpus(
+    texts: Iterable[str],
+    tokenizer,
+    path: Union[str, pathlib.Path],
+    *,
+    eos_id: Optional[int] = None,
+) -> int:
+    """Tokenize ``texts`` (an iterable of documents — a generator is fine)
+    into one token file at ``path``. Returns the total token count.
+
+    - ``tokenizer``: any object with ``encode(text) -> list[int]``
+      (``transformers`` tokenizers qualify).
+    - ``eos_id``: appended after EVERY document (defaults to the
+      tokenizer's ``eos_token_id``); feed the same id to
+      ``TokenFile.lm_source(eos_id=...)`` to train on packed documents.
+
+    The on-disk width (uint16/int32) is chosen by ``write_token_file``
+    from the actual ids.
+    """
+    if eos_id is None:
+        eos_id = getattr(tokenizer, "eos_token_id", None)
+        if eos_id is None:
+            raise ValueError(
+                "tokenizer has no eos_token_id; pass eos_id= explicitly "
+                "(document boundaries are what packing needs)")
+    chunks = []
+    total = 0
+    for text in texts:
+        ids = tokenizer.encode(text)
+        if getattr(ids, "ids", None) is not None:    # tokenizers.Encoding
+            ids = ids.ids
+        arr = np.asarray(list(ids) + [eos_id], dtype=np.int64)
+        chunks.append(arr)
+        total += arr.size
+    if not chunks:
+        raise ValueError("no documents in the corpus iterable")
+    write_token_file(path, np.concatenate(chunks))
+    return total

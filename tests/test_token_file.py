@@ -127,3 +127,63 @@ def test_pipeline_integration_device_batches(token_path):
             if n == 5:
                 break
         assert pipe.data_state() is not None
+
+
+class TestTokenizeCorpus:
+    """Raw text → token file through any HF-style tokenizer; EOS after
+    every document so the loader's packing picks up the boundaries."""
+
+    class FakeTokenizer:
+        eos_token_id = 0
+
+        def encode(self, text):
+            return [ord(c) % 250 + 1 for c in text]
+
+    def test_corpus_round_trips_with_document_boundaries(self, tmp_path):
+        from lzy_tpu.data import TokenFile
+        from lzy_tpu.data.tokenize import tokenize_corpus
+
+        docs = ["hello world", "a second document", "x"]
+        path = tmp_path / "corpus.bin"
+        n = tokenize_corpus(iter(docs), self.FakeTokenizer(), path)
+        assert n == sum(len(d) for d in docs) + len(docs)   # + one EOS each
+        with TokenFile(str(path)) as tf:
+            tokens = tf.gather(np.array([0]), n)[0]
+        # EOS lands exactly at each document boundary
+        eos_positions = np.where(tokens == 0)[0].tolist()
+        expect = np.cumsum([len(d) + 1 for d in docs]) - 1
+        assert eos_positions == expect.tolist()
+
+    def test_real_transformers_tokenizer(self, tmp_path):
+        transformers = pytest.importorskip("transformers")
+        from lzy_tpu.data import TokenFile
+        from lzy_tpu.data.tokenize import tokenize_corpus
+
+        # offline: build a tiny WordLevel-style tokenizer from scratch
+        tok = transformers.PreTrainedTokenizerFast(
+            tokenizer_object=self._tiny_tokenizer(), eos_token="</s>")
+        path = tmp_path / "c.bin"
+        n = tokenize_corpus(["the cat sat", "the dog"], tok, path)
+        assert n > 0
+        with TokenFile(str(path)) as tf:
+            assert tf.gather(np.array([0]), n).shape == (1, n)
+
+    @staticmethod
+    def _tiny_tokenizer():
+        from tokenizers import Tokenizer, models, pre_tokenizers
+
+        vocab = {"</s>": 0, "the": 1, "cat": 2, "sat": 3, "dog": 4,
+                 "[UNK]": 5}
+        t = Tokenizer(models.WordLevel(vocab, unk_token="[UNK]"))
+        t.pre_tokenizer = pre_tokenizers.Whitespace()
+        return t
+
+    def test_missing_eos_is_a_clear_error(self, tmp_path):
+        from lzy_tpu.data.tokenize import tokenize_corpus
+
+        class NoEos:
+            def encode(self, text):
+                return [1, 2]
+
+        with pytest.raises(ValueError, match="eos"):
+            tokenize_corpus(["x"], NoEos(), tmp_path / "c.bin")
