@@ -1,6 +1,7 @@
 from lzy_tpu.data.pipeline import DataPipeline, synthetic_lm_batches
 from lzy_tpu.data.resumable import ResumableSource, array_source
 from lzy_tpu.data.token_file import TokenFile, write_token_file
+from lzy_tpu.data.tokenize import tokenize_corpus
 
 __all__ = ["DataPipeline", "ResumableSource", "TokenFile", "array_source",
-           "synthetic_lm_batches", "write_token_file"]
+           "synthetic_lm_batches", "tokenize_corpus", "write_token_file"]

@@ -6,9 +6,9 @@ with ``encode``/``eos_token_id``) and appends an EOS after every
 document — exactly the boundary marker ``TokenFile.lm_source(eos_id=...)``
 turns into packed-document segment ids downstream.
 
-One in-memory pass: the corpus must fit in RAM as int64 (8 bytes/token);
-shard pretraining-scale corpora across multiple calls/files and list
-them all in the data pipeline.
+One in-memory pass: peak RAM is ~16 bytes/token (the int64 chunks plus
+the concatenated copy); shard pretraining-scale corpora across multiple
+calls/files and list them all in the data pipeline.
 """
 
 from __future__ import annotations
@@ -57,5 +57,7 @@ def tokenize_corpus(
         total += arr.size
     if not chunks:
         raise ValueError("no documents in the corpus iterable")
-    write_token_file(path, np.concatenate(chunks))
+    flat = np.concatenate(chunks)
+    chunks.clear()                      # drop the per-document copies early
+    write_token_file(path, flat)
     return total

@@ -328,9 +328,34 @@ def test_worker_plane_requires_worker_token(tmp_path):
             storage_registry=storage,
         )
         # the full data path works: the worker authenticated every channel
-        # bind / publish / complete and its register/heartbeats with its token
+        # bind / publish / complete and its register/heartbeats with its token.
+        # The VM-specific probes run INSIDE the workflow: finish_workflow's
+        # teardown destroys the session's VMs through an ASYNC durable op,
+        # so touching vm records after the block races it (observed as a
+        # load-dependent flake in full-suite runs)
         with lzy.workflow("iam-proc-wf"):
             assert int(proc_square(6)) == 36
+
+            # one VM's token cannot heartbeat for another VM
+            (vm,) = [v for v in c.allocator.vms()]
+            with pytest.raises(AuthError):
+                raw.call("Heartbeat", {"vm_id": "some-other-vm",
+                                       "token": vm.worker_token})
+            # OTT bootstrap: the launch env carried a one-time credential
+            # which registration burned — a replayed OTT cannot re-register
+            ott = c.allocator.mint_bootstrap_token(vm.id)
+            assert c.allocator.redeem_bootstrap_token(vm.id, ott) \
+                == vm.worker_token
+            with pytest.raises(AuthError):
+                raw.call("RegisterVm", {"vm_id": vm.id,
+                                        "endpoint": "127.0.0.1:1",
+                                        "token": ott})
+            # an OTT minted for one VM cannot bootstrap another — and the
+            # probe must not burn it
+            other = c.allocator.mint_bootstrap_token("vm-other")
+            with pytest.raises(AuthError, match="not vm"):
+                c.allocator.redeem_bootstrap_token(vm.id, other)
+            assert c.iam.redeem_ott(other) == "vm/vm-other"  # redeemable
 
         # anonymous peer cannot touch the channel plane
         with pytest.raises(AuthError):
@@ -340,26 +365,6 @@ def test_worker_plane_requires_worker_token(tmp_path):
             raw.call("RegisterVm", {"vm_id": "vm-x",
                                     "endpoint": "127.0.0.1:1",
                                     "token": user_token})
-        # one VM's token cannot heartbeat for another VM
-        (vm,) = [v for v in c.allocator.vms()]
-        with pytest.raises(AuthError):
-            raw.call("Heartbeat", {"vm_id": "some-other-vm",
-                                   "token": vm.worker_token})
-        # OTT bootstrap: the launch env carried a one-time credential which
-        # registration burned — a replayed OTT cannot re-register the VM
-        ott = c.allocator.mint_bootstrap_token(vm.id)
-        assert c.allocator.redeem_bootstrap_token(vm.id, ott) \
-            == vm.worker_token
-        with pytest.raises(AuthError):
-            raw.call("RegisterVm", {"vm_id": vm.id,
-                                    "endpoint": "127.0.0.1:1",
-                                    "token": ott})
-        # an OTT minted for one VM cannot bootstrap another — and the
-        # probe must not burn it
-        other = c.allocator.mint_bootstrap_token("vm-other")
-        with pytest.raises(AuthError, match="not vm"):
-            c.allocator.redeem_bootstrap_token(vm.id, other)
-        assert c.iam.redeem_ott(other) == "vm/vm-other"   # still redeemable
     finally:
         raw.close()
         client.close()
