@@ -114,3 +114,145 @@ def generate(
         tokens.append(cur[:, None])
         cache, cur, rng = step(cache, params, cur[:, None], rng)
     return jnp.concatenate(tokens, axis=1)
+
+
+def pp_generate(
+    cfg: LlamaConfig,
+    params: Any,
+    prompt: jax.Array,
+    *,
+    max_new_tokens: int,
+    mesh,
+    axis: str = "pp",
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    rng: Optional[jax.Array] = None,
+    eos_token: Optional[int] = None,
+) -> jax.Array:
+    """Decode DIRECTLY from pipeline-staged params — no ``unstack_pp_params``
+    dense-tree materialization: each pp rank holds only its stage's weights
+    and KV cache, and the token's hidden state rides a ``ppermute`` ring of
+    stage applications (sequential per token — the memory shape of pipelined
+    decode, not token-level pipelining). Matches the dense ``generate``
+    token-for-token (same rng discipline), incl. sampling and ``eos_token``.
+    """
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from lzy_tpu.models.llama import (
+        LlamaStage, RMSNorm, _check_pp_config)
+
+    # decode=True is this function's own business — normalize before the
+    # training-entry validator so callers who set it aren't bounced with
+    # advice to call the function they are already calling
+    cfg = dataclasses.replace(cfg, decode=False)
+    k = _check_pp_config(cfg)
+    n = mesh.shape[axis]
+    if n != cfg.pp_stages:
+        raise ValueError(f"mesh {axis}={n} != pp_stages={cfg.pp_stages}")
+    b, t0 = prompt.shape
+    if t0 + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({t0}) + new tokens ({max_new_tokens}) exceeds "
+            f"max_seq_len ({cfg.max_seq_len})")
+    dcfg = dataclasses.replace(
+        cfg, decode=True, remat=False, pp_stages=0, use_flash_kernel=False,
+        use_ring_attention=False, use_ulysses_attention=False,
+    )
+    stage = LlamaStage(dcfg, k)
+    cache_shapes = jax.eval_shape(
+        lambda: stage.init(jax.random.PRNGKey(0),
+                           jnp.zeros((b, 1, cfg.d_model), dcfg.dtype),
+                           jnp.zeros((b, 1), jnp.int32))["cache"])
+    embed = params["embed_tokens"]
+    head = embed if cfg.tie_embeddings else params["lm_head"]
+    norm_params = params["final_norm"]
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def local(stages_local, prompt_tokens, rng):
+        sp = jax.tree_util.tree_map(lambda a: a[0], stages_local)
+        rank = lax.axis_index(axis)
+        zv = rank * 0
+        cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype) + zv.astype(s.dtype),
+            cache_shapes)
+        pos0 = jnp.zeros((b, 1), jnp.int32)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def ring_token(cache, tok):
+            """One token through all stages; returns last-position logits."""
+            h = embed.astype(dcfg.dtype)[tok] + zv.astype(dcfg.dtype)
+
+            def tick(carry, j):
+                h, cache = carry
+
+                def run(h, cache):
+                    y, upd = stage.apply({"params": sp, "cache": cache}, h,
+                                         pos0, mutable=["cache"])
+                    return y, upd["cache"]
+
+                # per-device predicate inside the manual region: only the
+                # active stage pays the weight + KV-cache sweep (decode is
+                # HBM-bound; apply-everywhere-and-select would multiply
+                # that traffic by the stage count)
+                h, cache = lax.cond(rank == j, run,
+                                    lambda h, cache: (h, cache), h, cache)
+                return (lax.ppermute(h, axis, perm), cache), None
+
+            (h, cache), _ = lax.scan(tick, (h, cache), jnp.arange(n))
+            # after n hops the final stage's output has rotated onto rank 0;
+            # a psum of the masked value replicates it (and is f32 — the
+            # XLA:CPU AllReducePromotion constraint, see parallel/pipeline)
+            final = lax.psum(
+                jnp.where(rank == 0, h.astype(jnp.float32), 0.0), axis)
+            # EXACTLY the dense model's tail dtypes (norm and head in
+            # cfg.dtype, f32 accumulation) — bit-identical logits are what
+            # make the sampled path match the dense generate token-for-token
+            x = RMSNorm(cfg.norm_eps, cfg.param_dtype).apply(
+                {"params": norm_params}, final.astype(dcfg.dtype))
+            logits = jnp.einsum(
+                "bte,ve->btv", x.astype(dcfg.dtype),
+                head.astype(dcfg.dtype),
+                preferred_element_type=jnp.float32)
+            return cache, logits[:, -1]
+
+        # prefill mirrors the dense generate exactly (it samples-and-
+        # discards per prompt token, keeping the rng stream in lockstep so
+        # sampled outputs are bit-identical between the two paths)
+        def prefill_step(carry, t):
+            cache, rng = carry
+            cache, logits = ring_token(
+                cache, lax.dynamic_slice_in_dim(prompt_tokens, t, 1, axis=1))
+            nxt, rng = sample_token(logits, temperature, rng,
+                                    top_k=top_k, top_p=top_p)
+            return (cache, rng), nxt
+
+        (cache, rng), sampled = lax.scan(
+            prefill_step, (cache, rng), jnp.arange(t0))
+        cur = sampled[-1]
+
+        def decode_step(carry, _):
+            cache, cur, rng, done = carry
+            if eos_token is not None:
+                cur = jnp.where(done, eos_token, cur)
+                done = done | (cur == eos_token)
+            emitted = cur
+            cache, logits = ring_token(cache, cur[:, None])
+            nxt, rng = sample_token(logits, temperature, rng,
+                                    top_k=top_k, top_p=top_p)
+            return (cache, nxt, rng, done), emitted
+
+        done0 = jnp.zeros((b,), bool)
+        (_, _, _, _), toks = lax.scan(
+            decode_step, (cache, cur, rng, done0), None,
+            length=max_new_tokens)
+        return jnp.transpose(toks, (1, 0))       # [B, max_new_tokens]
+
+    stacked_specs = jax.tree_util.tree_map(
+        lambda _: P(axis), params["stages"])
+    new_tokens = shard_map(
+        local, mesh=mesh, in_specs=(stacked_specs, P(), P()),
+        out_specs=P(), axis_names={axis},
+    )(params["stages"], prompt, rng)
+    return jnp.concatenate([prompt, new_tokens.astype(prompt.dtype)], axis=1)

@@ -65,8 +65,9 @@ class LlamaConfig:
     fused_ce: bool = False
     # GPipe pipeline parallelism: >1 partitions the decoder stack into that
     # many stages streamed over the mesh's 'pp' axis (parallel/pipeline.py);
-    # composes with dp/fsdp/tp. Training-only (generate() takes the dense
-    # tree — see unstack_pp_params).
+    # composes with dp/fsdp/tp, ring/Ulysses sp, MoE, and packed segments.
+    # Decode from staged params with models.generate.pp_generate (or
+    # unstack_pp_params + the dense generate).
     pp_stages: int = 0
     pp_microbatches: int = 0  # 0 → pp_stages (the minimum that fills the pipe)
 
@@ -428,9 +429,10 @@ def _check_pp_config(cfg: LlamaConfig) -> int:
         )
     if cfg.decode:
         raise ValueError(
-            "pp_stages>1 does not compose with decode (pipeline is for "
-            "training; decode via unstack_pp_params + the dense tree). "
-            "Ring/Ulysses sequence parallelism and MoE DO compose with pp."
+            "pp_stages>1 training entries do not take decode configs; "
+            "decode from staged params with models.generate.pp_generate "
+            "(or unstack_pp_params + the dense generate). Ring/Ulysses "
+            "sequence parallelism and MoE DO compose with pp."
         )
     if cfg.n_experts > 0 and (cfg.use_ring_attention
                               or cfg.use_ulysses_attention):
