@@ -165,9 +165,12 @@ def pp_generate(
         lambda: stage.init(jax.random.PRNGKey(0),
                            jnp.zeros((b, 1, cfg.d_model), dcfg.dtype),
                            jnp.zeros((b, 1), jnp.int32))["cache"])
-    embed = params["embed_tokens"]
-    head = embed if cfg.tie_embeddings else params["lm_head"]
-    norm_params = params["final_norm"]
+    # jnp-coerce the closed-over leaves: callers legitimately pass
+    # device_get'd (numpy) trees, and numpy_array[tracer] indexing inside
+    # the scan would fail with a TracerArrayConversionError
+    embed = jnp.asarray(params["embed_tokens"])
+    head = embed if cfg.tie_embeddings else jnp.asarray(params["lm_head"])
+    norm_params = jax.tree_util.tree_map(jnp.asarray, params["final_norm"])
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     def local(stages_local, prompt_tokens, rng):

@@ -1,8 +1,9 @@
 """Pipelined training through the workflow layer (TPU-build addition):
 an @op builds a pp×fsdp mesh on the worker's devices, trains the pp-
-staged Llama a few steps, then unstacks the stage params to the dense
-tree and greedy-decodes one token — the full pp lifecycle (train →
-unstack → generate) riding the ordinary op/channel/snapshot path."""
+staged Llama a few steps, then greedy-decodes DIRECTLY from the staged
+params with pp_generate (each rank keeps only its stage's weights + KV
+cache) — the full pp lifecycle riding the ordinary op/channel/snapshot
+path. unstack_pp_params remains the dense-tree escape hatch."""
 import dataclasses
 
 from tests.scenarios._base import make_lzy
@@ -33,12 +34,13 @@ def train_pipelined(steps: int) -> dict:
         state, metrics = step(state, batch)
         last = float(metrics["loss"])
         first = first if first is not None else last
-    # pp-trained params → dense tree → one greedy decode step
-    dense = llama.unstack_pp_params(cfg, jax.device_get(state.params))
-    dense_cfg = dataclasses.replace(cfg, pp_stages=0)
-    tokens = batch["tokens"][:1, :8]
-    logits = llama.Llama(dense_cfg).apply({"params": dense}, tokens)
-    next_token = int(jax.numpy.argmax(logits[0, -1]))
+    # decode straight from the pipeline-staged params
+    from lzy_tpu.models.generate import pp_generate
+
+    prompt = batch["tokens"][:1, :8]
+    out = pp_generate(cfg, jax.device_get(state.params), prompt,
+                      max_new_tokens=1, mesh=mesh, temperature=0.0)
+    next_token = int(out[0, -1])
     return {"improved": last < first, "next_token_in_vocab":
             0 <= next_token < cfg.vocab_size}
 
