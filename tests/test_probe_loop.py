@@ -1,0 +1,104 @@
+"""The relay-evidence loop's decision logic (tools/tpu_probe_loop.py).
+
+If the relay revives, this loop is what converts the revival window into
+committed artifacts — it must not be the thing that fails. The expensive
+legs (bench, pytest, sweep) are stubbed; the decisions (TCP preflight
+short-circuit, rate limiting, capture sequencing, history records) run
+for real against a temp evidence dir.
+"""
+
+import importlib
+import json
+import os
+
+import pytest
+
+
+@pytest.fixture()
+def loop(tmp_path, monkeypatch):
+    monkeypatch.syspath_prepend(
+        os.path.join(os.path.dirname(__file__), "..", "tools"))
+    mod = importlib.import_module("tpu_probe_loop")
+    monkeypatch.setattr(mod, "EVIDENCE", str(tmp_path))
+    return mod
+
+
+class TestPreflightDecisions:
+    def test_refused_probe_is_free_and_recorded(self, loop, monkeypatch):
+        monkeypatch.setattr(loop, "tcp_preflight",
+                            lambda: {"status": "refused",
+                                     "latency_ms": 0.1, "port": 8083})
+        called = []
+        monkeypatch.setattr(loop, "jax_probe",
+                            lambda: called.append(1) or (True, "ok", 1.0))
+        up, ran = loop.probe_once()
+        assert not up and not ran and not called
+        rec = json.loads(open(f"{loop.EVIDENCE}/probe_history.jsonl")
+                         .readlines()[-1])
+        assert rec["up"] is False and rec["tcp"]["status"] == "refused"
+
+    def test_open_port_triggers_the_jax_probe(self, loop, monkeypatch):
+        monkeypatch.setattr(loop, "tcp_preflight",
+                            lambda: {"status": "open", "latency_ms": 0.2,
+                                     "port": 8083})
+        monkeypatch.setattr(loop, "jax_probe",
+                            lambda: (True, "ok: 1x axon", 3.0))
+        up, ran = loop.probe_once()
+        assert up and ran
+
+    def test_forced_probe_overrides_refused(self, loop, monkeypatch):
+        monkeypatch.setattr(loop, "tcp_preflight",
+                            lambda: {"status": "refused",
+                                     "latency_ms": 0.1, "port": 8083})
+        monkeypatch.setattr(loop, "jax_probe",
+                            lambda: (False, "init failed", 124.0))
+        up, ran = loop.probe_once(force_jax=True)
+        assert not up and ran                 # ground-truth probe still ran
+
+    def test_wedged_listener_is_rate_limited(self, loop, monkeypatch):
+        monkeypatch.setattr(loop, "tcp_preflight",
+                            lambda: {"status": "open", "latency_ms": 0.2,
+                                     "port": 8083})
+        calls = []
+        monkeypatch.setattr(
+            loop, "jax_probe",
+            lambda: calls.append(1) or (False, "hung", 124.0))
+        up, ran = loop.probe_once(jax_allowed=False)
+        assert not up and not ran and not calls
+        rec = json.loads(open(f"{loop.EVIDENCE}/probe_history.jsonl")
+                         .readlines()[-1])
+        assert "backing off" in rec["detail"]
+
+
+class TestCaptureSequencing:
+    def test_bench_json_line_is_parsed_and_recorded(self, loop, monkeypatch,
+                                                    tmp_path):
+        line = json.dumps({"metric": "llama_train_step_mfu", "value": 0.52,
+                           "unit": "mfu_fraction", "vs_baseline": 1.3})
+
+        class FakeProc:
+            stdout = (b"noise\n" + line.encode() + b"\n")
+            stderr = b"[bench] staged progress\n"
+            returncode = 0
+
+        monkeypatch.setattr(loop.subprocess, "run",
+                            lambda *a, **k: FakeProc())
+        assert loop.capture_bench() is True
+        rec = json.load(open(f"{tmp_path}/BENCH_LOCAL.json"))
+        assert rec["ok"] and rec["parsed"]["value"] == 0.52
+        assert "staged progress" in open(
+            f"{tmp_path}/bench_stderr.log").read()
+
+    def test_error_bearing_bench_line_is_not_a_capture(self, loop,
+                                                       monkeypatch):
+        line = json.dumps({"metric": "llama_train_step_mfu", "value": 0.0,
+                           "error": "backend never initialized"})
+
+        class FakeProc:
+            stdout = line.encode() + b"\n"
+            stderr = b""
+            returncode = 0
+
+        monkeypatch.setattr(loop.subprocess, "run",
+                            lambda *a, **k: FakeProc())
+        assert loop.capture_bench() is False   # the loop must keep trying
