@@ -521,6 +521,33 @@ class TestPipelinedDecode:
                             temperature=0.0, eos_token=3)
         np.testing.assert_array_equal(np.asarray(pp_out), np.asarray(dense))
 
+    def test_decodes_from_live_sharded_train_state(self):
+        """The loop users actually run: train on pp×fsdp, then decode
+        straight from the LIVE sharded state.params — no device_get, no
+        unstack."""
+        from lzy_tpu.models.generate import pp_generate
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(vocab_size=256),
+                                  pp_stages=2, dtype=jnp.float32)
+        mesh = mesh_for(8, pp=2, fsdp=4)
+        params, axes = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tx = optax.adamw(1e-2)
+        step, shard_state, _ = make_train_step(
+            llama.make_loss_fn(cfg, mesh), tx, mesh=mesh,
+            param_logical_axes=axes, batch_logical_axes=("batch", "seq"),
+            donate=False)
+        state = shard_state(TrainState.create(params, tx))
+        batch = {"tokens": jax.random.randint(
+            jax.random.PRNGKey(2), (8, 32), 0, cfg.vocab_size)}
+        state, _ = step(state, batch)
+        prompt = batch["tokens"][:1, :8]
+        out = pp_generate(cfg, state.params, prompt, max_new_tokens=4,
+                          mesh=mesh, temperature=0.0)
+        dense = self._dense(
+            cfg, jax.device_get(state.params), prompt,
+            max_new_tokens=4, temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(dense))
+
     def test_bf16_sampled_parity(self):
         """The default dtype too: the pipelined tail mirrors the dense
         model's norm/head dtypes exactly, so even bf16 sampling stays
