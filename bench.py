@@ -62,12 +62,19 @@ def tcp_preflight() -> str | None:
     if os.environ.get("JAX_PLATFORMS") != "axon" or not os.environ.get(
             "PALLAS_AXON_POOL_IPS"):
         return None  # not the relayed environment; nothing to preflight
+    tools_dir = os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools")
+    sys.path.insert(0, tools_dir)
     try:
-        sys.path.insert(0, os.path.join(os.path.dirname(
-            os.path.abspath(__file__)), "tools"))
         from tpu_diag import RELAY_HOST, RELAY_PORTS, tcp_probe
     except Exception:  # noqa: BLE001 — a tooling import must never kill bench
         return None
+    finally:
+        # don't leave tools/ shadowing stdlib names for the whole process
+        try:
+            sys.path.remove(tools_dir)
+        except ValueError:
+            pass
     port = RELAY_PORTS[0]
     last = "unknown"
     deadline = time.monotonic() + 60  # relay may be mid-restart; give it 60 s
@@ -250,6 +257,7 @@ def init_devices(timeout_s: float = 240.0):
 
 
 def run() -> None:
+    _apply_platform_contract()
     _log("initializing jax backend...")
     try:
         devices = init_devices()
@@ -353,9 +361,15 @@ def run() -> None:
         params = batch = metrics = None
         jax.clear_caches()
         # the fused loss frees the ~2 GB logits activation — exactly what a
-        # doubled batch needs; this variant is the headline candidate
+        # doubled batch needs; this variant is the headline candidate.
+        # Full-recompute remat: the AOT memory analysis
+        # (tpu_evidence/AOT_ANALYSIS.md) showed b16 needs 23 GB HBM with
+        # remat off and still 21 GB with the dots policy — both would
+        # RESOURCE_EXHAUST on the chip; nothing_saveable fits in 8.6 GB
+        # with an MFU roofline of 0.79
         extra = variant_measurement(
-            jax, cfg, mesh, n_params, "fused_ce_b16", {"fused_ce": True},
+            jax, cfg, mesh, n_params, "fused_ce_b16",
+            {"fused_ce": True, "remat": True, "remat_policy": "nothing"},
             batch_size=16, seq_len=2048)
         if extra:
             detail.update(extra)
@@ -496,8 +510,25 @@ def step_breakdown(jax, loss_fn, params, batch, step_ms: float, n: int = 5):
         return {}
 
 
+def _apply_platform_contract() -> None:
+    """Honor JAX_PLATFORMS at the config level in bench children: the
+    pinned axon plugin on this host overrides env vars, so a cpu-platform
+    bench run (local verify, CI) would otherwise hang all four probes
+    against the dead relay (worker_main/__graft_entry__ recipe)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat or plat == "axon":
+        return  # axon is the plugin's own default path
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    except Exception:  # noqa: BLE001 — older jax without the option
+        pass
+
+
 def probe() -> None:
     """Child probe: init the backend under a 120 s watchdog, print one line."""
+    _apply_platform_contract()
     try:
         devices = init_devices(120.0)
     except Exception as e:  # noqa: BLE001 — reported to the supervisor

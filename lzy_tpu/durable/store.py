@@ -108,6 +108,16 @@ class OperationStore:
             self._conn.commit()
         return self.load(op_id)
 
+    def find_by_idempotency_key(self, key: str) -> Optional[OpRecord]:
+        """Lookup without create — lets callers probe a legacy key
+        namespace (pre-scoping records) before writing a new record."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT id FROM operations WHERE idempotency_key = ?",
+                (key,),
+            ).fetchone()
+        return self.load(row[0]) if row is not None else None
+
     def load(self, op_id: str) -> OpRecord:
         with self._lock:
             row = self._conn.execute(

@@ -7,12 +7,21 @@ single-tenant-friendly but IAM-shaped — subjects, roles, signed tokens, and an
 ``authorize`` check the services call, so a multi-tenant backend can replace
 the token scheme without touching call sites.
 
-Tokens are HMAC-SHA256 over ``subject_id:issued_at:generation`` with a
-per-deployment secret (the stdlib equivalent of the reference's RSA JWTs; the
-interface — issue/authenticate — is the same). Like the reference JWTs they
-expire: ``authenticate`` enforces a max token age, and each subject carries a
-generation counter so tokens can be rotated (``rotate_subject``) without
-deleting the subject.
+Two token schemes share one ``authenticate``:
+
+- **Key-signed (preferred)** — Ed25519 signatures verified against public
+  keys stored per subject (``iam/keys.py``; reference parity:
+  ``DbAuthService.java:29`` per-subject keys, ``WorkerServiceImpl.java:
+  249-270`` fresh keypair per worker VM). Verifying planes hold only
+  public keys, so compromising a verifier does NOT grant forging power.
+  A subject that has registered keys is *asymmetric-only*: HMAC tokens
+  for it are refused, so the shared secret cannot be used to sidestep
+  the stronger scheme.
+- **HMAC (compat)** — SHA256 over ``subject_id:issued_at:generation``
+  with a per-deployment secret, for deployments without ``cryptography``.
+
+Both expire (max token age) and rotate via the subject's generation
+counter (``rotate_subject``) without deleting the subject.
 """
 
 from __future__ import annotations
@@ -95,36 +104,94 @@ class IamService:
     # -- subjects --------------------------------------------------------------
 
     def create_subject(self, subject_id: str, kind: str = USER,
-                       role: str = OWNER) -> str:
-        """Registers the subject and returns its bearer token."""
+                       role: str = OWNER,
+                       public_key: Optional[str] = None) -> Optional[str]:
+        """Registers the subject. Without ``public_key`` returns an HMAC
+        bearer token; with one, the subject is asymmetric-only and no
+        token is returned — the key holder signs its own
+        (``iam.keys.sign_token``)."""
         if kind not in (USER, WORKER):
             raise ValueError(f"bad subject kind {kind!r}")
         if role not in _ROLE_PERMISSIONS:
             raise ValueError(f"bad role {role!r}")
-        self._store.kv_put("iam", f"subject:{subject_id}",
-                           {"kind": kind, "role": role, "gen": 0})
-        return self._issue(subject_id, 0)
+        doc = {"kind": kind, "role": role, "gen": 0}
+        if public_key is not None:
+            doc["keys"] = {"default": public_key}
+        self._store.kv_put("iam", f"subject:{subject_id}", doc)
+        return None if public_key is not None else self._issue(subject_id, 0)
+
+    def create_worker_subject(self, subject_id: str,
+                              role: str = WORKER_ROLE) -> tuple:
+        """Mint a fresh Ed25519 keypair for a worker VM, register the
+        public half, and return ``(private_pem, signed_token)`` — the
+        private key travels to the VM exactly once (register/init RPC)
+        and is never persisted here. Reference:
+        ``WorkerServiceImpl.createWorkerSubject``
+        (graph-executor-2/.../WorkerServiceImpl.java:249-270)."""
+        from lzy_tpu.iam import keys as ed
+
+        private_pem, public_pem = ed.generate_keypair()
+        self.create_subject(subject_id, kind=WORKER, role=role,
+                            public_key=public_pem)
+        return private_pem, ed.sign_token(private_pem, subject_id, 0)
+
+    # -- per-subject public keys (site Keys routes / DbAuthService parity) ----
+
+    def add_public_key(self, subject_id: str, public_pem: str,
+                       name: str = "default") -> None:
+        doc = self._subject_doc(subject_id)
+        doc.setdefault("keys", {})[name] = public_pem
+        self._store.kv_put("iam", f"subject:{subject_id}", doc)
+
+    def remove_public_key(self, subject_id: str, name: str) -> None:
+        doc = self._subject_doc(subject_id)
+        keys = doc.get("keys", {})
+        if name not in keys:
+            raise KeyError(f"subject {subject_id!r} has no key {name!r}")
+        del keys[name]
+        self._store.kv_put("iam", f"subject:{subject_id}", doc)
+
+    def list_public_keys(self, subject_id: str) -> Dict[str, str]:
+        return dict(self._subject_doc(subject_id).get("keys", {}))
+
+    def _subject_doc(self, subject_id: str) -> Dict:
+        doc = self._store.kv_get("iam", f"subject:{subject_id}")
+        if doc is None:
+            raise KeyError(f"unknown subject {subject_id!r}")
+        return doc
 
     def remove_subject(self, subject_id: str) -> None:
         self._store.kv_del("iam", f"subject:{subject_id}")
 
-    def rotate_subject(self, subject_id: str) -> str:
+    def rotate_subject(self, subject_id: str) -> Optional[str]:
         """Invalidate every outstanding token for the subject (bump its
-        generation) and return a fresh one — revocation without deletion."""
+        generation) — revocation without deletion. Returns a fresh HMAC
+        token, or None for an asymmetric subject (its key holder signs
+        its own tokens at the new generation; read it via
+        ``subject_generation``)."""
         doc = self._store.kv_get("iam", f"subject:{subject_id}")
         if doc is None:
             raise KeyError(f"unknown subject {subject_id!r}")
         gen = int(doc.get("gen", 0)) + 1
         doc["gen"] = gen
         self._store.kv_put("iam", f"subject:{subject_id}", doc)
-        return self._issue(subject_id, gen)
+        return None if doc.get("keys") else self._issue(subject_id, gen)
 
     def issue_token(self, subject_id: str) -> str:
-        """Fresh token for an existing subject at its current generation."""
+        """Fresh HMAC token for an existing subject at its current
+        generation. Refused for asymmetric subjects — the service must
+        not hold the power to mint their credentials."""
         doc = self._store.kv_get("iam", f"subject:{subject_id}")
         if doc is None:
             raise KeyError(f"unknown subject {subject_id!r}")
+        if doc.get("keys"):
+            raise AuthError(
+                f"subject {subject_id!r} is asymmetric-only; tokens are "
+                f"signed by its key holder, not issued by the service")
         return self._issue(subject_id, int(doc.get("gen", 0)))
+
+    def subject_generation(self, subject_id: str) -> int:
+        return int(self._subject_doc(subject_id).get("gen", 0))
 
     # -- one-time tokens (OTT) -------------------------------------------------
 
@@ -203,6 +270,10 @@ class IamService:
         return f"{subject_id}:{ts}:{gen}:{sig}"
 
     def authenticate(self, token: Optional[str]) -> Subject:
+        from lzy_tpu.iam import keys as ed
+
+        if ed.is_ed_token(token):
+            return self._authenticate_ed(token)
         if token and token.count(":") == 2:
             # pre-generation token format ("subject:ts:sig"): cryptographically
             # fine but unrevocable; direct the holder to re-auth instead of a
@@ -225,7 +296,34 @@ class IamService:
         doc = self._store.kv_get("iam", f"subject:{subject_id}")
         if doc is None:
             raise AuthError(f"unknown subject {subject_id!r}")
+        if doc.get("keys"):
+            # asymmetric-only subject: accepting an HMAC token here would
+            # let anyone holding the shared verifier secret forge this
+            # subject — the exact hole per-subject keys exist to close
+            raise AuthError(
+                f"subject {subject_id!r} requires key-signed tokens")
         if int(gen) != int(doc.get("gen", 0)):
+            raise AuthError("token revoked (stale generation)")
+        return Subject(id=subject_id, kind=doc["kind"], role=doc["role"])
+
+    def _authenticate_ed(self, token: str) -> Subject:
+        from lzy_tpu.iam import keys as ed
+
+        if not ed.have_crypto():
+            raise AuthError("key-signed token but no cryptography on host")
+        try:
+            subject_id, issued_at, gen, payload, sig = ed.parse_token(token)
+        except ValueError as e:
+            raise AuthError(str(e))
+        doc = self._store.kv_get("iam", f"subject:{subject_id}")
+        if doc is None:
+            raise AuthError(f"unknown subject {subject_id!r}")
+        keys = doc.get("keys") or {}
+        if not any(ed.verify(pem, payload, sig) for pem in keys.values()):
+            raise AuthError("invalid token signature")
+        if time.time() - issued_at > self.max_token_age_s:
+            raise AuthError("token expired")
+        if gen != int(doc.get("gen", 0)):
             raise AuthError("token revoked (stale generation)")
         return Subject(id=subject_id, kind=doc["kind"], role=doc["role"])
 

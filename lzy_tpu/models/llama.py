@@ -288,11 +288,18 @@ class Mlp(nn.Module):
 
 
 class DecoderLayer(nn.Module):
+    """One decoder block. ``mesh`` is a module FIELD, not a call argument:
+    under ``nn.remat`` every call argument is traced, and a Mesh object
+    cannot be interpreted as an abstract array — remat=True with a mesh
+    crashed until the mesh moved to construction time (caught by the AOT
+    compile of the seq-4k bench variant, tpu_evidence/AOT_ANALYSIS.md)."""
+
     cfg: LlamaConfig
+    mesh: Any = None
 
     @nn.compact
-    def __call__(self, x, positions, mesh=None, segments=None):
-        cfg = self.cfg
+    def __call__(self, x, positions, segments=None):
+        cfg, mesh = self.cfg, self.mesh
         x = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attn_norm")(x),
             positions, mesh, segments,
@@ -309,6 +316,24 @@ class DecoderLayer(nn.Module):
             self.sow("losses", "moe_aux", aux)
             return x + moe_out
         return x + Mlp(cfg, name="mlp")(h)
+
+
+def _anchor(x, mesh, *logical_axes):
+    """Pin an activation's sharding to the logical rules (maxtext-style
+    anchor). Without this the TPU partitioner may resolve a
+    param-vs-activation axis conflict by un-sharding the *batch* — on an
+    fsdp mesh the embed table is (vocab, embed->fsdp), and propagating
+    that into the residual stream makes XLA batch-all-gather every
+    [B,T,V]-shaped intermediate (33 MB each at test size, 34 GB at
+    flagship scale: tpu_evidence/AOT_ANALYSIS.md)."""
+    if mesh is None or mesh.size == 1:
+        return x
+    from jax.sharding import NamedSharding
+
+    from lzy_tpu.parallel.sharding import spec_for
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, spec_for(logical_axes)))
 
 
 def _embed_lookup(table, tokens, *, one_hot: bool):
@@ -343,6 +368,7 @@ class Llama(nn.Module):
         )
         x = _embed_lookup(emb.astype(cfg.dtype), tokens,
                           one_hot=mesh is not None)
+        x = _anchor(x, mesh, "batch", "seq", "act_embed")
         if segments is None:
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1]), tokens.shape
@@ -361,7 +387,8 @@ class Llama(nn.Module):
                 policy=_remat_policy(cfg.remat_policy),
             )
         for i in range(cfg.n_layers):
-            x = layer(cfg, name=f"layer_{i}")(x, positions, mesh, segments)
+            x = layer(cfg, mesh=mesh, name=f"layer_{i}")(
+                x, positions, segments)
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="final_norm")(x)
         if cfg.tie_embeddings:
             head = emb
@@ -380,10 +407,11 @@ class Llama(nn.Module):
             return x.astype(cfg.dtype), head.astype(cfg.dtype)
         # bf16 operands on the MXU, f32 accumulation — an f32×f32 head matmul
         # would run ~4x slower for no useful precision (loss is f32 anyway)
-        return jnp.einsum(
+        logits = jnp.einsum(
             "bte,ve->btv", x.astype(cfg.dtype), head.astype(cfg.dtype),
             preferred_element_type=jnp.float32,
         )
+        return _anchor(logits, mesh, "batch", "seq", "act_vocab")
 
 
 class LlamaStage(nn.Module):
@@ -411,8 +439,8 @@ class LlamaStage(nn.Module):
                 policy=_remat_policy(cfg.remat_policy),
             )
         for i in range(self.n_layers):
-            x = layer(cfg, name=f"layer_{i}")(x, positions, self.mesh,
-                                              segments)
+            x = layer(cfg, mesh=self.mesh, name=f"layer_{i}")(
+                x, positions, segments)
         return x
 
 
@@ -654,7 +682,7 @@ def make_loss_fn(cfg: LlamaConfig, mesh=None):
             shifted_mask = mask[:, 1:] if mask is not None else None
             if segments is not None:
                 shifted_mask = _segment_shift_mask(segments, shifted_mask)
-            return _lm_loss(cfg, out, tokens, shifted_mask) + aux
+            return _lm_loss(cfg, out, tokens, shifted_mask, mesh) + aux
 
         return pp_loss_fn
     model = Llama(cfg)
@@ -678,7 +706,7 @@ def make_loss_fn(cfg: LlamaConfig, mesh=None):
         shifted_mask = mask[:, 1:] if mask is not None else None
         if segments is not None:
             shifted_mask = _segment_shift_mask(segments, shifted_mask)
-        return _lm_loss(cfg, logits, tokens, shifted_mask) + aux
+        return _lm_loss(cfg, logits, tokens, shifted_mask, mesh) + aux
 
     return loss_fn
 
@@ -692,13 +720,22 @@ def _segment_shift_mask(segments, shifted_mask):
         else jnp.logical_and(shifted_mask, same_doc)
 
 
-def _lm_loss(cfg: LlamaConfig, out, tokens, shifted_mask):
+def _lm_loss(cfg: LlamaConfig, out, tokens, shifted_mask, mesh=None):
     """Shared next-token loss tail: ``out`` is logits, or (features, head)
     when ``cfg.fused_ce`` (both the dense and pipelined paths end here)."""
     if cfg.fused_ce:
         features, head = out
         from lzy_tpu.ops.chunked_ce import chunked_cross_entropy
 
+        # anchor the CE operands: features keep the batch sharded; the
+        # head is gathered whole ONCE (vocab x embed, ~67 MB bf16 at
+        # flagship size) instead of the partitioner keeping its embed dim
+        # fsdp-sharded and batch-all-gathering every chunk of the scan —
+        # the 193 GB/step pathology AOT_ANALYSIS caught on v5e-16
+        features = _anchor(features, mesh, "batch", "seq", "act_embed")
+        # (vocab, None): "act_embed" here would map to the same mesh axis
+        # as "vocab" (both tp) and P("tp","tp") is illegal
+        head = _anchor(head, mesh, "vocab", None)
         return chunked_cross_entropy(
             features[:, :-1], head, tokens[:, 1:], mask=shifted_mask,
         )

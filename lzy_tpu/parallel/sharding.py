@@ -31,6 +31,13 @@ DEFAULT_RULES: Rules = {
     "expert": "ep",
     "norm": None,
     "embed_out": None,
+    # activation anchors: the residual stream and logits shard over tp,
+    # NEVER fsdp — fsdp shards *params* on model dims and *batch* on the
+    # batch dim; letting the partitioner put an activation's model dim on
+    # fsdp instead makes it batch-all-gather [B,T,V]-sized intermediates
+    # (the 377 MB pred gathers tests/test_aot_topology.py pins)
+    "act_embed": "tp",
+    "act_vocab": "tp",
     "stage": "pp",
     # conv models
     "conv_spatial": None,

@@ -162,6 +162,14 @@ def make_train_step(
                 self._compiled = jit_step(state)
             return self._compiled(state, batch)
 
+        def lower(self, state: TrainState, batch: Any):
+            """AOT entry: lower the sharded step against (possibly abstract)
+            avals. ``jax.ShapeDtypeStruct`` pytrees work — shardings derive
+            from tree structure + the closed-over mesh, never from device
+            buffers — which is what lets ``tools/aot_analysis.py`` compile
+            the full train step against a deviceless TPU topology."""
+            return jit_step(state).lower(state, batch)
+
     return _Stepper(), shard_state, batch_sharding
 
 

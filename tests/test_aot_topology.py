@@ -1,0 +1,120 @@
+"""Deviceless TPU AOT compiles: the dryrun's warning assert, promoted to
+the real target (VERDICT r4 #1).
+
+The CPU dryrun proves the sharded step executes; these tests prove the
+*TPU* compiler (same libtpu the chip uses, via
+``jax.experimental.topologies``) schedules it without collective
+pathologies: a single-chip module must contain no collectives at all,
+and an fsdp module's all-gather traffic must stay within the expected
+parameter-gathering budget — an activation resharding cliff blows
+straight through that bound. ``tools/aot_analysis.py`` runs the same
+machinery at flagship size and commits the evidence artifact
+(``tpu_evidence/AOT_ANALYSIS.*``).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lzy_tpu.models import count_params, llama, unbox
+from lzy_tpu.models.common import param_logical_axes
+
+
+def _topo(name, **kw):
+    import time
+
+    from jax.experimental import topologies
+
+    last = None
+    for _ in range(6):
+        try:
+            return topologies.get_topology_desc(
+                platform="tpu", topology_name=name, **kw)
+        except Exception as e:  # noqa: BLE001 — no libtpu on this host
+            last = e
+            # libtpu is single-process (one /tmp/libtpu_lockfile): another
+            # compile (tools/aot_analysis.py, the probe loop's bench) may
+            # hold it right now — that's contention, not absence
+            if "lockfile" not in str(e):
+                break
+            time.sleep(10)
+    pytest.skip(f"deviceless TPU topology unavailable: {last}")
+
+
+def _small_cfg():
+    # small-but-not-tiny: at toy sizes the partitioner makes degenerate
+    # choices that would make the traffic bound meaningless
+    return llama.LlamaConfig(
+        vocab_size=4096, d_model=256, n_layers=2, n_heads=8, n_kv_heads=4,
+        d_ff=512, max_seq_len=256, remat=False, tie_embeddings=True,
+    )
+
+
+def _compile(cfg, devices, mesh_axes, batch_shape):
+    import optax
+
+    from lzy_tpu.parallel import MeshSpec, TrainState, make_train_step
+
+    mesh = MeshSpec(**mesh_axes).build(devices)
+    boxed = jax.eval_shape(
+        lambda k: llama.init_params(cfg, k)[0], jax.random.PRNGKey(0))
+    params = unbox(boxed)
+    tx = optax.adamw(3e-4)
+    state = jax.eval_shape(lambda p: TrainState.create(p, tx), params)
+    step, _, batch_sharding = make_train_step(
+        llama.make_loss_fn(cfg, mesh), tx, mesh=mesh,
+        param_logical_axes=param_logical_axes(boxed),
+        batch_logical_axes=("batch", "seq"))
+    batch = {"tokens": jax.ShapeDtypeStruct(
+        batch_shape, jnp.int32, sharding=batch_sharding)}
+
+    from tools.aot_analysis import StderrCapture, collective_census
+
+    with StderrCapture() as scan:
+        compiled = step.lower(state, batch).compile()
+    return compiled, collective_census(compiled.as_text()), scan.text()
+
+
+def test_single_chip_module_has_no_collectives():
+    topo = _topo("v5e:1x1x1", chips_per_host_bounds=(1, 1, 1))
+    cfg = _small_cfg()
+    compiled, census, stderr = _compile(
+        cfg, list(topo.devices), {"fsdp": -1}, (4, 256))
+    assert census == {}, f"single-chip module emits collectives: {census}"
+    assert "Involuntary full rematerialization" not in stderr
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    assert ca.get("flops", 0) > 0
+
+
+def test_fsdp_module_collectives_are_the_expected_ones():
+    topo = _topo("v5e:2x2")
+    cfg = _small_cfg()
+    compiled, census, stderr = _compile(
+        cfg, list(topo.devices), {"fsdp": -1}, (8, 256))
+    assert "Involuntary full rematerialization" not in stderr
+
+    # fsdp's legal collective set: param all-gathers (fwd + bwd), grad
+    # reduction (all-reduce or reduce-scatter), scalar metric reductions.
+    # An all-to-all means the partitioner invented a resharding nobody
+    # asked for.
+    assert "all-to-all" not in census, census
+    assert "all-gather" in census, "fsdp must gather params"
+    assert ("all-reduce" in census) or ("reduce-scatter" in census), (
+        "fsdp must reduce grads")
+
+    # traffic budget: fsdp gathers each param in bf16 for fwd, bwd, and a
+    # few extra uses (the tied embedding feeds embed + head + both
+    # backwards) — a handful of full-tree equivalents. Before the
+    # activation anchors (models/llama.py _anchor) the partitioner
+    # batch-all-gathered [B,T,V] masks instead: 1459 MB here, 164x the
+    # tree — this bound pins that class of regression with huge margin.
+    boxed = jax.eval_shape(
+        lambda k: llama.init_params(cfg, k)[0], jax.random.PRNGKey(0))
+    param_bytes = count_params(unbox(boxed)) * 4  # f32 master params
+    ag_bytes = census["all-gather"]["bytes"]
+    assert ag_bytes <= 6 * param_bytes, (
+        f"all-gather traffic {ag_bytes/1e6:.1f} MB exceeds 6x param bytes "
+        f"{6*param_bytes/1e6:.1f} MB — unexpected gathers beyond fsdp's "
+        f"param fwd+bwd budget")

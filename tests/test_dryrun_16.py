@@ -33,3 +33,8 @@ def test_dryrun_multichip_16_devices():
     # the 16-device shape too — resharding cliffs often appear only at
     # larger axis products
     assert "Involuntary full rematerialization" not in res.stderr
+
+    from conftest import record_tier_run
+
+    record_tier_run("LZY_SLOW:dryrun16",
+                    res.stdout.strip().splitlines()[-1][:200])

@@ -377,6 +377,10 @@ class TestContainerExecution:
                     )()
                     _ = int(r)
             assert isinstance(exc_info.value.__cause__, ValueError)
+
+            from conftest import record_tier_run
+
+            record_tier_run("docker:real_container", f"image={image}")
         finally:
             c.shutdown()
 
@@ -536,3 +540,7 @@ exit 0
         out = sp.run([python, "-c", "print('conda-env-ok')"],
                      capture_output=True, text=True, timeout=300)
         assert out.returncode == 0 and "conda-env-ok" in out.stdout
+
+        from conftest import record_tier_run
+
+        record_tier_run("conda:real_env_create", python)

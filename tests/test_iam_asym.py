@@ -1,0 +1,158 @@
+"""Per-subject asymmetric credentials (VERDICT r4 missing #3).
+
+The property under test: *verification no longer implies forging power*.
+Reference parity: per-subject public keys (``DbAuthService.java:29``),
+fresh keypair per worker VM (``WorkerServiceImpl.java:249-270``).
+"""
+
+import time
+
+import pytest
+
+from lzy_tpu.durable.store import OperationStore
+from lzy_tpu.iam import AuthError, IamService, WORKER
+from lzy_tpu.iam import keys as ed
+
+pytestmark = pytest.mark.skipif(
+    not ed.have_crypto(), reason="no cryptography on host")
+
+
+@pytest.fixture()
+def iam():
+    return IamService(OperationStore(":memory:"))
+
+
+class TestKeySignedTokens:
+    def test_worker_subject_roundtrip(self, iam):
+        private_pem, token = iam.create_worker_subject("vm/alpha")
+        subject = iam.authenticate(token)
+        assert subject.id == "vm/alpha" and subject.kind == WORKER
+        # and the key holder can mint further tokens on its own
+        subject = iam.authenticate(ed.sign_token(private_pem, "vm/alpha"))
+        assert subject.id == "vm/alpha"
+
+    def test_user_registers_own_public_key(self, iam):
+        private_pem, public_pem = ed.generate_keypair()
+        assert iam.create_subject("alice", public_key=public_pem) is None
+        assert iam.authenticate(
+            ed.sign_token(private_pem, "alice")).id == "alice"
+
+    def test_hmac_secret_cannot_forge_asymmetric_subject(self, iam):
+        """THE adversarial property: an attacker holding the deployment's
+        HMAC verifier secret (any verifying plane) crafts a structurally
+        valid HMAC token for an asymmetric subject — refused."""
+        iam.create_worker_subject("vm/alpha")
+        forged = iam._issue("vm/alpha", 0)  # attacker == the secret holder
+        with pytest.raises(AuthError, match="requires key-signed"):
+            iam.authenticate(forged)
+        # nor will the service mint one through the front door
+        with pytest.raises(AuthError, match="asymmetric-only"):
+            iam.issue_token("vm/alpha")
+
+    def test_one_workers_key_cannot_impersonate_another(self, iam):
+        """A compromised worker (its private key leaked) still cannot
+        speak as any other subject."""
+        key_a, _ = iam.create_worker_subject("vm/a")
+        iam.create_worker_subject("vm/b")
+        cross = ed.sign_token(key_a, "vm/b")  # B's identity, A's key
+        with pytest.raises(AuthError, match="invalid token signature"):
+            iam.authenticate(cross)
+
+    def test_rotation_revokes_outstanding_signatures(self, iam):
+        private_pem, token = iam.create_worker_subject("vm/alpha")
+        assert iam.rotate_subject("vm/alpha") is None  # holder re-signs
+        with pytest.raises(AuthError, match="stale generation"):
+            iam.authenticate(token)
+        gen = iam.subject_generation("vm/alpha")
+        fresh = ed.sign_token(private_pem, "vm/alpha", gen)
+        assert iam.authenticate(fresh).id == "vm/alpha"
+
+    def test_expiry_enforced(self, iam):
+        iam.max_token_age_s = 10.0
+        private_pem, _ = iam.create_worker_subject("vm/alpha")
+        stale = ed.sign_token(private_pem, "vm/alpha", 0,
+                              now=time.time() - 60)
+        with pytest.raises(AuthError, match="expired"):
+            iam.authenticate(stale)
+
+    def test_key_crud(self, iam):
+        priv1, pub1 = ed.generate_keypair()
+        priv2, pub2 = ed.generate_keypair()
+        iam.create_subject("alice", public_key=pub1)
+        iam.add_public_key("alice", pub2, name="laptop")
+        assert set(iam.list_public_keys("alice")) == {"default", "laptop"}
+        # both keys authenticate; removing one revokes only its tokens
+        assert iam.authenticate(ed.sign_token(priv2, "alice")).id == "alice"
+        iam.remove_public_key("alice", "default")
+        with pytest.raises(AuthError):
+            iam.authenticate(ed.sign_token(priv1, "alice"))
+        assert iam.authenticate(ed.sign_token(priv2, "alice")).id == "alice"
+
+    def test_tampered_payload_rejected(self, iam):
+        iam.create_worker_subject("vm/alpha")
+        priv_b, _ = ed.generate_keypair()
+        # correct shape, self-consistent signature, wrong key entirely
+        with pytest.raises(AuthError, match="invalid token signature"):
+            iam.authenticate(ed.sign_token(priv_b, "vm/alpha"))
+
+
+class TestAllocatorAsymmetricFlow:
+    def test_private_key_handed_out_exactly_once(self, tmp_path):
+        from lzy_tpu.service import InProcessCluster
+
+        c = InProcessCluster(
+            db_path=str(tmp_path / "meta.db"),
+            storage_uri=f"file://{tmp_path}/storage",
+            with_iam=True,
+        )
+        try:
+            lzy = c.lzy(token=c.iam.create_subject("asym-user"))
+            from lzy_tpu.core.op import op
+
+            @op
+            def one() -> int:
+                return 1
+
+            with lzy.workflow("asym-wf"):
+                assert int(one()) == 1
+
+                # assert while the session still owns the VM (teardown
+                # destroys cached VMs and their subjects asynchronously)
+                (vm,) = c.allocator.vms()
+                assert ed.is_ed_token(vm.worker_token), (
+                    "worker credential should be key-signed when "
+                    "cryptography is available")
+                # control plane verifies but cannot mint: issue refuses
+                with pytest.raises(AuthError):
+                    c.iam.issue_token(f"vm/{vm.id}")
+                # OTT exchange delivers the private key exactly once
+                ott = c.allocator.mint_bootstrap_token(vm.id)
+                token, private_pem = c.allocator.redeem_bootstrap_token(
+                    vm.id, ott)
+                assert token == vm.worker_token
+                assert private_pem is not None
+                # the key leaves the control plane once: a second
+                # exchange must NOT yield it again
+                ott2 = c.allocator.mint_bootstrap_token(vm.id)
+                _, again = c.allocator.redeem_bootstrap_token(vm.id, ott2)
+                assert again is None
+        finally:
+            c.shutdown()
+
+    def test_self_refresh_and_adoption(self):
+        """WorkerToken.maybe_self_refresh signs at the holder, and the
+        allocator adopts the fresh token for dial-backs."""
+        from lzy_tpu.rpc.control import WorkerToken
+
+        store = OperationStore(":memory:")
+        iam = IamService(store)
+        private_pem, token = iam.create_worker_subject("vm/w")
+        holder = WorkerToken(token)
+        holder.private_key = private_pem
+        assert holder.maybe_self_refresh() is None  # too young
+        holder.SELF_REFRESH_S = 0.0
+        time.sleep(1.1)  # signatures are deterministic per (subject, ts)
+        fresh = holder.maybe_self_refresh()
+        assert fresh is not None and fresh != token
+        assert holder.accepts(token) and holder.accepts(fresh)
+        assert iam.authenticate(fresh).id == "vm/w"

@@ -176,6 +176,35 @@ class TestExactlyOnce:
         finally:
             c.shutdown()
 
+    def test_pre_scoping_record_still_replays(self, tmp_path):
+        """Upgrade bridge (ADVICE r4): records persisted before keys were
+        subject-scoped live under the bare key; a retry that spans the
+        upgrade (now authenticated, hence scoped) must replay that
+        outcome instead of re-executing the mutation."""
+        c = InProcessCluster(
+            db_path=str(tmp_path / "meta.db"),
+            storage_uri=f"file://{tmp_path}/storage",
+            with_iam=True,
+        )
+        monkeypatch = pytest.MonkeyPatch()
+        monkeypatch.setenv("LZY_IDEM_LEGACY_BRIDGE", "1")
+        try:
+            # simulate the pre-upgrade deployment: a settled record under
+            # the unscoped key, as the old code would have written it
+            c.store.create("op-legacy", "idem.start_workflow", {},
+                           idempotency_key="legacy-key")
+            c.store.complete("op-legacy", "exec-from-before-the-upgrade")
+            tok = c.iam.create_subject("alice")
+            replayed = c.workflow_service.start_workflow(
+                "alice", "wf", c.storage_uri, token=tok,
+                client_version="0.1.0", idempotency_key="legacy-key")
+            assert replayed == "exec-from-before-the-upgrade"
+            # nothing re-executed: no new execution row appeared
+            assert replayed not in c.store.kv_list("executions")
+        finally:
+            monkeypatch.undo()
+            c.shutdown()
+
     def test_replayed_error_keeps_its_type(self, cluster):
         svc = cluster.workflow_service
         # KeyError (NOT_FOUND over the wire) must replay as KeyError, not a

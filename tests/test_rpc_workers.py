@@ -344,8 +344,9 @@ def test_worker_plane_requires_worker_token(tmp_path):
             # OTT bootstrap: the launch env carried a one-time credential
             # which registration burned — a replayed OTT cannot re-register
             ott = c.allocator.mint_bootstrap_token(vm.id)
-            assert c.allocator.redeem_bootstrap_token(vm.id, ott) \
-                == vm.worker_token
+            redeemed_token, _ = c.allocator.redeem_bootstrap_token(
+                vm.id, ott)
+            assert redeemed_token == vm.worker_token
             with pytest.raises(AuthError):
                 raw.call("RegisterVm", {"vm_id": vm.id,
                                         "endpoint": "127.0.0.1:1",
