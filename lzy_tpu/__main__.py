@@ -55,10 +55,10 @@ def _fetch_rows(args, view: str):
         print("pass --db <path> / $LZY_TPU_DB, or --address <host:port>",
               file=sys.stderr)
         sys.exit(2)
-    from lzy_tpu.durable import OperationStore
+    from lzy_tpu.durable.pg_store import store_for
     from lzy_tpu.service import status as status_views
 
-    store = OperationStore(args.db)
+    store = store_for(args.db)
     try:
         return status_views.collect(store, view)
     finally:
@@ -95,14 +95,14 @@ def cmd_auth(args) -> None:
     """Mint/rotate/revoke IAM subjects against the deployment store (the
     reference's `lzy auth` flow). Tokens print to stdout ONCE — they are
     not recoverable from the store."""
-    from lzy_tpu.durable import OperationStore
+    from lzy_tpu.durable.pg_store import store_for
     from lzy_tpu.iam import IamService
 
     if not args.db:
         print("auth needs the deployment store: pass --db <path>",
               file=sys.stderr)
         sys.exit(2)
-    store = OperationStore(args.db)
+    store = store_for(args.db)
     try:
         iam = IamService(store)
         if args.auth_command == "create":
@@ -122,10 +122,10 @@ def cmd_serve_console(args) -> None:
         print("console serves a local store; pass --db <path>",
               file=sys.stderr)
         sys.exit(2)
-    from lzy_tpu.durable import OperationStore
+    from lzy_tpu.durable.pg_store import store_for
     from lzy_tpu.service.console import StatusConsole
 
-    store = OperationStore(args.db)
+    store = store_for(args.db)
     # keys/tasks routes ride the store's IAM state when it exists (the
     # same subjects `python -m lzy_tpu auth` manages) — but only when no
     # LIVE control plane holds the store's leader lease: the mutating key

@@ -151,15 +151,29 @@ class ChannelManager:
                     del self._tombstones[cid]
         self.device.evict_execution(dead)
 
+    def _live(self, entry_id: str) -> Channel:
+        """Lookup with a diagnosable miss: a missing channel at this layer
+        almost always means the execution was torn down (client abort /
+        GC) while a straggler task was still running — say so instead of
+        a bare KeyError (seen as a load-dependent flake: a slow host lets
+        teardown overtake in-flight tasks)."""
+        try:
+            return self._channels[entry_id]
+        except KeyError:
+            raise KeyError(
+                f"channel {entry_id!r} unknown or already destroyed — was "
+                f"its execution torn down while this task was running?"
+            ) from None
+
     def get(self, entry_id: str) -> Channel:
         with self._lock:
-            return self._channels[entry_id]
+            return self._live(entry_id)
 
     # -- public API (slots parity: bind / transfer lifecycle) ------------------
 
     def bind(self, entry_id: str, role: str, task_id: str) -> Channel:
         with self._lock:
-            ch = self._channels[entry_id]
+            ch = self._live(entry_id)
             if role == PRODUCER:
                 ch.producer_task = task_id
             elif task_id not in ch.consumer_tasks:
@@ -172,7 +186,14 @@ class ChannelManager:
     def transfer_completed(self, entry_id: str) -> None:
         """Producer finished writing the storage peer; wake waiting consumers."""
         with self._cv:
-            ch = self._channels[entry_id]
+            ch = self._channels.get(entry_id)
+            if ch is None:
+                # a straggler finishing after its execution's teardown
+                # destroyed the channels: the data landed durably, nobody
+                # is left to consume it — benign, don't fail the task
+                _LOG.warning("transfer_completed for unknown channel %s "
+                             "(execution torn down?)", entry_id)
+                return
             ch.completed = True
             snap = self._snapshot(ch)
             self._cv.notify_all()
@@ -187,7 +208,11 @@ class ChannelManager:
 
     def transfer_failed(self, entry_id: str, error: str) -> None:
         with self._cv:
-            ch = self._channels[entry_id]
+            ch = self._channels.get(entry_id)
+            if ch is None:
+                _LOG.warning("transfer_failed for unknown channel %s "
+                             "(execution torn down?): %s", entry_id, error)
+                return
             if ch.completed:
                 return  # durable data already landed; late failure is moot
             ch.failed = error
@@ -202,7 +227,7 @@ class ChannelManager:
         deadline = time.time() + timeout_s
         with self._cv:
             while True:
-                ch = self._channels[entry_id]
+                ch = self._live(entry_id)
                 if ch.completed or ch.failed:
                     return ch
                 remaining = deadline - time.time()
@@ -219,7 +244,7 @@ class ChannelManager:
         deadline = None if timeout_s is None else time.time() + timeout_s
         with self._cv:
             while True:
-                ch = self._channels[entry_id]
+                ch = self._live(entry_id)
                 if ch.failed:
                     raise ChannelFailed(entry_id, ch.failed)
                 if ch.completed or entry_id in self.device:

@@ -1,0 +1,201 @@
+"""PostgreSQL backend for the durable operation store.
+
+The reference runs every control-plane service against PostgreSQL with
+Flyway migrations and serialization-failure retries
+(``util/util-common/.../model/db/DbHelper.java`` ``withRetries``;
+per-service ``src/main/resources/db/``), deployed replicated
+(``deployment/tf/modules/k8s/graph-executor.tf:74-80``). This module is
+that structural property for the TPU build: the exact
+:class:`~lzy_tpu.durable.store.OperationStore` interface (ops, kv,
+idempotency unique index, leases) on a server multiple control planes
+can share, where SQLite is one file on one host.
+
+Design: the SQLite store is the canonical dialect (``?`` placeholders,
+``IS ?`` null-safe compares); this subclass translates at the single
+:meth:`_execute` choke point and adds the DbHelper retry discipline —
+statements that fail with a serialization (40001) or deadlock (40P01)
+SQLSTATE are retried with backoff. Connections run autocommit, matching
+the base class's statement-per-transaction granularity (every base
+method is one statement + commit; the explicit ``commit()`` calls become
+no-ops here).
+
+Driver: ``psycopg2`` or ``pg8000``, whichever imports. The test suite
+parametrizes the durable/lease tiers over both backends and skips the
+Postgres leg unless ``LZY_PG_DSN`` is set (e.g.
+``postgresql://user:pw@host/db``) — when it does run, it appends tier
+evidence (tests/conftest.py ``record_tier_run``).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Optional
+
+from lzy_tpu.durable.store import OperationStore
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+_PG_SCHEMA = """
+CREATE TABLE IF NOT EXISTS operations (
+    id TEXT PRIMARY KEY,
+    kind TEXT NOT NULL,
+    status TEXT NOT NULL,
+    step BIGINT NOT NULL DEFAULT 0,
+    state TEXT NOT NULL,
+    result TEXT,
+    error TEXT,
+    idempotency_key TEXT UNIQUE,
+    deadline DOUBLE PRECISION,
+    created_at DOUBLE PRECISION NOT NULL,
+    updated_at DOUBLE PRECISION NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_operations_status ON operations(status);
+CREATE TABLE IF NOT EXISTS kv (
+    ns TEXT NOT NULL,
+    k TEXT NOT NULL,
+    v TEXT NOT NULL,
+    PRIMARY KEY (ns, k)
+);
+CREATE TABLE IF NOT EXISTS leases (
+    name TEXT PRIMARY KEY,
+    owner TEXT NOT NULL,
+    expires_at DOUBLE PRECISION NOT NULL
+);
+"""
+
+#: SQLSTATEs DbHelper.withRetries treats as retryable
+_RETRYABLE_SQLSTATES = {"40001", "40P01"}
+
+_IS_PLACEHOLDER = re.compile(r"\bIS \?")
+
+
+def translate(sql: str) -> str:
+    """Canonical (sqlite) dialect -> PostgreSQL.
+
+    ``IS ?`` (sqlite's null-safe equality against a bound value) becomes
+    ``IS NOT DISTINCT FROM %s``; remaining ``?`` placeholders become
+    ``%s``. The store's SQL never contains literal question marks in
+    strings, so a blanket replace is safe.
+    """
+    sql = _IS_PLACEHOLDER.sub("IS NOT DISTINCT FROM ?", sql)
+    return sql.replace("?", "%s")
+
+
+def connect(dsn: str):
+    """Open an autocommit DBAPI connection via whichever driver exists.
+    Returns ``(connection, integrity_error_type, get_sqlstate)``."""
+    try:
+        import psycopg2
+
+        conn = psycopg2.connect(dsn)
+        conn.autocommit = True
+        return conn, psycopg2.IntegrityError, \
+            lambda e: getattr(e, "pgcode", None)
+    except ImportError:
+        pass
+    try:
+        import pg8000.dbapi
+
+        import pg8000
+
+        conn = pg8000.dbapi.connect(**_dsn_to_kwargs(dsn))
+        conn.autocommit = True
+
+        def sqlstate(e):
+            args = getattr(e, "args", ())
+            if args and isinstance(args[0], dict):
+                return args[0].get("C")
+            return None
+
+        return conn, pg8000.dbapi.IntegrityError, sqlstate
+    except ImportError:
+        raise ImportError(
+            "PostgresOperationStore needs psycopg2 or pg8000; neither "
+            "imports on this host")
+
+
+def _dsn_to_kwargs(dsn: str) -> dict:
+    """postgresql://user:pw@host:port/db -> pg8000 kwargs."""
+    from urllib.parse import urlparse
+
+    u = urlparse(dsn)
+    if u.scheme not in ("postgresql", "postgres"):
+        raise ValueError(f"unsupported DSN scheme {u.scheme!r}")
+    kw = {"user": u.username or "postgres", "database": (u.path or "/")[1:]
+          or "postgres", "host": u.hostname or "127.0.0.1",
+          "port": u.port or 5432}
+    if u.password:
+        kw["password"] = u.password
+    return kw
+
+
+class _RetryingCursor:
+    """Cursor facade exposing fetchone/fetchall/rowcount like sqlite's."""
+
+    def __init__(self, cursor):
+        self._c = cursor
+
+    def fetchone(self):
+        return self._c.fetchone()
+
+    def fetchall(self):
+        return self._c.fetchall()
+
+    @property
+    def rowcount(self):
+        return self._c.rowcount
+
+
+class PostgresOperationStore(OperationStore):
+    MAX_RETRIES = 5
+
+    def __init__(self, dsn: str, *, _connect=connect):
+        # deliberately NOT calling super().__init__ — different connection
+        self._dsn = dsn
+        self._conn, integrity, self._sqlstate = _connect(dsn)
+        self._integrity_errors = (integrity,)
+        self._lock = threading.RLock()
+        cur = self._conn.cursor()
+        for stmt in _PG_SCHEMA.split(";"):
+            if stmt.strip():
+                cur.execute(stmt)
+
+    def _execute(self, sql: str, params: tuple = ()):
+        """Translate + execute with DbHelper.withRetries parity: retry
+        serialization/deadlock SQLSTATEs with linear backoff; everything
+        else (including integrity errors the base class handles) raises
+        through."""
+        pg_sql = translate(sql)
+        delay = 0.02
+        for attempt in range(self.MAX_RETRIES):
+            cur = self._conn.cursor()
+            try:
+                cur.execute(pg_sql, tuple(params))
+                return _RetryingCursor(cur)
+            except self._integrity_errors:
+                raise
+            except Exception as e:  # noqa: BLE001 — filtered by SQLSTATE
+                state = self._sqlstate(e)
+                if state not in _RETRYABLE_SQLSTATES \
+                        or attempt == self.MAX_RETRIES - 1:
+                    raise
+                _LOG.warning("retrying statement after SQLSTATE %s "
+                             "(attempt %d)", state, attempt + 1)
+                time.sleep(delay)
+                delay *= 2
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+def store_for(path_or_dsn: Optional[str]) -> OperationStore:
+    """Factory the services use: a ``postgresql://`` DSN selects the
+    server backend, anything else is a SQLite path (the default)."""
+    if path_or_dsn and path_or_dsn.startswith(("postgresql://",
+                                               "postgres://")):
+        return PostgresOperationStore(path_or_dsn)
+    return OperationStore(path_or_dsn or ":memory:")

@@ -70,13 +70,27 @@ class OpRecord:
 
 class OperationStore:
     """Thread-safe durable op records + a generic KV namespace for service
-    state (VM registry, channels, graphs)."""
+    state (VM registry, channels, graphs).
+
+    SQL goes through :meth:`_execute` with sqlite's ``?`` placeholders and
+    ``IS ?`` null-safe comparisons as the canonical dialect; a second
+    backend (``durable/pg_store.py`` — the reference's
+    Postgres-per-service discipline) subclasses and translates."""
+
+    #: driver exception types that signal a unique-constraint violation
+    _integrity_errors: tuple = (sqlite3.IntegrityError,)
 
     def __init__(self, path: str = ":memory:"):
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.executescript(_SCHEMA)
         self._lock = threading.RLock()
+
+    def _execute(self, sql: str, params: tuple = ()):
+        """Run one statement (caller holds ``self._lock``). Subclasses
+        translate the dialect and add the serialization-failure retry
+        discipline (``DbHelper.withRetries`` parity) here."""
+        return self._conn.execute(sql, params)
 
     def close(self) -> None:
         with self._lock:
@@ -92,27 +106,47 @@ class OperationStore:
         now = time.time()
         with self._lock:
             if idempotency_key is not None:
-                row = self._conn.execute(
+                row = self._execute(
                     "SELECT id FROM operations WHERE idempotency_key = ?",
                     (idempotency_key,),
                 ).fetchone()
                 if row is not None:
                     return self.load(row[0])
-            self._conn.execute(
-                "INSERT INTO operations (id, kind, status, step, state, "
-                "idempotency_key, deadline, created_at, updated_at) "
-                "VALUES (?, ?, ?, 0, ?, ?, ?, ?, ?)",
-                (op_id, kind, RUNNING, json.dumps(state), idempotency_key,
-                 deadline, now, now),
-            )
+            try:
+                self._execute(
+                    "INSERT INTO operations (id, kind, status, step, state, "
+                    "idempotency_key, deadline, created_at, updated_at) "
+                    "VALUES (?, ?, ?, 0, ?, ?, ?, ?, ?)",
+                    (op_id, kind, RUNNING, json.dumps(state),
+                     idempotency_key, deadline, now, now),
+                )
+            except self._integrity_errors:
+                # two PLANES raced the same idempotency key (possible on a
+                # shared server backend; the in-process lock already
+                # serializes threads) — the winner's record is the answer
+                self._rollback()
+                if idempotency_key is not None:
+                    row = self._execute(
+                        "SELECT id FROM operations WHERE idempotency_key = ?",
+                        (idempotency_key,),
+                    ).fetchone()
+                    if row is not None:
+                        return self.load(row[0])
+                raise
             self._conn.commit()
         return self.load(op_id)
+
+    def _rollback(self) -> None:
+        try:
+            self._conn.rollback()
+        except Exception:  # noqa: BLE001 — autocommit backends have no txn
+            pass
 
     def find_by_idempotency_key(self, key: str) -> Optional[OpRecord]:
         """Lookup without create — lets callers probe a legacy key
         namespace (pre-scoping records) before writing a new record."""
         with self._lock:
-            row = self._conn.execute(
+            row = self._execute(
                 "SELECT id FROM operations WHERE idempotency_key = ?",
                 (key,),
             ).fetchone()
@@ -120,7 +154,7 @@ class OperationStore:
 
     def load(self, op_id: str) -> OpRecord:
         with self._lock:
-            row = self._conn.execute(
+            row = self._execute(
                 "SELECT id, kind, status, step, state, result, error, "
                 "idempotency_key, deadline FROM operations WHERE id = ?",
                 (op_id,),
@@ -138,7 +172,7 @@ class OperationStore:
         """One transaction per completed step — the crash-safety contract of
         ``OperationRunnerBase.execute`` (``OperationRunnerBase.java:47-90``)."""
         with self._lock:
-            self._conn.execute(
+            self._execute(
                 "UPDATE operations SET state = ?, step = ?, updated_at = ? "
                 "WHERE id = ? AND status = ?",
                 (json.dumps(state), step, time.time(), op_id, RUNNING),
@@ -159,7 +193,7 @@ class OperationStore:
             sql += " AND deadline IS ?"
             params.append(if_deadline)
         with self._lock:
-            cur = self._conn.execute(sql, params)
+            cur = self._execute(sql, params)
             self._conn.commit()
             return cur.rowcount == 1
 
@@ -175,7 +209,7 @@ class OperationStore:
             sql += " AND deadline IS ?"
             params.append(if_deadline)
         with self._lock:
-            cur = self._conn.execute(sql, params)
+            cur = self._execute(sql, params)
             self._conn.commit()
             return cur.rowcount == 1
 
@@ -186,7 +220,7 @@ class OperationStore:
         exactly one contender wins. Returns True when this caller now owns
         the op."""
         with self._lock:
-            cur = self._conn.execute(
+            cur = self._execute(
                 "UPDATE operations SET deadline = ?, updated_at = ? "
                 "WHERE id = ? AND status = ? AND deadline IS ?",
                 (new_deadline, time.time(), op_id, RUNNING, old_deadline),
@@ -200,7 +234,7 @@ class OperationStore:
         dedup rows); returns rows deleted."""
         cutoff = time.time() - older_than_s
         with self._lock:
-            cur = self._conn.execute(
+            cur = self._execute(
                 "DELETE FROM operations WHERE kind LIKE ? "
                 "AND status IN (?, ?) AND updated_at < ?",
                 (kind_prefix + "%", DONE, FAILED, cutoff),
@@ -210,7 +244,7 @@ class OperationStore:
 
     def running_ops(self) -> List[OpRecord]:
         with self._lock:
-            rows = self._conn.execute(
+            rows = self._execute(
                 "SELECT id FROM operations WHERE status = ? ORDER BY created_at",
                 (RUNNING,),
             ).fetchall()
@@ -220,7 +254,7 @@ class OperationStore:
 
     def kv_put(self, ns: str, key: str, value: Any) -> None:
         with self._lock:
-            self._conn.execute(
+            self._execute(
                 "INSERT INTO kv (ns, k, v) VALUES (?, ?, ?) "
                 "ON CONFLICT(ns, k) DO UPDATE SET v = excluded.v",
                 (ns, key, json.dumps(value)),
@@ -229,19 +263,19 @@ class OperationStore:
 
     def kv_get(self, ns: str, key: str, default: Any = None) -> Any:
         with self._lock:
-            row = self._conn.execute(
+            row = self._execute(
                 "SELECT v FROM kv WHERE ns = ? AND k = ?", (ns, key)
             ).fetchone()
         return json.loads(row[0]) if row else default
 
     def kv_del(self, ns: str, key: str) -> None:
         with self._lock:
-            self._conn.execute("DELETE FROM kv WHERE ns = ? AND k = ?", (ns, key))
+            self._execute("DELETE FROM kv WHERE ns = ? AND k = ?", (ns, key))
             self._conn.commit()
 
     def kv_list(self, ns: str) -> Dict[str, Any]:
         with self._lock:
-            rows = self._conn.execute(
+            rows = self._execute(
                 "SELECT k, v FROM kv WHERE ns = ?", (ns,)
             ).fetchall()
         return {k: json.loads(v) for k, v in rows}
@@ -257,20 +291,22 @@ class OperationStore:
         """Acquire if free, expired, or already ours. Returns ownership."""
         now = time.time()
         with self._lock:
-            cur = self._conn.execute(
+            cur = self._execute(
                 "UPDATE leases SET owner = ?, expires_at = ? "
                 "WHERE name = ? AND (owner = ? OR expires_at < ?)",
                 (owner, now + ttl_s, name, owner, now),
             )
             if cur.rowcount == 0:
                 try:
-                    self._conn.execute(
+                    self._execute(
                         "INSERT INTO leases (name, owner, expires_at) "
                         "VALUES (?, ?, ?)",
                         (name, owner, now + ttl_s),
                     )
-                except sqlite3.IntegrityError:
-                    self._conn.commit()
+                except self._integrity_errors:
+                    # a failed INSERT poisons a server-side transaction;
+                    # roll back before answering (sqlite tolerates either)
+                    self._rollback()
                     return False          # raced another acquirer; it won
             self._conn.commit()
             return True
@@ -278,7 +314,7 @@ class OperationStore:
     def renew_lease(self, name: str, owner: str, ttl_s: float) -> bool:
         """Extend our lease; False means it was lost (expired + taken)."""
         with self._lock:
-            cur = self._conn.execute(
+            cur = self._execute(
                 "UPDATE leases SET expires_at = ? "
                 "WHERE name = ? AND owner = ?",
                 (time.time() + ttl_s, name, owner),
@@ -288,7 +324,7 @@ class OperationStore:
 
     def release_lease(self, name: str, owner: str) -> None:
         with self._lock:
-            self._conn.execute(
+            self._execute(
                 "DELETE FROM leases WHERE name = ? AND owner = ?",
                 (name, owner),
             )
@@ -297,7 +333,7 @@ class OperationStore:
     def lease_holder(self, name: str) -> Optional[Tuple[str, float]]:
         """(owner, expires_at) of a live lease, or None."""
         with self._lock:
-            row = self._conn.execute(
+            row = self._execute(
                 "SELECT owner, expires_at FROM leases WHERE name = ?",
                 (name,),
             ).fetchone()

@@ -145,6 +145,10 @@ class RMSNorm(nn.Module):
 
 class Attention(nn.Module):
     cfg: LlamaConfig
+    #: mesh for activation anchors (dense path only; None inside the
+    #: pipeline's manual region, where constraints on the full mesh are
+    #: not expressible — LlamaStage manages its own boundaries)
+    anchor_mesh: Any = None
 
     @nn.compact
     def __call__(self, x, positions, mesh=None, segments=None):
@@ -161,6 +165,11 @@ class Attention(nn.Module):
         q = dense((h, d), "q_proj", ("embed", "heads", "head_dim"))(x)
         k = dense((kv, d), "k_proj", ("embed", "kv", "head_dim"))(x)
         v = dense((kv, d), "v_proj", ("embed", "kv", "head_dim"))(x)
+        # in-layer anchors (see Mlp): keep batch sharded through the
+        # projections so fsdp gathers weights, not [D,T,B] activations
+        q = _anchor(q, self.anchor_mesh, "batch", "seq", "act_heads", None)
+        k = _anchor(k, self.anchor_mesh, "batch", "seq", None, None)
+        v = _anchor(v, self.anchor_mesh, "batch", "seq", None, None)
 
         if cfg.decode:
             return self._decode_step(q, k, v, b)
@@ -194,18 +203,19 @@ class Attention(nn.Module):
             # (init, smoke shapes) fall through to the dense path
             from lzy_tpu.ops.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=True,
-                                  segment_ids=segments)
+            out = _batch_sharded_attention(
+                flash_attention, q, k, v, segments, self.anchor_mesh)
         else:
             # portable fallback: chunked online-softmax attention — O(T·block)
             # activations, never the T×T score matrix (lzy_tpu/ops/attention)
             from lzy_tpu.ops.attention import chunked_attention
 
-            out = chunked_attention(q, k, v, causal=True,
-                                    segment_ids=segments)
+            out = _batch_sharded_attention(
+                chunked_attention, q, k, v, segments, self.anchor_mesh)
 
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * d)
-        return self._o_proj(out)
+        return _anchor(self._o_proj(out), self.anchor_mesh,
+                       "batch", "seq", "act_embed")
 
     def _o_proj(self, out):
         cfg = self.cfg
@@ -266,6 +276,7 @@ class Attention(nn.Module):
 
 class Mlp(nn.Module):
     cfg: LlamaConfig
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -280,11 +291,16 @@ class Mlp(nn.Module):
                 ),
             )
 
+        # in-layer anchors: with fsdp-sharded kernels the partitioner
+        # otherwise re-shards the hidden activations onto the model dim
+        # and all-gathers [D,T,B] per matmul — 14 gathers/layer, 150 GB
+        # per step at flagship v5e-16 scale (AOT_ANALYSIS); anchoring the
+        # intermediates keeps batch sharded so only WEIGHTS are gathered
         gate = dense(cfg.d_ff, "gate_proj", ("embed", "mlp"))(x)
         up = dense(cfg.d_ff, "up_proj", ("embed", "mlp"))(x)
-        return dense(cfg.d_model, "down_proj", ("mlp", "embed"))(
-            nn.silu(gate) * up
-        )
+        h = _anchor(nn.silu(gate) * up, self.mesh, "batch", "seq", "act_mlp")
+        out = dense(cfg.d_model, "down_proj", ("mlp", "embed"))(h)
+        return _anchor(out, self.mesh, "batch", "seq", "act_embed")
 
 
 class DecoderLayer(nn.Module):
@@ -296,11 +312,15 @@ class DecoderLayer(nn.Module):
 
     cfg: LlamaConfig
     mesh: Any = None
+    #: dense-path activation anchors; False inside the pipeline's manual
+    #: region (LlamaStage), where full-mesh constraints don't apply
+    anchor: bool = False
 
     @nn.compact
     def __call__(self, x, positions, segments=None):
         cfg, mesh = self.cfg, self.mesh
-        x = x + Attention(cfg, name="attn")(
+        amesh = mesh if self.anchor else None
+        x = x + Attention(cfg, anchor_mesh=amesh, name="attn")(
             RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attn_norm")(x),
             positions, mesh, segments,
         )
@@ -315,7 +335,46 @@ class DecoderLayer(nn.Module):
             ), name="moe")(h)
             self.sow("losses", "moe_aux", aux)
             return x + moe_out
-        return x + Mlp(cfg, name="mlp")(h)
+        return x + Mlp(cfg, mesh=amesh, name="mlp")(h)
+
+
+def _batch_sharded_attention(fn, q, k, v, segments, mesh):
+    """Run a non-ring attention body per batch/head shard via shard_map.
+
+    The SPMD partitioner cannot see inside the Pallas flash custom call
+    (and shards the chunked-attention while loop poorly): without this
+    wrapper it REPLICATES the attention operands — at flagship v5e-16
+    scale that was 280 all-gathers / 150 GB per step of [B*H, T, D]
+    tensors, every chip then computing attention for the full global
+    batch (tpu_evidence/AOT_ANALYSIS.md, op_name attn/while/body).
+    Attention is independent per (batch, head), so mapping those dims is
+    exact. Dense path only (``anchor_mesh``); the ring/Ulysses paths and
+    the pipeline's manual region do their own thing."""
+    if mesh is None or mesh.size == 1:
+        return fn(q, k, v, causal=True, segment_ids=segments)
+    # shard_map demands exact divisibility where GSPMD would pad; odd
+    # batch/head counts (eval smoke runs, unusual head configs) keep the
+    # old replicated path — correct, just not bandwidth-optimal
+    bs = mesh.shape["dp"] * mesh.shape["fsdp"]
+    hs = mesh.shape["tp"]
+    if q.shape[0] % bs or q.shape[1] % hs:
+        return fn(q, k, v, causal=True, segment_ids=segments)
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    qkv_spec = P(("dp", "fsdp"), "tp", None, None)   # [B, H, T, D]
+    if segments is None:
+        return shard_map(
+            lambda a, b, c: fn(a, b, c, causal=True),
+            mesh=mesh, in_specs=(qkv_spec,) * 3, out_specs=qkv_spec,
+            check_vma=False,
+        )(q, k, v)
+    return shard_map(
+        lambda a, b, c, s: fn(a, b, c, causal=True, segment_ids=s),
+        mesh=mesh,
+        in_specs=(qkv_spec,) * 3 + (P(("dp", "fsdp"), None),),
+        out_specs=qkv_spec, check_vma=False,
+    )(q, k, v, segments)
 
 
 def _anchor(x, mesh, *logical_axes):
@@ -387,8 +446,15 @@ class Llama(nn.Module):
                 policy=_remat_policy(cfg.remat_policy),
             )
         for i in range(cfg.n_layers):
-            x = layer(cfg, mesh=mesh, name=f"layer_{i}")(
+            # anchor=True: in-layer activation anchors (Attention/Mlp) —
+            # one anchor at the embed is not enough; at flagship scale
+            # the partitioner re-shards activations onto the model dim
+            # mid-layer and all-gathers [D,T,B] for every matmul (280
+            # gathers / 150 GB per step on v5e-16, AOT_ANALYSIS.md). The
+            # pp path (LlamaStage) manages its own boundaries.
+            x = layer(cfg, mesh=mesh, anchor=True, name=f"layer_{i}")(
                 x, positions, segments)
+            x = _anchor(x, mesh, "batch", "seq", "act_embed")
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="final_norm")(x)
         if cfg.tie_embeddings:
             head = emb

@@ -38,6 +38,8 @@ DEFAULT_RULES: Rules = {
     # (the 377 MB pred gathers tests/test_aot_topology.py pins)
     "act_embed": "tp",
     "act_vocab": "tp",
+    "act_mlp": "tp",
+    "act_heads": "tp",
     "stage": "pp",
     # conv models
     "conv_spatial": None,

@@ -264,6 +264,8 @@ class ControlPlaneServer:
                 idempotency_key=p.get("idempotency_key"))},
             "GraphStatus": lambda p: svc.graph_status(
                 p["execution_id"], p["graph_op_id"], token=p.get("token")),
+            "GraphDot": lambda p: {"dot": svc.graph_dot(
+                p["execution_id"], p["graph_op_id"], token=p.get("token"))},
             "StopGraph": lambda p: svc.stop_graph(
                 p["execution_id"], p["graph_op_id"], token=p.get("token"),
                 idempotency_key=p.get("idempotency_key")),
@@ -566,6 +568,13 @@ class RpcWorkflowClient:
             "execution_id": execution_id, "graph_op_id": graph_op_id,
             "token": token,
         }, retry=True)
+
+    def graph_dot(self, execution_id, graph_op_id, *, token=None) -> str:
+        """Dataflow DAG as graphviz dot (DataFlowGraph.java parity)."""
+        return self._client.call("GraphDot", {
+            "execution_id": execution_id, "graph_op_id": graph_op_id,
+            "token": token,
+        }, retry=True)["dot"]
 
     def stop_graph(self, execution_id, graph_op_id, *, token=None):
         self._client.call("StopGraph", {

@@ -1,24 +1,33 @@
 """Web console: HTML + JSON status surface over a deployment.
 
 Counterpart of the reference's ``lzy/site`` service + React ``frontend/``
-(task/execution listings). Redesigned dependency-free: a stdlib threaded
-HTTP server rendering server-side HTML from the shared status views
-(``lzy_tpu/service/status.py``), plus a JSON API and the Prometheus
-metrics exposition — enough for an operator dashboard on any deployment,
-including one running in a TPU pod, without shipping a JS toolchain.
+(task/execution listings, GitHub-OAuth login, key management, and the
+dataflow-graph dot output of ``DataFlowGraph.java:20-268``). Redesigned
+dependency-free: a stdlib threaded HTTP server rendering server-side HTML
+from the shared status views (``lzy_tpu/service/status.py``), plus a JSON
+API and the Prometheus metrics exposition — enough for an operator
+dashboard on any deployment, including one running in a TPU pod, without
+shipping a JS toolchain.
 
-Routes: ``/`` (overview, auto-refresh), ``/api/<view>`` (JSON),
-``/healthz``, ``/metrics`` (Prometheus text).
+Routes: ``/`` (overview, auto-refresh), ``/login`` + ``/logout`` (session
+cookie over token exchange), ``/keys`` (key-management forms),
+``/graph/<graph-op-id>`` (dataflow DAG as inline SVG) and
+``/graph/<graph-op-id>.dot`` (graphviz, reference parity),
+``/api/<view>`` (JSON), ``/healthz``, ``/metrics`` (Prometheus text).
 
-With ``iam=`` wired, the console also covers the reference site's
-``Auth``/``Keys``/``Tasks`` routes (``lzy/site/.../routes/{Auth,Keys,
-Tasks}.java``) in token form — no OAuth dance, the bearer token IS the
-login: ``GET /api/tasks`` (caller's executions + graphs),
-``GET /api/keys`` (own subject; all for INTERNAL),
-``POST /api/keys/rotate`` (self-service credential rotation — the analog
-of a user replacing their key), and INTERNAL-only ``POST /api/keys`` /
-``DELETE /api/keys/<id>`` (operator subject management). Tokens ride
-``Authorization: Bearer`` (query ``?token=`` accepted for curl).
+Auth model with ``iam=`` wired (site Auth/Keys/Tasks parity):
+
+- **login** is a token exchange: POST the bearer token once at ``/login``
+  and the console sets an HttpOnly session cookie — no credential in any
+  URL from then on (query-string tokens are NOT accepted: they leak into
+  proxy/access logs and shell history). API callers keep sending
+  ``Authorization: Bearer``.
+- every data route authenticates; USER-scoped views (executions, graphs,
+  tasks) show the caller's own rows, infrastructure views (vms,
+  operations, disks, pools) and subject management need the INTERNAL
+  role. ``/healthz`` and ``/metrics`` stay open (operational plumbing).
+- without ``iam=`` the console is the single-tenant operator tool it
+  always was: loopback bind, no auth, expose deliberately.
 """
 
 from __future__ import annotations
@@ -37,6 +46,12 @@ _LOG = get_logger(__name__)
 
 _COLUMNS = status_views.COLUMNS
 
+#: views a USER token may read about itself; everything else is INTERNAL
+_USER_VIEWS = set(status_views.USER_SCOPED_VIEWS)
+
+_SESSION_COOKIE = "lzy_session"
+_SESSION_MAX_AGE_S = 8 * 3600
+
 _STYLE = """
 body { font-family: system-ui, sans-serif; margin: 2rem; color: #1a1a2e; }
 h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 1.6rem; }
@@ -48,13 +63,19 @@ th { background: #f4f4f8; }
 .status-FAILED, .status-ABORTED { color: #c0261e; font-weight: 600; }
 .status-DONE, .status-COMPLETED, .status-FINISHED { color: #555; }
 .empty { color: #888; font-style: italic; }
+nav { margin-bottom: 1rem; } nav a { margin-right: 1rem; }
+form.inline { display: inline; }
+input[type=text], input[type=password] { padding: 0.25rem 0.4rem; }
+button { padding: 0.25rem 0.8rem; }
+.note { color: #666; font-size: 0.8rem; }
 """
 
 
 _fmt = status_views.fmt_cell
 
 
-def _render_table(view: str, rows: List[Dict[str, Any]]) -> str:
+def _render_table(view: str, rows: List[Dict[str, Any]],
+                  link_fmt: Optional[str] = None) -> str:
     cols = _COLUMNS[view]
     if not rows:
         return f'<p class="empty">no {view}</p>'
@@ -65,10 +86,26 @@ def _render_table(view: str, rows: List[Dict[str, Any]]) -> str:
         for c in cols:
             v = _fmt(c, row.get(c))
             css = f' class="status-{html.escape(v)}"' if c == "status" else ""
-            cells.append(f"<td{css}>{html.escape(v)}</td>")
+            cell = html.escape(v)
+            if link_fmt and c == "id":
+                href = html.escape(link_fmt.format(id=row.get("id", "")))
+                cell = f'<a href="{href}">{cell}</a>'
+            cells.append(f"<td{css}>{cell}</td>")
         body.append("<tr>" + "".join(cells) + "</tr>")
     return (f"<table><thead><tr>{head}</tr></thead>"
             f"<tbody>{''.join(body)}</tbody></table>")
+
+
+def _page(title: str, body: str, refresh_s: Optional[int] = None,
+          nav: bool = True) -> str:
+    meta = (f'<meta http-equiv="refresh" content="{refresh_s}">'
+            if refresh_s else "")
+    navbar = ('<nav><a href="/">overview</a><a href="/keys">keys</a>'
+              '<a href="/metrics">metrics</a><a href="/logout">logout</a>'
+              "</nav>") if nav else ""
+    return (f"<!doctype html><html><head>{meta}<title>{html.escape(title)}"
+            f"</title><style>{_STYLE}</style></head><body>{navbar}"
+            f"{body}</body></html>")
 
 
 class StatusConsole:
@@ -76,12 +113,10 @@ class StatusConsole:
 
     def __init__(self, store, port: int = 0, bind_host: str = "127.0.0.1",
                  refresh_s: int = 5, iam=None, mutation_guard=None):
-        """The status pages are UNAUTHENTICATED (an operator tool for the
-        control-plane host), so it binds loopback by default; expose it
-        network-wide only deliberately (``bind_host="0.0.0.0"``) behind
-        your own auth proxy — the token-scoped alternative is the
-        GetStatus RPC. The keys/tasks routes need ``iam`` and a bearer
-        token regardless of bind address."""
+        """Without ``iam`` the pages are UNAUTHENTICATED (an operator tool
+        for the control-plane host): loopback bind by default, expose only
+        deliberately. With ``iam`` every data route needs a bearer token
+        or the ``/login`` session cookie."""
         self._store = store
         self._iam = iam
         # optional callable run before every MUTATING route; returning a
@@ -124,32 +159,54 @@ class StatusConsole:
                                         name="status-console", daemon=True)
         self._thread.start()
 
-    # -- routing ---------------------------------------------------------------
-
-    # -- auth helpers (iam-gated routes) ---------------------------------------
+    # -- auth helpers ----------------------------------------------------------
 
     def _bearer(self, req: BaseHTTPRequestHandler) -> Optional[str]:
+        """Header first, session cookie second. NEVER the query string —
+        tokens in URLs leak through proxy/access logs and history
+        (ADVICE r4)."""
         auth = req.headers.get("Authorization", "")
         if auth.startswith("Bearer "):
             return auth[len("Bearer "):].strip()
-        from urllib.parse import parse_qs, urlparse
+        from http.cookies import SimpleCookie
 
-        qs = parse_qs(urlparse(req.path).query)
-        return (qs.get("token") or [None])[0]
+        cookies = SimpleCookie(req.headers.get("Cookie", ""))
+        morsel = cookies.get(_SESSION_COOKIE)
+        return morsel.value if morsel is not None else None
 
-    def _subject(self, req: BaseHTTPRequestHandler):
-        """Authenticated subject or None-with-response-sent."""
+    def _subject(self, req: BaseHTTPRequestHandler, *,
+                 page: bool = False):
+        """Authenticated subject, or None with a response already sent
+        (401 JSON for API callers, redirect to /login for pages)."""
         if self._iam is None:
             self._json(req, 404, {"error": "iam not enabled on this plane"})
             return None
         try:
             return self._iam.authenticate(self._bearer(req))
         except Exception as e:  # noqa: BLE001 — surface as 401, not a 500
-            self._json(req, 401, {"error": str(e)})
+            if page:
+                self._redirect(req, "/login")
+            else:
+                self._json(req, 401, {"error": str(e)})
             return None
+
+    def _scope(self, subject) -> Optional[str]:
+        from lzy_tpu.iam import INTERNAL
+
+        return None if subject is None or subject.role == INTERNAL \
+            else subject.id
 
     def _json(self, req, code: int, doc: Dict[str, Any]) -> None:
         self._send(req, code, "application/json", json.dumps(doc).encode())
+
+    def _redirect(self, req, location: str,
+                  set_cookie: Optional[str] = None) -> None:
+        req.send_response(303)
+        req.send_header("Location", location)
+        if set_cookie is not None:
+            req.send_header("Set-Cookie", set_cookie)
+        req.send_header("Content-Length", "0")
+        req.end_headers()
 
     def _subject_docs(self, only: Optional[str] = None) -> List[Dict[str, Any]]:
         out = []
@@ -161,22 +218,71 @@ class StatusConsole:
                 continue
             out.append({"id": sid, "kind": doc.get("kind"),
                         "role": doc.get("role"),
-                        "generation": doc.get("gen", 0)})
+                        "generation": doc.get("gen", 0),
+                        "public_keys": sorted(doc.get("keys", {}))})
         return out
+
+    def _body(self, req) -> Dict[str, Any]:
+        """JSON or HTML-form body as a dict (forms post urlencoded)."""
+        length = int(req.headers.get("Content-Length") or 0)
+        raw = req.rfile.read(length) if length else b""
+        # sniff JSON first: API clients (urllib included) often omit or
+        # mislabel Content-Type; a non-dict JSON body is still an error,
+        # only a non-JSON body falls through to form decoding
+        try:
+            doc = json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            from urllib.parse import parse_qs
+
+            return {k: v[0] for k, v in parse_qs(raw.decode()).items()}
+        if not isinstance(doc, dict):
+            raise ValueError("body must be a JSON object")
+        return doc
+
+    @staticmethod
+    def _wants_html(req) -> bool:
+        # browsers send Accept: text/html on form posts; API clients
+        # don't (urllib labels JSON posts as form-encoded, so the
+        # Content-Type is useless for this distinction)
+        return "text/html" in req.headers.get("Accept", "")
+
+    # -- GET routing -----------------------------------------------------------
 
     def _route(self, req: BaseHTTPRequestHandler) -> None:
         path = req.path.split("?", 1)[0].rstrip("/") or "/"
-        if path == "/":
+        if path == "/login":
             self._send(req, 200, "text/html; charset=utf-8",
-                       self._render_home().encode())
+                       self._render_login().encode())
+            return
+        if path == "/logout":
+            self._redirect(
+                req, "/login",
+                set_cookie=f"{_SESSION_COOKIE}=; Path=/; Max-Age=0")
+            return
+        if path == "/":
+            subject = None
+            if self._iam is not None:
+                subject = self._subject(req, page=True)
+                if subject is None:
+                    return
+            self._send(req, 200, "text/html; charset=utf-8",
+                       self._render_home(subject).encode())
+        elif path == "/keys":
+            subject = None
+            if self._iam is not None:
+                subject = self._subject(req, page=True)
+                if subject is None:
+                    return
+            self._send(req, 200, "text/html; charset=utf-8",
+                       self._render_keys(subject).encode())
+        elif path.startswith("/graph/"):
+            self._route_graph(req, path[len("/graph/"):])
         elif path == "/api/tasks":
             # Tasks.java semantics: the CALLER's work, scoped by token
             subject = self._subject(req)
             if subject is None:
                 return
-            from lzy_tpu.iam import INTERNAL
-
-            user = None if subject.role == INTERNAL else subject.id
+            user = self._scope(subject)
             self._json(req, 200, {
                 "executions": status_views.collect(
                     self._store, "executions", user=user),
@@ -189,20 +295,30 @@ class StatusConsole:
             subject = self._subject(req)
             if subject is None:
                 return
-            from lzy_tpu.iam import INTERNAL
-
-            only = None if subject.role == INTERNAL else subject.id
+            only = self._scope(subject)
             self._json(req, 200, {"subjects": self._subject_docs(only)})
         elif path.startswith("/api/"):
             view = path[len("/api/"):]
+            user = None
+            if self._iam is not None:
+                # the generic views are authenticated too: user-scoped
+                # ones per caller, infrastructure ones INTERNAL-only (an
+                # unauthenticated /api/executions next to a scoped
+                # /api/tasks would be a trivial bypass — ADVICE r4)
+                subject = self._subject(req)
+                if subject is None:
+                    return
+                user = self._scope(subject)
+                if user is not None and view not in _USER_VIEWS:
+                    self._json(req, 403, {
+                        "error": f"view {view!r} needs the INTERNAL role"})
+                    return
             try:
-                rows = status_views.collect(self._store, view)
+                rows = status_views.collect(self._store, view, user=user)
             except KeyError as e:
-                self._send(req, 404, "application/json",
-                           json.dumps({"error": str(e)}).encode())
+                self._json(req, 404, {"error": str(e)})
                 return
-            self._send(req, 200, "application/json",
-                       json.dumps({view: rows}).encode())
+            self._json(req, 200, {view: rows})
         elif path == "/healthz":
             self._send(req, 200, "text/plain", b"ok")
         elif path == "/metrics":
@@ -211,17 +327,68 @@ class StatusConsole:
         else:
             self._send(req, 404, "text/plain", b"not found")
 
-    def _route_mutate(self, req: BaseHTTPRequestHandler) -> None:
-        """POST/DELETE key management (reference Keys.java + site admin).
+    def _route_graph(self, req, rest: str) -> None:
+        """/graph/<op-id>[.dot] — the execution's dataflow DAG
+        (DataFlowGraph.java parity: dot out; plus inline SVG)."""
+        from lzy_tpu.service import graphviz
 
+        want_dot = rest.endswith(".dot")
+        graph_op_id = rest[:-len(".dot")] if want_dot else rest
+        user = None
+        if self._iam is not None:
+            subject = self._subject(req, page=not want_dot)
+            if subject is None:
+                return
+            user = self._scope(subject)
+        state = graphviz.load_graph_state(self._store, graph_op_id)
+        if state is None:
+            self._json(req, 404, {"error": f"unknown graph {graph_op_id!r}"})
+            return
+        if user is not None and state.get("user") != user:
+            self._json(req, 403, {"error": "not your graph"})
+            return
+        if want_dot:
+            self._send(req, 200, "text/vnd.graphviz; charset=utf-8",
+                       graphviz.graph_dot(state).encode())
+            return
+        tasks = state.get("tasks", {})
+        done = sum(1 for t in tasks.values()
+                   if t.get("status") == "COMPLETED")
+        body = (
+            f"<h1>graph {html.escape(graph_op_id)}</h1>"
+            f"<p>status {html.escape(state.get('_status', '?'))} · "
+            f"{done}/{len(tasks)} tasks done · "
+            f'<a href="/graph/{html.escape(graph_op_id)}.dot">dot</a></p>'
+            + graphviz.graph_svg(state)
+        )
+        self._send(req, 200, "text/html; charset=utf-8",
+                   _page(f"graph {graph_op_id}", body,
+                         refresh_s=self._refresh_s,
+                         nav=self._iam is not None).encode())
+
+    # -- POST/DELETE routing ---------------------------------------------------
+
+    def _route_mutate(self, req: BaseHTTPRequestHandler) -> None:
+        """Login + key management (reference Auth/Keys routes).
+
+        - ``POST /login`` {"token"}: token exchange — validates and sets
+          the HttpOnly session cookie (the documented login flow; no
+          OAuth broker exists in a zero-egress deployment, so the
+          exchange IS the dance).
         - ``POST /api/keys/rotate``: self-service — invalidate every
-          outstanding token for the CALLER and return a fresh one (the
-          analog of a user replacing their key).
-        - ``POST /api/keys`` {"subject_id", "role"?, "kind"?}: create a
-          subject, returning its bearer token (INTERNAL only).
+          outstanding token for the CALLER; returns a fresh one (HMAC
+          subjects) or the new generation (asymmetric subjects re-sign).
+        - ``POST /api/keys`` {"subject_id", "role"?, "kind"?,
+          "public_key"?}: create a subject (INTERNAL only); with
+          ``public_key`` the subject is asymmetric-only and no token is
+          returned.
         - ``DELETE /api/keys/<id>``: remove a subject (INTERNAL only).
+        Forms (urlencoded) get redirects; JSON callers get JSON.
         """
         path = req.path.split("?", 1)[0].rstrip("/")
+        if path == "/login":
+            self._login(req)
+            return
         if self._mutation_guard is not None:
             refusal = self._mutation_guard()
             if refusal:
@@ -232,23 +399,47 @@ class StatusConsole:
             return
         from lzy_tpu.iam import INTERNAL
 
+        form = self._wants_html(req)
         if req.command == "POST" and path == "/api/keys/rotate":
             token = self._iam.rotate_subject(subject.id)
-            self._json(req, 200, {"subject_id": subject.id, "token": token})
+            if form:
+                # the rotation just invalidated the session cookie too —
+                # redirecting would lock the user out with no way to ever
+                # see the fresh token; show it ONCE instead
+                gen = self._iam.subject_generation(subject.id)
+                if token is not None:
+                    detail = (
+                        "<p>Your new bearer token (shown once — store it "
+                        f"now):</p><p><code>{html.escape(token)}</code></p>")
+                else:
+                    detail = (
+                        f"<p>Asymmetric subject: sign fresh tokens with "
+                        f"your private key at generation <b>{gen}</b>.</p>")
+                body = ("<h1>credential rotated</h1>"
+                        "<p>Every outstanding token (including this "
+                        "browser session) is now invalid.</p>" + detail +
+                        '<p><a href="/login">sign in again</a></p>')
+                self._send(req, 200, "text/html; charset=utf-8",
+                           _page("rotated", body, nav=False).encode())
+                return
+            doc = {"subject_id": subject.id, "token": token}
+            if token is None:
+                doc["generation"] = self._iam.subject_generation(subject.id)
+                doc["note"] = ("asymmetric subject: sign fresh tokens with "
+                               "your private key at this generation")
+            self._json(req, 200, doc)
             return
         if subject.role != INTERNAL:
             self._json(req, 403, {"error": "subject management needs the "
                                            "INTERNAL role"})
             return
         if req.command == "POST" and path == "/api/keys":
-            length = int(req.headers.get("Content-Length") or 0)
             try:
-                doc = json.loads(req.rfile.read(length) or b"{}")
+                doc = self._body(req)
                 subject_id = doc["subject_id"]
             except (ValueError, KeyError, TypeError):
                 self._json(req, 400,
-                           {"error": "body must be a JSON object with "
-                                     "subject_id"})
+                           {"error": "body must carry subject_id"})
                 return
             if self._subject_docs(subject_id):
                 # re-creating would silently reset the token generation to
@@ -259,22 +450,63 @@ class StatusConsole:
                 return
             try:
                 token = self._iam.create_subject(
-                    subject_id, kind=doc.get("kind", "USER"),
-                    role=doc.get("role", "OWNER"))
+                    subject_id, kind=doc.get("kind") or "USER",
+                    role=doc.get("role") or "OWNER",
+                    public_key=doc.get("public_key") or None)
             except ValueError as e:
                 self._json(req, 400, {"error": str(e)})
                 return
-            self._json(req, 201, {"subject_id": subject_id, "token": token})
-        elif req.command == "DELETE" and path.startswith("/api/keys/"):
-            subject_id = path[len("/api/keys/"):]
-            if not self._subject_docs(subject_id):
-                self._json(req, 404,
-                           {"error": f"unknown subject {subject_id!r}"})
+            if form:
+                self._redirect(req, "/keys")
                 return
-            self._iam.remove_subject(subject_id)
-            self._json(req, 200, {"removed": subject_id})
+            self._json(req, 201, {"subject_id": subject_id, "token": token})
+        elif req.command == "POST" and path.startswith("/api/keys/") \
+                and path.endswith("/delete"):
+            # HTML forms cannot DELETE; POST .../delete is the form path
+            self._delete_subject(req, path[len("/api/keys/"):-len("/delete")],
+                                 redirect=True)
+        elif req.command == "DELETE" and path.startswith("/api/keys/"):
+            self._delete_subject(req, path[len("/api/keys/"):],
+                                 redirect=False)
         else:
             self._json(req, 404, {"error": "not found"})
+
+    def _delete_subject(self, req, subject_id: str, *, redirect: bool) -> None:
+        if not self._subject_docs(subject_id):
+            self._json(req, 404, {"error": f"unknown subject {subject_id!r}"})
+            return
+        self._iam.remove_subject(subject_id)
+        if redirect:
+            self._redirect(req, "/keys")
+        else:
+            self._json(req, 200, {"removed": subject_id})
+
+    def _login(self, req) -> None:
+        if self._iam is None:
+            self._json(req, 404, {"error": "iam not enabled on this plane"})
+            return
+        try:
+            token = self._body(req).get("token", "")
+            self._iam.authenticate(token)
+        except Exception as e:  # noqa: BLE001 — a failed login is a 401
+            if self._wants_html(req):
+                self._send(req, 401, "text/html; charset=utf-8",
+                           self._render_login(str(e)).encode())
+            else:
+                self._json(req, 401, {"error": str(e)})
+            return
+        cookie = (f"{_SESSION_COOKIE}={token}; Path=/; HttpOnly; "
+                  f"SameSite=Lax; Max-Age={_SESSION_MAX_AGE_S}")
+        if self._wants_html(req):
+            self._redirect(req, "/", set_cookie=cookie)
+        else:
+            req.send_response(200)
+            req.send_header("Set-Cookie", cookie)
+            body = json.dumps({"ok": True}).encode()
+            req.send_header("Content-Type", "application/json")
+            req.send_header("Content-Length", str(len(body)))
+            req.end_headers()
+            req.wfile.write(body)
 
     @staticmethod
     def _send(req: BaseHTTPRequestHandler, code: int, ctype: str,
@@ -285,22 +517,101 @@ class StatusConsole:
         req.end_headers()
         req.wfile.write(body)
 
-    def _render_home(self) -> str:
-        sections = []
-        for view in ("executions", "graphs", "vms", "operations", "disks"):
-            rows = status_views.collect(self._store, view)
-            sections.append(f"<h2>{view} ({len(rows)})</h2>"
-                            + _render_table(view, rows))
-        return (
-            "<!doctype html><html><head>"
-            f'<meta http-equiv="refresh" content="{self._refresh_s}">'
-            "<title>lzy-tpu console</title>"
-            f"<style>{_STYLE}</style></head><body>"
-            "<h1>lzy-tpu deployment</h1>"
-            + "".join(sections)
-            + '<p><a href="/metrics">metrics</a></p>'
-            "</body></html>"
+    # -- pages -----------------------------------------------------------------
+
+    def _render_login(self, error: str = "") -> str:
+        err = (f'<p class="status-FAILED">{html.escape(error)}</p>'
+               if error else "")
+        body = (
+            "<h1>lzy-tpu console</h1>"
+            "<p>Sign in by exchanging your bearer token for a session "
+            "cookie. Get a token from your operator (or mint one: "
+            "<code>python -m lzy_tpu auth create &lt;user&gt;</code>; "
+            "key-pair subjects sign their own — see docs/deployment.md)."
+            f"</p>{err}"
+            '<form method="post" action="/login" '
+            'enctype="application/x-www-form-urlencoded">'
+            '<input type="password" name="token" placeholder="bearer token" '
+            'size="48" autofocus> <button type="submit">sign in</button>'
+            "</form>"
+            '<p class="note">The token never appears in a URL; the cookie '
+            "is HttpOnly and expires in 8 h.</p>"
         )
+        return _page("sign in", body, nav=False)
+
+    def _render_keys(self, subject) -> str:
+        only = self._scope(subject) if self._iam is not None else None
+        subjects = self._subject_docs(only) if self._iam is not None else []
+        from lzy_tpu.iam import INTERNAL
+
+        is_op = subject is not None and subject.role == INTERNAL
+        rows = []
+        for s in subjects:
+            actions = ""
+            if is_op:
+                actions = (
+                    f'<form class="inline" method="post" '
+                    f'action="/api/keys/{html.escape(s["id"])}/delete" '
+                    f'enctype="application/x-www-form-urlencoded">'
+                    f"<button>delete</button></form>")
+            rows.append(
+                f"<tr><td>{html.escape(s['id'])}</td>"
+                f"<td>{html.escape(str(s['kind']))}</td>"
+                f"<td>{html.escape(str(s['role']))}</td>"
+                f"<td>{s['generation']}</td>"
+                f"<td>{html.escape(', '.join(s['public_keys']) or '—')}</td>"
+                f"<td>{actions}</td></tr>")
+        table = ("<table><thead><tr><th>subject</th><th>kind</th>"
+                 "<th>role</th><th>generation</th><th>public keys</th>"
+                 "<th></th></tr></thead><tbody>"
+                 + "".join(rows) + "</tbody></table>") if rows else \
+            '<p class="empty">no subjects</p>'
+        rotate = (
+            '<h2>rotate my credential</h2>'
+            '<form method="post" action="/api/keys/rotate" '
+            'enctype="application/x-www-form-urlencoded">'
+            "<button>rotate (invalidates all my outstanding tokens)"
+            "</button></form>"
+            '<p class="note">HMAC subjects: fetch the fresh token via '
+            "<code>POST /api/keys/rotate</code> with JSON Accept. "
+            "Key-pair subjects re-sign at the new generation.</p>")
+        create = ""
+        if is_op:
+            create = (
+                "<h2>create subject</h2>"
+                '<form method="post" action="/api/keys" '
+                'enctype="application/x-www-form-urlencoded">'
+                '<input type="text" name="subject_id" '
+                'placeholder="subject id"> '
+                '<input type="text" name="role" placeholder="OWNER"> '
+                '<input type="text" name="public_key" '
+                'placeholder="Ed25519 public key PEM (optional)" size="40"> '
+                "<button>create</button></form>"
+                '<p class="note">With a public key the subject is '
+                "asymmetric-only: the holder signs its own tokens and the "
+                "deployment can only verify.</p>")
+        return _page("keys", f"<h1>keys</h1>{table}{rotate}{create}")
+
+    def _render_home(self, subject=None) -> str:
+        user = self._scope(subject) if self._iam is not None else None
+        sections = []
+        views = ("executions", "graphs") if user is not None else \
+            ("executions", "graphs", "vms", "operations", "disks")
+        for view in views:
+            rows = status_views.collect(
+                self._store, view,
+                user=user if view in _USER_VIEWS else None)
+            link = "/graph/{id}" if view == "graphs" else None
+            sections.append(f"<h2>{view} ({len(rows)})</h2>"
+                            + _render_table(view, rows, link_fmt=link))
+        who = (f'<p class="note">signed in as '
+               f"{html.escape(subject.id)} ({html.escape(subject.role)})</p>"
+               if subject is not None else "")
+        return _page("lzy-tpu console",
+                     f"<h1>lzy-tpu deployment</h1>{who}"
+                     + "".join(sections),
+                     refresh_s=self._refresh_s,
+                     nav=self._iam is not None)
 
     @property
     def address(self) -> str:

@@ -1,0 +1,167 @@
+"""Dataflow-graph rendering: dot (reference parity) + dependency-free SVG.
+
+The reference renders each execution's dataflow graph to graphviz dot —
+operations as nodes, data links as edges
+(``lzy-service/.../dao/DataFlowGraph.java:20-268`` ``toString``/buildGraph).
+This module does the same from a graph op record's state (the
+``exec_graph`` durable op holds the full ``GraphDesc`` doc plus live
+per-task status), and additionally renders an inline SVG so the web
+console can show the DAG without a graphviz binary or a JS toolchain.
+"""
+
+from __future__ import annotations
+
+import html
+from typing import Any, Dict, List, Optional, Tuple
+
+#: task status -> fill color (dot + svg share it)
+_STATUS_FILL = {
+    "WAITING": "#e8e8ee",
+    "RUNNING": "#fff3c4",
+    "COMPLETED": "#d3f0da",
+    "FAILED": "#f6d3d1",
+}
+
+
+def _edges(graph_doc: Dict[str, Any]) -> List[Tuple[str, str, str]]:
+    """(producer_task_id, consumer_task_id, entry_name) data edges."""
+    producer: Dict[str, Tuple[str, str]] = {}
+    for t in graph_doc.get("tasks", []):
+        for out in t.get("outputs", []):
+            producer[out["id"]] = (t["id"], out.get("name") or out["id"])
+    edges = []
+    for t in graph_doc.get("tasks", []):
+        ins = list(t.get("args", [])) + list(t.get("kwargs", {}).values())
+        for ref in ins:
+            src = producer.get(ref["id"])
+            if src is not None and src[0] != t["id"]:
+                edges.append((src[0], t["id"], src[1]))
+    return edges
+
+
+def graph_dot(state: Dict[str, Any]) -> str:
+    """Graphviz dot for one graph op (``record.state`` of ``exec_graph``).
+
+    Nodes are ops colored by live status; edges are data entries labeled
+    with the entry name — the same shape DataFlowGraph.java emits."""
+    graph_doc = state.get("graph", {})
+    tasks = state.get("tasks", {})
+    lines = [
+        "digraph dataflow {",
+        "  rankdir=LR;",
+        '  node [shape=box, style="rounded,filled", fontname="sans-serif"];',
+    ]
+    for t in graph_doc.get("tasks", []):
+        tid = t["id"]
+        status = (tasks.get(tid) or {}).get("status", "WAITING")
+        fill = _STATUS_FILL.get(status, "#e8e8ee")
+        label = f"{t.get('name') or tid}\\n[{status}]"
+        if t.get("gang_size", 1) > 1:
+            label += f"\\ngang x{t['gang_size']}"
+        lines.append(
+            f'  "{tid}" [label="{label}", fillcolor="{fill}"];')
+    for src, dst, name in _edges(graph_doc):
+        lines.append(f'  "{src}" -> "{dst}" [label="{name}"];')
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def _layers(graph_doc: Dict[str, Any]) -> List[List[str]]:
+    """Topological layering by longest path from any source."""
+    tasks = [t["id"] for t in graph_doc.get("tasks", [])]
+    preds: Dict[str, List[str]] = {tid: [] for tid in tasks}
+    for src, dst, _ in _edges(graph_doc):
+        preds[dst].append(src)
+    depth: Dict[str, int] = {}
+
+    def d(tid: str, seen=()) -> int:
+        if tid in depth:
+            return depth[tid]
+        if tid in seen:        # cycle guard; validation rejects these earlier
+            return 0
+        depth[tid] = 1 + max(
+            (d(p, seen + (tid,)) for p in preds[tid]), default=-1)
+        return depth[tid]
+
+    for tid in tasks:
+        d(tid)
+    n_layers = max(depth.values(), default=0) + 1
+    layers: List[List[str]] = [[] for _ in range(n_layers)]
+    for tid in tasks:
+        layers[depth[tid]].append(tid)
+    return layers
+
+
+_NODE_W, _NODE_H, _GAP_X, _GAP_Y, _PAD = 190, 46, 70, 18, 16
+
+
+def graph_svg(state: Dict[str, Any]) -> str:
+    """Inline SVG of the DAG: layered left-to-right, status-colored nodes,
+    curved data edges. Pure stdlib — the console embeds this directly."""
+    graph_doc = state.get("graph", {})
+    tasks_state = state.get("tasks", {})
+    names = {t["id"]: (t.get("name") or t["id"])
+             for t in graph_doc.get("tasks", [])}
+    layers = _layers(graph_doc)
+    if not layers or not any(layers):
+        return '<svg xmlns="http://www.w3.org/2000/svg" width="200" ' \
+               'height="40"><text x="8" y="24">empty graph</text></svg>'
+    pos: Dict[str, Tuple[int, int]] = {}
+    for li, layer in enumerate(layers):
+        for ni, tid in enumerate(sorted(layer)):
+            x = _PAD + li * (_NODE_W + _GAP_X)
+            y = _PAD + ni * (_NODE_H + _GAP_Y)
+            pos[tid] = (x, y)
+    width = _PAD * 2 + len(layers) * (_NODE_W + _GAP_X) - _GAP_X
+    height = _PAD * 2 + max(len(l) for l in layers) * (_NODE_H + _GAP_Y) \
+        - _GAP_Y
+    parts = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+        f'height="{height}" font-family="system-ui,sans-serif">',
+        '<defs><marker id="arr" viewBox="0 0 10 10" refX="9" refY="5" '
+        'markerWidth="7" markerHeight="7" orient="auto-start-reverse">'
+        '<path d="M 0 0 L 10 5 L 0 10 z" fill="#666"/></marker></defs>',
+    ]
+    for src, dst, name in _edges(graph_doc):
+        x1, y1 = pos[src]
+        x2, y2 = pos[dst]
+        sx, sy = x1 + _NODE_W, y1 + _NODE_H // 2
+        ex, ey = x2, y2 + _NODE_H // 2
+        mx = (sx + ex) // 2
+        parts.append(
+            f'<path d="M {sx} {sy} C {mx} {sy}, {mx} {ey}, {ex} {ey}" '
+            f'fill="none" stroke="#666" stroke-width="1.2" '
+            f'marker-end="url(#arr)"/>')
+        parts.append(
+            f'<text x="{mx}" y="{(sy + ey) // 2 - 4}" font-size="10" '
+            f'fill="#888" text-anchor="middle">{html.escape(name)}</text>')
+    for tid, (x, y) in pos.items():
+        status = (tasks_state.get(tid) or {}).get("status", "WAITING")
+        fill = _STATUS_FILL.get(status, "#e8e8ee")
+        label = names.get(tid, tid)
+        if len(label) > 24:
+            label = label[:23] + "…"
+        parts.append(
+            f'<rect x="{x}" y="{y}" width="{_NODE_W}" height="{_NODE_H}" '
+            f'rx="8" fill="{fill}" stroke="#99a"/>')
+        parts.append(
+            f'<text x="{x + _NODE_W // 2}" y="{y + 19}" font-size="12" '
+            f'text-anchor="middle">{html.escape(label)}</text>')
+        parts.append(
+            f'<text x="{x + _NODE_W // 2}" y="{y + 36}" font-size="10" '
+            f'fill="#555" text-anchor="middle">{html.escape(status)}</text>')
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def load_graph_state(store, graph_op_id: str) -> Optional[Dict[str, Any]]:
+    """The exec_graph op's state, or None if unknown/not a graph op."""
+    try:
+        record = store.load(graph_op_id)
+    except KeyError:
+        return None
+    if record.kind != "exec_graph":
+        return None
+    state = dict(record.state)
+    state.setdefault("_status", record.status)
+    return state

@@ -17,6 +17,7 @@ from typing import List, Optional, Sequence
 
 from lzy_tpu.channels.manager import ChannelManager
 from lzy_tpu.core.lzy import Lzy
+from lzy_tpu.durable.pg_store import store_for
 from lzy_tpu.durable import OperationsExecutor, OperationStore
 from lzy_tpu.serialization import default_registry
 from lzy_tpu.service.allocator import AllocatorService
@@ -67,7 +68,7 @@ class InProcessCluster:
     ):
         self._rpc_port = rpc_port
         self.storage_uri = storage_uri
-        self.store = OperationStore(db_path)
+        self.store = store_for(db_path)
         # Exactly one control-plane process may drive a given metadata
         # store: the mutating paths are in-process read-modify-write (the
         # reference runs replicated services against Postgres with leader-

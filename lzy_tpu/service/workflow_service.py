@@ -385,6 +385,20 @@ class WorkflowService:
         self._authz(token, WORKFLOW_READ, execution_id)
         return self._ge.status(graph_op_id)
 
+    def graph_dot(self, execution_id: str, graph_op_id: str, *,
+                  token: Optional[str] = None) -> str:
+        """The graph's dataflow DAG as graphviz dot with live per-task
+        status (reference: ``DataFlowGraph.java:20-268`` dot output).
+        The web console renders the same state as inline SVG."""
+        from lzy_tpu.iam import WORKFLOW_READ
+        from lzy_tpu.service import graphviz
+
+        self._authz(token, WORKFLOW_READ, execution_id)
+        state = graphviz.load_graph_state(self._store, graph_op_id)
+        if state is None:
+            raise KeyError(f"unknown graph {graph_op_id!r}")
+        return graphviz.graph_dot(state)
+
     def stop_graph(self, execution_id: str, graph_op_id: str, *,
                    token: Optional[str] = None,
                    idempotency_key: Optional[str] = None) -> None:

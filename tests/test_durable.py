@@ -26,9 +26,12 @@ def _clear_failures():
     InjectedFailures.clear()
 
 
-@pytest.fixture()
-def store(tmp_path):
-    s = OperationStore(str(tmp_path / "meta.db"))
+from conftest import durable_store_backends, make_durable_store
+
+
+@pytest.fixture(params=durable_store_backends())
+def store(request, tmp_path):
+    s = make_durable_store(request.param, str(tmp_path / "meta.db"))
     yield s
     s.close()
 

@@ -18,9 +18,17 @@ from lzy_tpu.service import InProcessCluster
 from lzy_tpu.service.harness import LeaderLeaseHeld
 
 
+from conftest import durable_store_backends, make_durable_store
+
+
+@pytest.fixture(params=durable_store_backends())
+def lease_backend(request):
+    return request.param
+
+
 class TestLeaseStore:
-    def test_acquire_renew_release(self, tmp_path):
-        s = OperationStore(str(tmp_path / "m.db"))
+    def test_acquire_renew_release(self, tmp_path, lease_backend):
+        s = make_durable_store(lease_backend, str(tmp_path / "m.db"))
         assert s.try_acquire_lease("gc", "a", 30)
         assert s.lease_holder("gc")[0] == "a"
         assert not s.try_acquire_lease("gc", "b", 30)   # held by a
@@ -32,8 +40,8 @@ class TestLeaseStore:
         assert s.try_acquire_lease("gc", "b", 30)
         s.close()
 
-    def test_expired_lease_is_taken_over(self, tmp_path):
-        s = OperationStore(str(tmp_path / "m.db"))
+    def test_expired_lease_is_taken_over(self, tmp_path, lease_backend):
+        s = make_durable_store(lease_backend, str(tmp_path / "m.db"))
         assert s.try_acquire_lease("gc", "a", 0.05)
         time.sleep(0.1)
         assert s.lease_holder("gc") is None              # lapsed
@@ -41,10 +49,11 @@ class TestLeaseStore:
         assert not s.renew_lease("gc", "a", 30)          # a lost it
         s.close()
 
-    def test_cross_process_visibility(self, tmp_path):
+    def test_cross_process_visibility(self, tmp_path, lease_backend):
         """Two store handles on one file (the two-process topology)."""
         path = str(tmp_path / "m.db")
-        s1, s2 = OperationStore(path), OperationStore(path)
+        s1 = make_durable_store(lease_backend, path)
+        s2 = make_durable_store(lease_backend, path, fresh=False)
         assert s1.try_acquire_lease("gc", "a", 30)
         assert not s2.try_acquire_lease("gc", "b", 30)
         assert s2.lease_holder("gc")[0] == "a"

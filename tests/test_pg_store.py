@@ -1,0 +1,99 @@
+"""PostgresOperationStore specifics: dialect translation and the
+DbHelper.withRetries discipline (serialization-failure retry), exercised
+through the fake DBAPI driver so they run without a server."""
+
+import pytest
+
+from fake_pg import FakePgError, fake_connect
+
+from lzy_tpu.durable.pg_store import (
+    PostgresOperationStore,
+    store_for,
+    translate,
+)
+from lzy_tpu.durable.store import OperationStore
+
+
+class TestTranslate:
+    def test_placeholders(self):
+        assert translate("SELECT v FROM kv WHERE ns = ? AND k = ?") == \
+            "SELECT v FROM kv WHERE ns = %s AND k = %s"
+
+    def test_null_safe_compare(self):
+        assert translate("UPDATE t SET a = ? WHERE deadline IS ?") == \
+            "UPDATE t SET a = %s WHERE deadline IS NOT DISTINCT FROM %s"
+
+
+class TestRetryDiscipline:
+    def test_serialization_failure_retried(self, tmp_path):
+        s = PostgresOperationStore(str(tmp_path / "pg.db"),
+                                   _connect=fake_connect)
+        s._conn.fail_next_sqlstates = ["40001", "40P01"]  # two, then clean
+        s.kv_put("ns", "k", {"v": 1})                     # survives both
+        assert s.kv_get("ns", "k") == {"v": 1}
+
+    def test_non_retryable_sqlstate_raises(self, tmp_path):
+        s = PostgresOperationStore(str(tmp_path / "pg.db"),
+                                   _connect=fake_connect)
+        s._conn.fail_next_sqlstates = ["23502"]           # NOT NULL violation
+        with pytest.raises(FakePgError):
+            s.kv_put("ns", "k", 1)
+
+    def test_retries_exhaust(self, tmp_path):
+        s = PostgresOperationStore(str(tmp_path / "pg.db"),
+                                   _connect=fake_connect)
+        s.MAX_RETRIES = 3
+        s._conn.fail_next_sqlstates = ["40001"] * 10
+        with pytest.raises(FakePgError):
+            s.kv_put("ns", "k", 1)
+
+    def test_cross_plane_idempotency_race(self, tmp_path):
+        """Two planes insert the same idempotency key; the loser's unique
+        violation resolves to the winner's record (multi-process PG path —
+        the in-process sqlite lock can never hit this)."""
+        path = str(tmp_path / "pg.db")
+        a = PostgresOperationStore(path, _connect=fake_connect)
+        b = PostgresOperationStore(path, _connect=fake_connect)
+        rec_a = a.create("op-a", "k", {}, idempotency_key="shared")
+        # force plane B's pre-check to miss, as if A's insert landed in
+        # the check->insert window: B's INSERT must hit the unique index
+        # and resolve to A's record instead of raising
+        real_execute = b._execute
+        state = {"missed": False}
+
+        def racy_execute(sql, params=()):
+            if (not state["missed"]
+                    and sql.lstrip().startswith("SELECT id FROM operations")):
+                state["missed"] = True
+
+                class _Miss:
+                    def fetchone(self):
+                        return None
+
+                return _Miss()
+            return real_execute(sql, params)
+
+        b._execute = racy_execute
+        rec_b = b.create("op-b", "k", {}, idempotency_key="shared")
+        assert rec_a.id == rec_b.id == "op-a"
+        assert state["missed"], "the race path was not exercised"
+
+
+def test_store_for_dispatch(tmp_path):
+    s = store_for(str(tmp_path / "x.db"))
+    assert type(s) is OperationStore
+    try:
+        import psycopg2  # noqa: F401
+
+        have_driver = True
+    except ImportError:
+        try:
+            import pg8000  # noqa: F401
+
+            have_driver = True
+        except ImportError:
+            have_driver = False
+    if have_driver:
+        pytest.skip("a real PG driver is installed; the DSN would dial out")
+    with pytest.raises(ImportError, match="psycopg2 or pg8000"):
+        store_for("postgresql://u@h/db")

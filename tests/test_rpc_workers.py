@@ -522,6 +522,25 @@ def spmd_pretrain(steps: int) -> float:
     storage = client_for(StorageConfig(uri=os.environ["LZY_TEST_CKPT_URI"]))
     mgr = CheckpointManager(storage, os.environ["LZY_TEST_CKPT_URI"], "pre")
     mgr.save_sharded(state.params, steps, metrics={"loss": loss})
+
+    # orbax round-trip leg (VERDICT r4 #9): export from the LIVE
+    # multi-process run (rank-0 gather-and-write), re-import with the
+    # live shardings, and demand bit-identical local shards on each host
+    import numpy as np
+
+    from lzy_tpu.parallel.orbax_interop import export_orbax, import_orbax
+
+    orbax_dir = os.environ["LZY_TEST_ORBAX_DIR"]
+    export_orbax(state.params, orbax_dir, force=True)
+    template = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state.params)
+    shardings = jax.tree_util.tree_map(lambda a: a.sharding, state.params)
+    back = import_orbax(orbax_dir, template=template, shardings=shardings)
+    for x, y in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(back)):
+        for sx, sy in zip(x.addressable_shards, y.addressable_shards):
+            np.testing.assert_array_equal(
+                np.asarray(sx.data), np.asarray(sy.data))
     return loss
 
 
@@ -539,11 +558,22 @@ def test_multihost_pretrain_op_with_sharded_checkpoint(tmp_path):
     ckpt_uri = f"file://{tmp_path}/ckpt"
     try:
         lzy = c.lzy()
+        orbax_dir = str(tmp_path / "orbax-export")
         with lzy.workflow("pretrain-wf"):
             r = spmd_pretrain.with_env_vars(
-                {"LZY_TEST_CKPT_URI": ckpt_uri})(3)
+                {"LZY_TEST_CKPT_URI": ckpt_uri,
+                 "LZY_TEST_ORBAX_DIR": orbax_dir})(3)
             loss = float(r)
         assert 0.0 < loss < 20.0
+
+        # the orbax export is a real checkpoint on disk (written by the
+        # gang's rank 0), importable OUTSIDE the gang too
+        from lzy_tpu.parallel.orbax_interop import import_orbax
+
+        outside = import_orbax(orbax_dir)
+        import jax as _jax
+
+        assert len(_jax.tree_util.tree_leaves(outside)) > 0
 
         # the checkpoint is real and SHARDED: manifest published, and the
         # fsdp axis spans both processes' devices, so shard objects exist

@@ -77,14 +77,25 @@ _COLLECTIVES = (
     "all-to-all",
 )
 
-# definition lines look like:
+# sync definition lines look like:
 #   %all-gather.3 = bf16[8,2048,1024]{2,1,0:T(8,128)(2,1)} all-gather(...)
-# or (async pairs)  ... all-gather-start(...) / all-gather-done(...)
 _DEF_RE = re.compile(
-    r"=\s*(?:\()?\s*([a-z0-9]+)\[([0-9,]*)\][^ ]*\s+"
+    r"=\s*([a-z0-9]+)\[([0-9,]*)\][^ ]*\s+"
     r"(all-gather|all-reduce|reduce-scatter|collective-permute|all-to-all)"
-    r"(?:-start)?\("
+    r"\("
 )
+# async pairs return a TUPLE from -start:
+#   %cp.s = (bf16[64,..], bf16[64,..]) collective-permute-start(...)
+# (the TPU partitioner lowers windowed einsums to thousands of these —
+# round-5 lesson: a census that only reads sync ops calls a permute-ring
+# module "1 all-gather" and mis-rooflines it); bytes moved = the RESULT
+# (last tuple element) shape; the matching -done defines no collective
+_ASYNC_RE = re.compile(
+    r"=\s*\((.*)\)\s+"
+    r"(all-gather|all-reduce|reduce-scatter|collective-permute|all-to-all)"
+    r"-start\("
+)
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
 
 
 def _shape_bytes(dtype: str, dims: str) -> int:
@@ -101,12 +112,23 @@ def collective_census(hlo_text: str) -> dict:
     largest = []
     for m in _DEF_RE.finditer(hlo_text):
         dtype, dims, op = m.groups()
-        # async -start/-done pairs define the op once at -start; -done lines
-        # don't match (no shape before the opcode), so no double counting
         nbytes = _shape_bytes(dtype, dims)
         census[op]["count"] += 1
         census[op]["bytes"] += nbytes
         largest.append((nbytes, f"{op} {dtype}[{dims}]"))
+    for m in _ASYNC_RE.finditer(hlo_text):
+        tuple_body, op = m.groups()
+        shapes = _SHAPE_RE.findall(tuple_body)
+        if not shapes:
+            continue
+        # the tuple mixes (operand, result, sync-flag scalars...); the
+        # moved payload is the largest element (= result: >= operand for
+        # all-gather, == operand for a permute)
+        dtype, dims = max(shapes, key=lambda s: _shape_bytes(*s))
+        nbytes = _shape_bytes(dtype, dims)
+        census[op]["count"] += 1
+        census[op]["bytes"] += nbytes
+        largest.append((nbytes, f"{op}-async {dtype}[{dims}]"))
     out = {op: v for op, v in census.items() if v["count"]}
     if largest:
         largest.sort(reverse=True)
@@ -231,11 +253,19 @@ def analyze(tag: str, cfg, topo_name: str, *, global_batch: int,
     # --- roofline ---------------------------------------------------------
     flops_dev = float(ca.get("flops", 0.0))        # per-device (SPMD module)
     bytes_dev = float(ca.get("bytes accessed", 0.0))
-    t_mxu = flops_dev / V5E["peak_bf16_flops"]
     t_hbm = bytes_dev / V5E["hbm_bytes_per_s"]
     # ring model: an N-way all-gather/reduce-scatter moves (N-1)/N of its
     # gathered bytes through each chip's ring links; all-reduce costs 2x a
     # reduce-scatter; a collective-permute hop moves its bytes once
+    tokens_dev = global_batch * seq_len / n_chips
+    model_flops_dev = 6.0 * n_params * tokens_dev  # 6ND, matches train.mfu()
+    # XLA's cost analysis counts a while body ONCE — a windowed einsum
+    # (how the TPU partitioner implements fsdp matmuls, as
+    # collective-permute rings) under-reports its flops by the trip
+    # count. The 6ND model flops are a hard floor for a train step, so
+    # the roofline takes the max.
+    flops_floor = max(flops_dev, model_flops_dev)
+    t_mxu = flops_floor / V5E["peak_bf16_flops"]
     n = n_chips
     ici_bytes = 0.0
     for op, v in census.items():
@@ -249,9 +279,6 @@ def analyze(tag: str, cfg, topo_name: str, *, global_batch: int,
         ici_bytes += v["bytes"] * factor
     t_ici = ici_bytes / V5E["ici_ring_bytes_per_s"] if n > 1 else 0.0
     t_bound = max(t_mxu, t_hbm, t_ici)
-
-    tokens_dev = global_batch * seq_len / n_chips
-    model_flops_dev = 6.0 * n_params * tokens_dev  # 6ND, matches train.mfu()
     mfu_bound = model_flops_dev / (V5E["peak_bf16_flops"] * t_bound)
     # donated state aliases its output slots (alias_size), so live HBM is
     # args + temps + code + the non-aliased output remainder
@@ -346,6 +373,12 @@ def targets() -> dict:
                                     remat_policy="dots"),
             topo="v5e-16", global_batch=batch * 16, seq_len=seq,
             mesh_axes={"fsdp": -1}),
+        # best-per-chip candidate on the slice: fused CE WITHOUT remat —
+        # logits-free frees enough HBM at b8/chip that no recompute
+        # re-reads are needed; dots-remat costs ~2x HBM traffic
+        "northstar_v5e16_fsdp_fused_noremat": dict(
+            cfg=dataclasses.replace(cfg, fused_ce=True), topo="v5e-16",
+            global_batch=batch * 16, seq_len=seq, mesh_axes={"fsdp": -1}),
         # control experiment: identical config on a single-host 16-chip
         # topology — separates what the partitioner does to the sharding
         # from what it does about the DCN (4-process) boundary
