@@ -1,0 +1,109 @@
+"""Generate the Grafana dashboard from the ACTUAL metrics registry.
+
+The reference ships a hand-written dashboard
+(``deployment/grafana/dashboards/main.json``); hand-written dashboards
+drift. This generator imports the service modules (which register their
+metrics in ``lzy_tpu.utils.metrics.REGISTRY``), then emits one panel per
+metric with the idiomatic query shape per type:
+
+- counter  -> ``sum(rate(<name>[5m])) by (labels)`` timeseries
+- gauge    -> ``<name>`` timeseries
+- histogram-> p50/p95 via ``histogram_quantile`` over bucket rates
+
+Output: ``deploy/grafana/dashboard.json`` (committed; the suite asserts
+it stays in sync — tests/test_deploy.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def registry_metrics():
+    # importing the service modules registers every production metric
+    import lzy_tpu.service.allocator  # noqa: F401
+    import lzy_tpu.service.graph_executor  # noqa: F401
+    import lzy_tpu.service.workflow_service  # noqa: F401
+    import lzy_tpu.service.worker  # noqa: F401
+    from lzy_tpu.utils.metrics import Counter, Gauge, Histogram, REGISTRY
+
+    kinds = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
+    out = []
+    for name, metric in sorted(REGISTRY._metrics.items()):
+        out.append({
+            "name": name,
+            "type": kinds.get(type(metric), "gauge"),
+            "help": getattr(metric, "help", "") or getattr(
+                metric, "_help", ""),
+        })
+    return out
+
+
+def _panel(metric: dict, idx: int) -> dict:
+    name, kind = metric["name"], metric["type"]
+    if kind == "counter":
+        targets = [{"expr": f"sum(rate({name}[5m]))",
+                    "legendFormat": f"{name}/s"}]
+        title = f"{name} (rate)"
+    elif kind == "histogram":
+        targets = [
+            {"expr": ("histogram_quantile(0.50, "
+                      f"sum(rate({name}_bucket[5m])) by (le))"),
+             "legendFormat": "p50"},
+            {"expr": ("histogram_quantile(0.95, "
+                      f"sum(rate({name}_bucket[5m])) by (le))"),
+             "legendFormat": "p95"},
+        ]
+        title = f"{name} (p50/p95)"
+    else:
+        targets = [{"expr": name, "legendFormat": name}]
+        title = name
+    return {
+        "id": idx + 1,
+        "title": title,
+        "description": metric["help"],
+        "type": "timeseries",
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "targets": [{"refId": chr(ord("A") + i), **t}
+                    for i, t in enumerate(targets)],
+        "gridPos": {"h": 8, "w": 12, "x": 12 * (idx % 2),
+                    "y": 8 * (idx // 2)},
+        "fieldConfig": {"defaults": {"unit": "short"}, "overrides": []},
+    }
+
+
+def build() -> dict:
+    metrics = registry_metrics()
+    return {
+        "title": "lzy-tpu control plane",
+        "uid": "lzy-tpu-main",
+        "schemaVersion": 39,
+        "tags": ["lzy-tpu"],
+        "time": {"from": "now-6h", "to": "now"},
+        "refresh": "30s",
+        "templating": {"list": [{
+            "name": "datasource", "type": "datasource",
+            "query": "prometheus", "label": "datasource",
+        }]},
+        "panels": [_panel(m, i) for i, m in enumerate(metrics)],
+        "_generated_from": sorted(m["name"] for m in metrics),
+    }
+
+
+def main() -> int:
+    out_path = os.path.join(REPO, "deploy", "grafana", "dashboard.json")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(build(), f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
