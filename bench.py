@@ -354,6 +354,15 @@ def run() -> None:
     if extra:
         detail.update(extra)
         emit()
+    is_tpu = platform in ("tpu", "axon")
+    extra = decode_measurement(
+        jax, cfg, params,
+        batch_size=8 if is_tpu else 4,
+        prompt_len=128 if is_tpu else 32,
+        new_tokens=64)
+    if extra:
+        detail.update(extra)
+        emit()
     if platform in ("tpu", "axon"):
         # each extra pass builds a whole second model+optimizer: evict the
         # previous one (buffers AND compiled executables) first or OOM
@@ -473,6 +482,64 @@ def seq4k_measurement(jax, cfg, mesh, n_params, steps: int = 10):
                 return {}
             jax.clear_caches()  # next attempt saves more memory
     return {}
+
+
+def decode_measurement(jax, cfg, params, *, batch_size: int,
+                       prompt_len: int, new_tokens: int):
+    """Best-effort serving-path point: KV-cache decode throughput of the
+    headline model (batched prefill + one jitted per-token decode step —
+    the exact hot loop the continuous-batching engine in lzy_tpu/serving
+    drives). The step is jitted ONCE and timed directly, so the metric is
+    pure decode — no prefill share, no per-call recompiles; two extra
+    compiles total (prefill chunk + step), wrapped so a hiccup never
+    loses the headline metric."""
+    try:
+        import functools
+
+        import jax.numpy as jnp
+
+        from lzy_tpu.models.generate import (
+            batched_prefill, decode_config, init_cache, make_prefill_step)
+        from lzy_tpu.models.llama import Llama
+
+        dcfg = decode_config(cfg)
+        model = Llama(dcfg)
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(2), (batch_size, prompt_len), 0,
+            dcfg.vocab_size)
+        _log("decode: compiling + prefill...")
+        cache = init_cache(lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((batch_size, 1), jnp.int32)))
+        cache, last = batched_prefill(
+            model, cache, params, prompt, max_seq_len=dcfg.max_seq_len,
+            prefill_step=make_prefill_step(model))
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(cache, params, tok):
+            logits, updated = model.apply(
+                {"params": params, "cache": cache}, tok, mutable=["cache"])
+            return (updated["cache"],
+                    jnp.argmax(logits[:, -1], -1).astype(jnp.int32))
+
+        cur = jnp.argmax(last, -1).astype(jnp.int32)
+        cache, cur = step(cache, params, cur[:, None])   # compile + warmup
+        cur.block_until_ready()
+        _log(f"decode: timing {new_tokens} steps x batch {batch_size}...")
+        t0 = time.perf_counter()
+        for _ in range(new_tokens):
+            cache, cur = step(cache, params, cur[:, None])
+        cur.block_until_ready()
+        dt = time.perf_counter() - t0
+        tps = batch_size * new_tokens / dt
+        _log(f"decode: {1000 * dt / new_tokens:.2f} ms/step, "
+             f"{tps:.1f} tok/s")
+        return {"decode_tokens_per_s": round(tps, 1),
+                "decode_step_ms": round(1000 * dt / new_tokens, 3),
+                "decode_batch": batch_size,
+                "decode_prompt_len": prompt_len}
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        _log(f"decode skipped: {type(e).__name__}: {e}")
+        return {}
 
 
 def step_breakdown(jax, loss_fn, params, batch, step_ms: float, n: int = 5):

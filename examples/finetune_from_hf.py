@@ -30,7 +30,9 @@ import numpy as np
 # config-level too: a site-pinned TPU plugin overrides env vars
 jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 if os.environ["JAX_PLATFORMS"] == "cpu":
-    jax.config.update("jax_num_cpu_devices", 8)
+    from lzy_tpu.utils.compat import request_cpu_devices
+
+    request_cpu_devices(8)
 
 import optax  # noqa: E402
 import torch  # noqa: E402

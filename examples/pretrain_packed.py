@@ -32,7 +32,9 @@ if os.environ.get("JAX_PLATFORMS"):
 
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     if "host_platform_device_count=8" in os.environ.get("XLA_FLAGS", ""):
-        jax.config.update("jax_num_cpu_devices", 8)
+        from lzy_tpu.utils.compat import request_cpu_devices
+
+        request_cpu_devices(8)
 
 from lzy_tpu import Lzy, op, whiteboard
 

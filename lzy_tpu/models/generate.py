@@ -47,6 +47,17 @@ def sample_token(logits: jax.Array, temperature: float, rng: jax.Array,
     return nxt.astype(jnp.int32), rng
 
 
+def decode_config(cfg: LlamaConfig, **overrides) -> LlamaConfig:
+    """The decode-mode variant of a train config: KV-cache decoding with
+    every training-only feature cleared (remat, flash/ring/ulysses
+    attention — none apply to single-position steps against a cache).
+    The one place this set lives; generate, pp_generate, the serving
+    engine, and bench all derive from it."""
+    return dataclasses.replace(
+        cfg, decode=True, remat=False, use_flash_kernel=False,
+        use_ring_attention=False, use_ulysses_attention=False, **overrides)
+
+
 def init_cache(init_fn):
     """Materialize a model's zeroed KV cache from an abstract init:
     ``init_fn`` is a zero-arg lambda running ``model.init(...)``; eval_shape
@@ -54,6 +65,100 @@ def init_cache(init_fn):
     cache_shapes = jax.eval_shape(init_fn)["cache"]
     return jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+
+
+#: padded prefill widths — prompts are fed through the model in chunks of
+#: these shapes, so the number of compiled prefill programs is bounded by
+#: the bucket count instead of growing with every distinct prompt length
+PREFILL_BUCKETS = (8, 16, 32, 64, 128, 256)
+
+
+def prefill_plan(t0: int, chunk: int, max_seq_len: int):
+    """Chunk schedule for a ``t0``-token prompt: list of
+    ``(start, take, width)`` where ``take`` real tokens starting at
+    ``start`` run as one forward pass padded to ``width`` (the smallest
+    bucket that fits, capped so the padded write never spills past
+    ``max_seq_len`` — ``dynamic_update_slice`` would clamp the start and
+    overwrite real cache rows). At most ``ceil(t0/chunk)`` passes."""
+    chunk = max(1, chunk)
+    widths = sorted({w for w in PREFILL_BUCKETS if w <= chunk} | {chunk})
+    plan = []
+    start = 0
+    while start < t0:
+        take = min(chunk, t0 - start)
+        width = next(w for w in widths if w >= take)
+        plan.append((start, take, min(width, max_seq_len - start)))
+        start += take
+    return plan
+
+
+def _set_cache_index(cache, value: int):
+    """Rewrite every ``index`` leaf of a KV-cache tree to ``value`` (host
+    side, between jitted calls). Needed after a PADDED prefill chunk: the
+    model advanced the index by the padded width, but decoding must resume
+    at the true prompt length — the pad slots hold garbage K/V that each
+    subsequent decode step overwrites before its mask can see them."""
+    def fix(path, leaf):
+        if any(getattr(p, "key", None) == "index" for p in path):
+            return jnp.full(leaf.shape, value, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def make_prefill_step(model):
+    """One jitted prefill pass: run a whole ``[B, W]`` token chunk through
+    the decode-mode model (the cache write and causal masking live in
+    ``Attention._decode_step``), returning the updated cache and the logits
+    at ``last_idx`` (the final REAL position — pad logits are garbage)."""
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def prefill_step(cache, params, tokens, last_idx):
+        logits, updated = model.apply(
+            {"params": params, "cache": cache}, tokens, mutable=["cache"]
+        )
+        last = jax.lax.dynamic_index_in_dim(
+            logits, last_idx, axis=1, keepdims=False)
+        return updated["cache"], last
+
+    return prefill_step
+
+
+def batched_prefill(model, cache, params, prompt, *, chunk: int = 64,
+                    max_seq_len: int, prefill_step=None):
+    """Write a whole prompt ``[B, T0]`` into the KV cache in
+    ``ceil(T0/chunk)`` forward passes (vs T0 sequential single-token device
+    calls) over at most ``len(PREFILL_BUCKETS)+1`` compiled shapes.
+    Returns ``(cache, last_logits)`` with ``last_logits`` taken at the
+    prompt's final position. Pass a shared ``prefill_step`` (from
+    :func:`make_prefill_step`) to reuse its jit cache across calls — the
+    serving engine does; ``generate`` builds a throwaway one."""
+    b, t0 = prompt.shape
+    if prefill_step is None:
+        prefill_step = make_prefill_step(model)
+    last = None
+    plan = prefill_plan(t0, chunk, max_seq_len)
+    for start, take, width in plan:
+        tokens = prompt[:, start:start + take]
+        if width != take:
+            tokens = jnp.pad(tokens, ((0, 0), (0, width - take)))
+        cache, last = prefill_step(
+            cache, params, tokens, jnp.asarray(take - 1, jnp.int32))
+    _, last_take, last_width = plan[-1]
+    if last_take != last_width:
+        # final chunk was padded: rewind the index to the true length
+        cache = _set_cache_index(cache, t0)
+    return cache, last
+
+
+def _advance_rng(rng: jax.Array, n: int) -> jax.Array:
+    """The rng stream after ``n`` sample-and-discard calls — batched prefill
+    skips the per-prompt-token sampling the sequential path does, but must
+    land on the SAME key so sampled continuations are bit-identical between
+    the two paths (each ``sample_token`` call advances via one split)."""
+    if n <= 0:
+        return rng
+    return jax.lax.fori_loop(
+        0, n, lambda _, r: jax.random.split(r)[0], rng)
 
 
 def generate(
@@ -67,20 +172,36 @@ def generate(
     top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
     eos_token: Optional[int] = None,
+    prefill: str = "batched",
+    prefill_chunk: int = 64,
+    eos_check_every: int = 8,
 ) -> jax.Array:
     """Greedy (``temperature=0``) or sampled continuation of ``prompt``
     (``[B, T0]`` int32). Returns ``[B, T0 + max_new_tokens]`` (positions after
-    an ``eos_token`` keep repeating it)."""
+    an ``eos_token`` keep repeating it).
+
+    ``prefill="batched"`` (default) runs the prompt through the model in
+    ``ceil(T0/prefill_chunk)`` causal-masked forward passes over a bounded
+    set of padded shapes (:data:`PREFILL_BUCKETS`); ``"sequential"`` keeps
+    the original one-device-call-per-token loop as the reference oracle —
+    both produce identical tokens (the batched path advances the sampling
+    rng in lockstep with the oracle's per-token sample-and-discard).
+
+    With ``eos_token`` set, the decode loop syncs ``done`` to the host
+    every ``eos_check_every`` steps and exits early once every sequence
+    has finished, padding the remainder with ``eos_token`` (identical
+    output, without burning ``max_new_tokens`` device calls on it).
+    """
     b, t0 = prompt.shape
     if t0 + max_new_tokens > cfg.max_seq_len:
         raise ValueError(
             f"prompt ({t0}) + new tokens ({max_new_tokens}) exceeds "
             f"max_seq_len ({cfg.max_seq_len})"
         )
-    dcfg = dataclasses.replace(
-        cfg, decode=True, remat=False, use_flash_kernel=False,
-        use_ring_attention=False,
-    )
+    if prefill not in ("batched", "sequential"):
+        raise ValueError(
+            f"prefill must be 'batched' or 'sequential', got {prefill!r}")
+    dcfg = decode_config(cfg)
     model = Llama(dcfg)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
@@ -99,19 +220,36 @@ def generate(
                                 top_k=top_k, top_p=top_p)
         return updated["cache"], nxt, rng
 
-    # prefill: feed prompt tokens through the cache one position at a time
-    nxt = None
-    for t in range(t0):
-        cache, nxt, rng = step(cache, params, prompt[:, t:t + 1], rng)
+    if prefill == "sequential":
+        # reference oracle: one jitted device call per prompt position
+        cur = None
+        for t in range(t0):
+            cache, cur, rng = step(cache, params, prompt[:, t:t + 1], rng)
+    else:
+        cache, last_logits = batched_prefill(
+            model, cache, params, prompt, chunk=prefill_chunk,
+            max_seq_len=cfg.max_seq_len)
+        rng = _advance_rng(rng, t0 - 1)
+        cur, rng = sample_token(last_logits, temperature, rng,
+                                top_k=top_k, top_p=top_p)
 
     tokens = [prompt]
     done = jnp.zeros((b,), bool)
-    cur = nxt
-    for _ in range(max_new_tokens):
+    for n in range(max_new_tokens):
         if eos_token is not None:
             cur = jnp.where(done, eos_token, cur)
             done = done | (cur == eos_token)
         tokens.append(cur[:, None])
+        emitted = n + 1
+        if emitted == max_new_tokens:
+            break  # the last emitted token needs no further model step
+        if (eos_token is not None and eos_check_every > 0
+                and emitted % eos_check_every == 0 and bool(done.all())):
+            # every sequence has hit eos: the remaining positions are all
+            # eos by construction — emit them without any device calls
+            tokens.append(jnp.full(
+                (b, max_new_tokens - emitted), eos_token, prompt.dtype))
+            break
         cache, cur, rng = step(cache, params, cur[:, None], rng)
     return jnp.concatenate(tokens, axis=1)
 
@@ -137,8 +275,10 @@ def pp_generate(
     decode, not token-level pipelining). Matches the dense ``generate``
     token-for-token (same rng discipline), incl. sampling and ``eos_token``.
     """
-    from jax import lax, shard_map
+    from jax import lax
     from jax.sharding import PartitionSpec as P
+
+    from lzy_tpu.utils.compat import shard_map
 
     from lzy_tpu.models.llama import (
         LlamaStage, RMSNorm, _check_pp_config)
@@ -156,10 +296,7 @@ def pp_generate(
         raise ValueError(
             f"prompt ({t0}) + new tokens ({max_new_tokens}) exceeds "
             f"max_seq_len ({cfg.max_seq_len})")
-    dcfg = dataclasses.replace(
-        cfg, decode=True, remat=False, pp_stages=0, use_flash_kernel=False,
-        use_ring_attention=False, use_ulysses_attention=False,
-    )
+    dcfg = decode_config(cfg, pp_stages=0)
     stage = LlamaStage(dcfg, k)
     cache_shapes = jax.eval_shape(
         lambda: stage.init(jax.random.PRNGKey(0),

@@ -58,8 +58,15 @@ class LlamaConfig:
     # Mixtral-style sparse MLP: >0 replaces dense MLPs with MoE (ep-shardable)
     n_experts: int = 0
     moe_top_k: int = 2
-    # autoregressive decoding with a KV cache (see generate())
+    # autoregressive decoding with a KV cache (see generate()); the decode
+    # step accepts token chunks [B, T>=1], so prefill writes a whole prompt
+    # chunk into the cache per forward pass instead of one position at a time
     decode: bool = False
+    # per-row cache positions: the cache "index" is [B] instead of a scalar,
+    # so every batch row decodes at its own sequence position — what the
+    # continuous-batching engine (lzy_tpu/serving) needs to admit and retire
+    # requests mid-decode without draining the batch
+    decode_slot_index: bool = False
     # logits-free loss: the model returns (features, head) and the loss uses
     # chunked_cross_entropy — saves the [B,T,V] activation (ops/chunked_ce.py)
     fused_ce: bool = False
@@ -228,50 +235,73 @@ class Attention(nn.Module):
         )(out)
 
     def _decode_step(self, q, k, v, b):
-        """Single-token autoregressive step against the KV cache (flax cache
-        collection); q/k/v: [B, 1, heads|kv, D] pre-RoPE."""
+        """Autoregressive step against the KV cache (flax cache collection);
+        q/k/v: [B, T, heads|kv, D] pre-RoPE. T=1 is token-by-token decode;
+        T>1 is batched prefill: the whole chunk is written into the cache
+        first, and the mask below keeps each query position causal within
+        it. With ``cfg.decode_slot_index`` the cache index is ``[B]`` and
+        every row reads/writes at its own position (continuous batching)."""
         cfg = self.cfg
         h, kv_heads, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
         L = cfg.max_seq_len
+        t = q.shape[1]
         cache_k = self.variable(
             "cache", "k", jnp.zeros, (b, L, kv_heads, d), cfg.dtype
         )
         cache_v = self.variable(
             "cache", "v", jnp.zeros, (b, L, kv_heads, d), cfg.dtype
         )
+        idx_shape = (b,) if cfg.decode_slot_index else ()
         index = self.variable(
-            "cache", "index", lambda: jnp.zeros((), jnp.int32)
+            "cache", "index", lambda: jnp.zeros(idx_shape, jnp.int32)
         )
         i = index.value
-        pos = jnp.full((b, 1), i, jnp.int32)
+        starts = i if i.ndim else jnp.broadcast_to(i, (b,))      # [B]
+        pos = starts[:, None] + jnp.arange(t, dtype=jnp.int32)   # [B, T]
         q = _rope(q, pos, cfg.rope_theta)
         k = _rope(k, pos, cfg.rope_theta)
         if not self.is_initializing():
             # init() RUNS the module; writing during init would pre-populate
             # the cache with the dummy token and shift every real position
-            cache_k.value = jax.lax.dynamic_update_slice(
-                cache_k.value, k.astype(cfg.dtype), (0, i, 0, 0)
-            )
-            cache_v.value = jax.lax.dynamic_update_slice(
-                cache_v.value, v.astype(cfg.dtype), (0, i, 0, 0)
-            )
-            index.value = i + 1
+            if i.ndim:
+                # per-row positions: each batch row lands at its own start
+                row_write = jax.vmap(
+                    lambda c, kv_chunk, start: jax.lax.dynamic_update_slice(
+                        c, kv_chunk, (start, 0, 0)))
+                cache_k.value = row_write(
+                    cache_k.value, k.astype(cfg.dtype), starts)
+                cache_v.value = row_write(
+                    cache_v.value, v.astype(cfg.dtype), starts)
+            else:
+                cache_k.value = jax.lax.dynamic_update_slice(
+                    cache_k.value, k.astype(cfg.dtype), (0, i, 0, 0)
+                )
+                cache_v.value = jax.lax.dynamic_update_slice(
+                    cache_v.value, v.astype(cfg.dtype), (0, i, 0, 0)
+                )
+            index.value = i + t
 
-        # GQA without jnp.repeat: grouping q as [B, 1, KV, G, D] lets the
+        # GQA without jnp.repeat: grouping q as [B, T, KV, G, D] lets the
         # einsum broadcast the shared KV head instead of materializing a
         # G-times larger cache copy every step — decode is HBM-bound, and
         # the repeat was pure wasted bandwidth
         reps = h // kv_heads
-        qg = q.reshape(b, 1, kv_heads, reps, d)
+        qg = q.reshape(b, t, kv_heads, reps, d)
         s = jnp.einsum(
-            "bqkgd,blkd->bkgql", qg, cache_k.value,
+            "btkgd,blkd->bkgtl", qg, cache_k.value,
             preferred_element_type=jnp.float32,
-        ) * (d ** -0.5)                                   # [B, KV, G, 1, L]
-        visible = jnp.arange(L)[None, None, None, None, :] <= i
+        ) * (d ** -0.5)                                   # [B, KV, G, T, L]
+        # query at (row, chunk offset tq) sees cache slots l <= start + tq:
+        # everything already cached plus the chunk's own causal prefix (the
+        # chunk was written above, so "future" chunk positions ARE in the
+        # cache and must be masked; -1e30 underflows to exactly 0 after
+        # softmax, so masked garbage contributes nothing)
+        visible = (jnp.arange(L)[None, None, None, None, :]
+                   <= pos[:, None, None, :, None])
         s = jnp.where(visible, s, -1e30)
         p = jax.nn.softmax(s, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bkgql,blkd->bqkgd", p, cache_v.value)
-        return self._o_proj(out.reshape(b, 1, h * d))
+        out = jnp.einsum("bkgtl,blkd->btkgd", p, cache_v.value)
+        return self._o_proj(out.reshape(b, t, h * d))
 
 
 class Mlp(nn.Module):
@@ -359,8 +389,9 @@ def _batch_sharded_attention(fn, q, k, v, segments, mesh):
     hs = mesh.shape["tp"]
     if q.shape[0] % bs or q.shape[1] % hs:
         return fn(q, k, v, causal=True, segment_ids=segments)
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from lzy_tpu.utils.compat import shard_map
 
     qkv_spec = P(("dp", "fsdp"), "tp", None, None)   # [B, H, T, D]
     if segments is None:
@@ -387,12 +418,29 @@ def _anchor(x, mesh, *logical_axes):
     flagship scale: tpu_evidence/AOT_ANALYSIS.md)."""
     if mesh is None or mesh.size == 1:
         return x
-    from jax.sharding import NamedSharding
+    from jax.sharding import NamedSharding, PartitionSpec
 
     from lzy_tpu.parallel.sharding import spec_for
+    from lzy_tpu.utils.compat import manual_axes_of
 
+    spec = spec_for(logical_axes)
+    manual = manual_axes_of(mesh)
+    if manual:
+        # inside a manual region (the pp pipeline runs the stage body under
+        # shard_map): a constraint naming a manual axis is rejected by both
+        # partitioners, so anchor only the still-auto axes
+        def strip(entry):
+            if entry is None:
+                return None
+            names = entry if isinstance(entry, tuple) else (entry,)
+            kept = tuple(a for a in names if a not in manual)
+            return kept if kept else None
+
+        spec = PartitionSpec(*(strip(e) for e in spec))
+        if all(e is None for e in spec):
+            return x
     return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, spec_for(logical_axes)))
+        x, NamedSharding(mesh, spec))
 
 
 def _embed_lookup(table, tokens, *, one_hot: bool):
@@ -599,7 +647,11 @@ def pp_forward(params, tokens: jax.Array, cfg: LlamaConfig, mesh,
     if b % n_micro:
         raise ValueError(f"batch {b} not divisible by {n_micro} microbatches")
 
-    x = params["embed_tokens"].astype(cfg.dtype)[tokens]
+    # one-hot, not gather: same resharding-cliff avoidance as the dense
+    # path (_embed_lookup) — the gather's scatter-add transpose forces an
+    # involuntary full rematerialization on pp x fsdp meshes
+    x = _embed_lookup(params["embed_tokens"].astype(cfg.dtype), tokens,
+                      one_hot=True)
     mb = b // n_micro
     xm = x.reshape(n_micro, mb, t, x.shape[-1])
 

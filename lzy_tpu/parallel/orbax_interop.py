@@ -29,6 +29,27 @@ from typing import Any, Optional
 import jax
 
 
+def _raise_if_rank0_failed(err: Optional[BaseException], op: str,
+                           path: str) -> None:
+    """Broadcast rank 0's save/restore outcome BEFORE the collective that
+    follows it. Without this, a rank-0 orbax failure leaves every other
+    process parked forever in ``sync_global_devices`` /
+    ``broadcast_one_to_all`` (rank 0 raised and never arrives); with it,
+    the gang fails loudly together — rank 0 re-raises the original
+    exception, everyone else raises a RuntimeError naming the op."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    failed = multihost_utils.broadcast_one_to_all(
+        np.int32(0 if err is None else 1))
+    if int(failed):
+        if err is not None:
+            raise err
+        raise RuntimeError(
+            f"{op} failed on process 0 (path {path!r}); see its log for "
+            f"the original exception")
+
+
 def export_orbax(state: Any, path: str, *, force: bool = False) -> str:
     """Write ``state`` (any pytree of arrays — a TrainState, bare params)
     as an Orbax PyTree checkpoint at ``path`` (a local directory on
@@ -46,17 +67,23 @@ def export_orbax(state: Any, path: str, *, force: bool = False) -> str:
         # compiles one program per parameter — minutes of compile time
         # for zero benefit)
         gathered = multihost_utils.process_allgather(state, tiled=True)
+        err: Optional[BaseException] = None
         if jax.process_index() == 0:
             # scope orbax's internal barriers to process 0 alone
             # (active_processes): the tree is already replicated host
             # numpy, so only rank 0 writes and nobody else must rendezvous
             # with orbax's save protocol
-            ckptr = ocp.Checkpointer(
-                ocp.PyTreeCheckpointHandler(),
-                multiprocessing_options=ocp.options.MultiprocessingOptions(
-                    primary_host=0, active_processes={0}))
-            ckptr.save(path, args=ocp.args.PyTreeSave(gathered),
-                       force=force)
+            try:
+                ckptr = ocp.Checkpointer(
+                    ocp.PyTreeCheckpointHandler(),
+                    multiprocessing_options=(
+                        ocp.options.MultiprocessingOptions(
+                            primary_host=0, active_processes={0})))
+                ckptr.save(path, args=ocp.args.PyTreeSave(gathered),
+                           force=force)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                err = e
+        _raise_if_rank0_failed(err, "export_orbax", path)
         # nobody returns before the write is durable (a reader on any
         # host may act on the returned path)
         multihost_utils.sync_global_devices("lzy_tpu_export_orbax")
@@ -116,6 +143,8 @@ def _import_orbax_multihost(path: str, template: Optional[Any],
             "multi-host import_orbax needs template= (and usually "
             "shardings=): non-zero processes cannot discover the tree "
             "structure from a checkpoint they cannot read")
+    err: Optional[BaseException] = None
+    host_tree = None
     if jax.process_index() == 0:
         # barriers scoped to rank 0 (same reasoning as the export side):
         # an unscoped restore would rendezvous with ALL processes while
@@ -123,19 +152,23 @@ def _import_orbax_multihost(path: str, template: Optional[Any],
         # the template's structure: a bare restore dict-ifies NamedTuple
         # optimizer states, and broadcast_one_to_all would then see
         # different pytree structures per process.
-        ckptr = ocp.Checkpointer(
-            ocp.PyTreeCheckpointHandler(),
-            multiprocessing_options=ocp.options.MultiprocessingOptions(
-                primary_host=0, active_processes={0}))
-        abstract = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), template)
-        host_tree = ckptr.restore(
-            path, args=ocp.args.PyTreeRestore(
-                restore_args=ocp.checkpoint_utils.construct_restore_args(
-                    abstract)))
+        try:
+            ckptr = ocp.Checkpointer(
+                ocp.PyTreeCheckpointHandler(),
+                multiprocessing_options=ocp.options.MultiprocessingOptions(
+                    primary_host=0, active_processes={0}))
+            abstract = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), template)
+            host_tree = ckptr.restore(
+                path, args=ocp.args.PyTreeRestore(
+                    restore_args=ocp.checkpoint_utils.construct_restore_args(
+                        abstract)))
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            err = e
     else:
         host_tree = jax.tree_util.tree_map(
             lambda a: np.zeros(a.shape, a.dtype), template)
+    _raise_if_rank0_failed(err, "import_orbax", path)
     host_tree = multihost_utils.broadcast_one_to_all(host_tree)
     if shardings is None:
         return host_tree

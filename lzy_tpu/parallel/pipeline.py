@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from lzy_tpu.utils.compat import shard_map
 
 
 def pipeline_apply(
@@ -111,7 +111,11 @@ def pipeline_apply(
         buf0 = jnp.zeros(micro_shape, dtype) + zero_v
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
-        aux0 = jnp.zeros((), jnp.float32) + zero_v.astype(jnp.float32)
+        # shape (1,), not scalar: legacy shard_map's partial-eval stamps
+        # residuals with a dim-0 sharding, which is ill-formed for rank-0
+        # arrays — any scalar crossing the forward/backward split aborts
+        # grad tracing. Kept 1-D through the region, squeezed outside.
+        aux0 = jnp.zeros((1,), jnp.float32) + zero_v.astype(jnp.float32)
 
         def tick(carry, t):
             buf_in, outs, aux_acc = carry
@@ -151,7 +155,8 @@ def pipeline_apply(
         if with_aux:
             # sum over stages (each rank accumulated its own layers' aux),
             # mean over microbatches — equal micro sizes make this exactly
-            # the dense full-batch aux
+            # the dense full-batch aux; still (1,) at the boundary (see
+            # the aux0 note)
             return outs, lax.psum(aux_acc, axis) / n_micro
         return outs
 
@@ -180,5 +185,5 @@ def pipeline_apply(
     )(stage_params, x.astype(jnp.float32))
     if with_aux:
         y, aux = out
-        return y.astype(dtype), aux
+        return y.astype(dtype), aux[0]
     return out.astype(dtype)

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from lzy_tpu.utils.compat import inside_manual, shard_map
 
 _NEG_INF = -1e30
 
@@ -152,8 +152,7 @@ def ring_attention(
     else:
         fn, in_specs, args = (local_fn, (q_spec, q_spec, q_spec, seg_spec),
                               (q, k, v, segment_ids))
-    ctx = jax.sharding.get_abstract_mesh()
-    if ctx is not None and not ctx.empty and axis in ctx.manual_axes:
+    if inside_manual(axis):
         if segment_ids is not None:
             raise ValueError(
                 "packed segments do not compose with ring attention inside "

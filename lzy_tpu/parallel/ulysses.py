@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from lzy_tpu.utils.compat import inside_manual, shard_map
 
 
 def ulysses_attention(
@@ -87,8 +87,7 @@ def ulysses_attention(
     else:
         fn, in_specs, args = (local_fn, (q_spec, q_spec, q_spec, seg_spec),
                               (q, k, v, segment_ids))
-    ctx = jax.sharding.get_abstract_mesh()
-    if ctx is not None and not ctx.empty and axis in ctx.manual_axes:
+    if inside_manual(axis):
         # Composition with the pp pipeline (same shape as ring.py): we are
         # already inside a manual region holding the sp axis, the inputs
         # are per-rank chunks, and the all-to-alls run directly against

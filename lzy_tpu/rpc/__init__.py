@@ -2,6 +2,7 @@ from lzy_tpu.rpc.control import (
     ControlPlaneServer,
     RpcAllocatorClient,
     RpcChannelsClient,
+    RpcInferenceClient,
     RpcWorkerClient,
     RpcWorkflowClient,
 )
@@ -11,6 +12,7 @@ __all__ = [
     "ControlPlaneServer",
     "RpcAllocatorClient",
     "RpcChannelsClient",
+    "RpcInferenceClient",
     "RpcWorkerClient",
     "RpcWorkflowClient",
     "JsonRpcClient",

@@ -320,6 +320,20 @@ class ControlPlaneServer:
                         not_after=_parse_dt(p.get("not_after")),
                         token=p.get("token"))]},
             })
+        inference = getattr(cluster, "inference_service", None)
+        if inference is not None:
+            handlers.update({
+                # inference surface (serving plane; serve.py --serve-model):
+                # blocking generate rides the same gRPC stack — deadlines,
+                # status codes, and backpressure as UNAVAILABLE
+                "InferGenerate": lambda p: inference.generate(
+                    p["prompt"],
+                    max_new_tokens=int(p.get("max_new_tokens", 64)),
+                    timeout_s=p.get("timeout_s"),
+                    token=p.get("token")),
+                "InferStats": lambda p: inference.stats(
+                    token=p.get("token")),
+            })
         if debug:
             def _dbg(fn):
                 def handler(p):
@@ -686,6 +700,49 @@ class RpcWhiteboardClient:
             "token": _token_value(self._token),
         }, retry=True)["manifests"]
         return [self._manifest(d) for d in docs]
+
+    def close(self) -> None:
+        if self._owns_client:
+            self._client.close()
+
+
+class RpcInferenceClient:
+    """Client for the serving plane (``serve.py --serve-model``): blocking
+    token-level generate plus engine stats, over the control plane's gRPC
+    port. Generation is NOT idempotent, so ``generate`` never retries —
+    a lost reply after decoding must surface, not silently decode twice.
+    Admission backpressure arrives as UNAVAILABLE *before any work
+    happens*; that one IS safe for the caller to retry with backoff.
+    ``stats`` is read-only and retries transparently."""
+
+    def __init__(self, address: Optional[str] = None, *, token=None,
+                 client: Optional[JsonRpcClient] = None):
+        if client is None:
+            if address is None:
+                raise ValueError("pass address or client")
+            client = JsonRpcClient(address, timeout_s=180.0)
+            self._owns_client = True
+        else:
+            self._owns_client = False
+        self._client = client
+        self._token = token
+
+    def generate(self, prompt, *, max_new_tokens: int = 64,
+                 timeout_s: Optional[float] = None) -> dict:
+        """``prompt``: list of token ids. Returns ``{"request_id",
+        "tokens", "ttft_ms", "model"}`` (generated ids only, no echo)."""
+        rpc_timeout = (timeout_s or 120.0) + 30.0   # server waits first
+        return self._client.call("InferGenerate", {
+            "prompt": list(prompt),
+            "max_new_tokens": int(max_new_tokens),
+            "timeout_s": timeout_s,
+            "token": _token_value(self._token),
+        }, timeout_s=rpc_timeout)
+
+    def stats(self) -> dict:
+        return self._client.call("InferStats", {
+            "token": _token_value(self._token),
+        }, retry=True)
 
     def close(self) -> None:
         if self._owns_client:

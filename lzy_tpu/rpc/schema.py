@@ -266,6 +266,12 @@ REQUESTS: Dict[str, Schema] = {
         "tags": f(list),
         "not_before": f(str),
         "not_after": f(str), **_TOKEN}),
+    # inference surface (serving plane; serve.py --serve-model)
+    "InferGenerate": Schema("InferGenerateRequest", {
+        "prompt": f(list, required=True),
+        "max_new_tokens": f(int),
+        "timeout_s": f(float, int), **_TOKEN}),
+    "InferStats": Schema("InferStatsRequest", {**_TOKEN}),
     # status surface
     "GetStatus": Schema("GetStatusRequest", {
         "view": f(str, required=True), **_TOKEN}),

@@ -341,11 +341,11 @@ class StatusConsole:
                 return
             user = self._scope(subject)
         state = graphviz.load_graph_state(self._store, graph_op_id)
-        if state is None:
+        if state is None or (user is not None and state.get("user") != user):
+            # one answer for unknown AND not-owned: a scoped caller must
+            # not be able to probe which graph ids exist (a 403 here was
+            # an enumeration oracle — ADVICE r5)
             self._json(req, 404, {"error": f"unknown graph {graph_op_id!r}"})
-            return
-        if user is not None and state.get("user") != user:
-            self._json(req, 403, {"error": "not your graph"})
             return
         if want_dot:
             self._send(req, 200, "text/vnd.graphviz; charset=utf-8",

@@ -39,6 +39,14 @@ def _edges(graph_doc: Dict[str, Any]) -> List[Tuple[str, str, str]]:
     return edges
 
 
+def _dot_quote(s: Any) -> str:
+    """Escape for a double-quoted dot ID: backslashes first, then quotes,
+    then literal newlines (task/entry names are user input — an unescaped
+    ``"`` would close the quote and inject attributes or nodes)."""
+    return (str(s).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\r", "").replace("\n", "\\n"))
+
+
 def graph_dot(state: Dict[str, Any]) -> str:
     """Graphviz dot for one graph op (``record.state`` of ``exec_graph``).
 
@@ -55,13 +63,14 @@ def graph_dot(state: Dict[str, Any]) -> str:
         tid = t["id"]
         status = (tasks.get(tid) or {}).get("status", "WAITING")
         fill = _STATUS_FILL.get(status, "#e8e8ee")
-        label = f"{t.get('name') or tid}\\n[{status}]"
+        label = f"{_dot_quote(t.get('name') or tid)}\\n[{_dot_quote(status)}]"
         if t.get("gang_size", 1) > 1:
-            label += f"\\ngang x{t['gang_size']}"
+            label += f"\\ngang x{_dot_quote(t['gang_size'])}"
         lines.append(
-            f'  "{tid}" [label="{label}", fillcolor="{fill}"];')
+            f'  "{_dot_quote(tid)}" [label="{label}", fillcolor="{fill}"];')
     for src, dst, name in _edges(graph_doc):
-        lines.append(f'  "{src}" -> "{dst}" [label="{name}"];')
+        lines.append(f'  "{_dot_quote(src)}" -> "{_dot_quote(dst)}" '
+                     f'[label="{_dot_quote(name)}"];')
     lines.append("}")
     return "\n".join(lines) + "\n"
 

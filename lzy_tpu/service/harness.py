@@ -65,6 +65,7 @@ class InProcessCluster:
         execution_ttl_s: float = 86_400.0,     # stale-execution reap age
         backend=None,                     # explicit VmBackend (e.g. GKE)
         leader_lease_ttl_s: float = 30.0,      # control-plane leader lease
+        inference_service=None,           # serving plane (serve --serve-model)
     ):
         self._rpc_port = rpc_port
         self.storage_uri = storage_uri
@@ -128,7 +129,7 @@ class InProcessCluster:
                 container_runtime=container_runtime, worker_mode=worker_mode,
                 worker_pythonpath=worker_pythonpath, debug_rpc=debug_rpc,
                 gc_period_s=gc_period_s, execution_ttl_s=execution_ttl_s,
-                backend=backend,
+                backend=backend, inference_service=inference_service,
             )
         except BaseException:
             if self._lease_acquired:
@@ -156,7 +157,8 @@ class InProcessCluster:
                        max_running_tasks, poll_period_s, vm_boot_delay_s,
                        p2p_spill_root, with_iam, container_runtime,
                        worker_mode, worker_pythonpath, debug_rpc,
-                       gc_period_s, execution_ttl_s, backend):
+                       gc_period_s, execution_ttl_s, backend,
+                       inference_service=None):
         self.executor = OperationsExecutor(self.store, workers=workers)
         self.channels = ChannelManager(store=self.store)
         self.serializers = default_registry()
@@ -223,6 +225,14 @@ class InProcessCluster:
             self.whiteboard_index, iam=self.iam,
         )
         self._debug_rpc = debug_rpc
+        # serving plane: the ControlPlaneServer registers the inference
+        # surface when this is set, and the cluster's IAM guards it like
+        # every other route (wired here so the service never runs open on
+        # an IAM-enabled plane)
+        self.inference_service = inference_service
+        if (inference_service is not None
+                and getattr(inference_service, "iam", None) is None):
+            inference_service.iam = self.iam
         if worker_mode == "process":
             from lzy_tpu.rpc import ControlPlaneServer
 
@@ -343,6 +353,14 @@ class InProcessCluster:
         for vm in list(self.allocator.vms()):
             try:
                 self.backend.destroy(vm)
+            except Exception:
+                pass
+        if self.inference_service is not None:
+            # stop the engine loop before the RPC server: a decode thread
+            # outliving the plane would keep finishing requests nobody can
+            # collect
+            try:
+                self.inference_service.close()
             except Exception:
                 pass
         if self.rpc_server is not None:

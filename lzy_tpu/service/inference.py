@@ -1,0 +1,135 @@
+"""Inference service: the serving engine on the control-plane RPC stack.
+
+The reference platform's control plane schedules *workflows*; this module
+makes the same deployable process also serve *models* — the
+``--serve-model`` mode of ``lzy_tpu.service.serve`` builds one of these and
+hands it to ``InProcessCluster``, whose ``ControlPlaneServer`` registers
+the ``InferGenerate``/``InferStats`` RPC methods next to the workflow
+surface (one gRPC port, one IAM, one metrics registry).
+
+Auth model mirrors the rest of the plane: with IAM wired every call needs
+a bearer token (any authenticated subject may generate; stats too — they
+carry no tenant data, only engine health); without IAM the surface is the
+single-tenant operator tool the rest of the plane is.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from lzy_tpu.serving.scheduler import AdmissionError, any_to_tokens
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+#: named model configs --serve-model accepts; weights are random-initialized
+#: unless --model-checkpoint points at an Orbax export of the same config
+MODEL_CONFIGS = ("tiny", "llama3_8b", "llama3_70b")
+
+
+class InferenceService:
+    """Thin RPC-facing wrapper over an :class:`InferenceEngine`.
+
+    ``max_waiters`` bounds how many RPC handler threads may BLOCK in
+    ``generate`` at once: the control plane's gRPC pool is shared with the
+    workflow surface (16 threads), and without a cap a burst of generate
+    calls parked in ``req.result()`` would starve worker heartbeats and
+    task RPCs on the same port. Beyond the cap, callers get the same
+    ``Unavailable`` backpressure a full queue produces."""
+
+    def __init__(self, engine, model_name: str = "custom", iam=None,
+                 max_waiters: int = 8):
+        import threading
+
+        self.engine = engine
+        self.model_name = model_name
+        self.iam = iam        # harness wires the cluster's IAM in here
+        self._waiters = threading.BoundedSemaphore(max_waiters)
+
+    def _auth(self, token: Optional[str]) -> None:
+        if self.iam is not None:
+            self.iam.authenticate(token)
+
+    def generate(self, prompt, *, max_new_tokens: int = 64,
+                 token: Optional[str] = None,
+                 timeout_s: Optional[float] = None) -> dict:
+        """Blocking generate: admit, wait, return generated token ids.
+        Backpressure (full queue OR all waiter threads busy) surfaces as
+        ``Unavailable`` BEFORE any work happens — safe for the caller to
+        retry with backoff; the plane never buffers unboundedly. On
+        timeout the request is cancelled so the engine stops spending
+        decode steps on it."""
+        self._auth(token)
+        from lzy_tpu.rpc.core import Unavailable
+
+        if not self._waiters.acquire(blocking=False):
+            raise Unavailable(
+                "all inference waiter threads are busy; retry later")
+        try:
+            try:
+                req = self.engine.submit(
+                    any_to_tokens(prompt),
+                    max_new_tokens=int(max_new_tokens))
+            except AdmissionError as e:
+                raise Unavailable(str(e)) from None
+            try:
+                tokens = req.result(timeout=timeout_s or 120.0)
+            except TimeoutError:
+                req.cancel()
+                raise
+        finally:
+            self._waiters.release()
+        ttft_ms = None
+        if req.first_token_at is not None:
+            ttft_ms = round(1000 * (req.first_token_at - req.submitted_at), 3)
+        return {"request_id": req.id, "tokens": tokens,
+                "ttft_ms": ttft_ms, "model": self.model_name}
+
+    def stats(self, *, token: Optional[str] = None) -> dict:
+        self._auth(token)
+        return {"model": self.model_name, **self.engine.stats().doc()}
+
+    def close(self) -> None:
+        self.engine.close()
+
+
+def build_inference_service(
+    model: str,
+    *,
+    slots: int = 4,
+    max_queue: int = 64,
+    eos_token: Optional[int] = None,
+    checkpoint: Optional[str] = None,
+    seed: int = 0,
+    prefill_chunk: int = 64,
+    start: bool = True,
+) -> InferenceService:
+    """Construct the engine for a named config and wrap it for RPC.
+
+    ``model`` is one of :data:`MODEL_CONFIGS`. Without ``checkpoint`` the
+    weights are random-initialized — enough for smoke tests and load
+    drills; real deployments pass an Orbax export
+    (``parallel.orbax_interop.export_orbax``) of the matching config.
+    """
+    import jax
+
+    from lzy_tpu.models import llama, unbox
+    from lzy_tpu.serving import InferenceEngine
+
+    if model not in MODEL_CONFIGS:
+        raise ValueError(
+            f"unknown --serve-model {model!r}; known: {MODEL_CONFIGS}")
+    cfg = getattr(llama.LlamaConfig, model)()
+    boxed, _ = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    params: Any = unbox(boxed)
+    if checkpoint:
+        from lzy_tpu.parallel.orbax_interop import import_orbax
+
+        _LOG.info("restoring %s weights from %s", model, checkpoint)
+        params = import_orbax(checkpoint, template=params)
+    engine = InferenceEngine(
+        cfg, params, slots=slots, max_queue=max_queue, eos_token=eos_token,
+        prefill_chunk=prefill_chunk, seed=seed)
+    if start:
+        engine.start()
+    return InferenceService(engine, model_name=model)

@@ -57,9 +57,36 @@ def main(argv=None) -> int:
                         help="expose the fault-injection surface (never in "
                              "production)")
     parser.add_argument("--gc-period-s", type=float, default=300.0)
+    parser.add_argument("--serve-model", default=None,
+                        help="also serve an LLM from this process: a named "
+                             "config (tiny, llama3_8b, llama3_70b) exposed "
+                             "as InferGenerate/InferStats on the same gRPC "
+                             "port (docs/serving.md)")
+    parser.add_argument("--model-checkpoint", default=None,
+                        help="Orbax export to restore --serve-model weights "
+                             "from (random init without it)")
+    parser.add_argument("--serve-slots", type=int, default=4,
+                        help="continuous-batching decode slots")
+    parser.add_argument("--serve-queue", type=int, default=64,
+                        help="admission queue depth (beyond it requests are "
+                             "shed with UNAVAILABLE)")
+    parser.add_argument("--serve-eos-token", type=int, default=None,
+                        help="token id that terminates generation early")
     args = parser.parse_args(argv)
 
     from lzy_tpu.service import InProcessCluster
+
+    inference_service = None
+    if args.serve_model:
+        from lzy_tpu.service.inference import build_inference_service
+
+        inference_service = build_inference_service(
+            args.serve_model,
+            slots=args.serve_slots,
+            max_queue=args.serve_queue,
+            eos_token=args.serve_eos_token,
+            checkpoint=args.model_checkpoint,
+        )
 
     backend = None
     if args.backend == "gke":
@@ -84,10 +111,13 @@ def main(argv=None) -> int:
         rpc_port=args.port,
         debug_rpc=args.debug_rpc,
         gc_period_s=args.gc_period_s,
+        inference_service=inference_service,
     )
     server = cluster.serve(args.port)
+    model = f", model={args.serve_model}" if args.serve_model else ""
     print(f"lzy-tpu control plane serving on {server.address} "
-          f"(backend={args.backend}, iam={'on' if args.with_iam else 'off'})",
+          f"(backend={args.backend}, "
+          f"iam={'on' if args.with_iam else 'off'}{model})",
           flush=True)
 
     stop = threading.Event()
@@ -99,6 +129,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, handle)
     signal.signal(signal.SIGINT, handle)
     stop.wait()
+    if inference_service is not None:
+        inference_service.close()
     cluster.shutdown()
     return 0
 

@@ -584,10 +584,9 @@ class WorkerAgent:
         return func if callable(func) else obj
 
     def _store_exception(self, task: TaskDesc, e: BaseException, tb: str) -> str:
-        try:
-            e.add_note(f"[remote traceback from {self.vm_id}]\n{tb}")
-        except AttributeError:
-            pass
+        from lzy_tpu.utils.compat import add_exception_note
+
+        add_exception_note(e, f"[remote traceback from {self.vm_id}]\n{tb}")
         import cloudpickle
 
         try:

@@ -1,0 +1,29 @@
+"""LLM serving plane: batched prefill + continuous batching.
+
+The training side of the platform runs one jitted step over a fixed batch;
+serving traffic does not arrive that way — requests come and go, prompts
+have wildly different lengths, and throughput comes from keeping every
+decode slot busy (the two mechanisms the Gemma-on-TPU study credits with
+most TPU serving throughput: single-pass prefill and continuous batching).
+
+- ``scheduler``: request admission — a bounded FIFO with backpressure.
+- ``engine``: the fixed-capacity slot batch. New requests are prefilled
+  (one forward pass per bucketed prompt chunk, not one per token) into a
+  fresh batch-1 cache and spliced into a free slot of the live decode
+  batch; finished slots free on EOS/limit; one jitted decode step advances
+  every active slot at once and the loop idles when all slots drain.
+
+Expose over the control plane with ``lzy_tpu.service.inference`` (the
+``--serve-model`` flag of ``lzy_tpu.service.serve``).
+"""
+
+from lzy_tpu.serving.engine import EngineStats, InferenceEngine
+from lzy_tpu.serving.scheduler import AdmissionError, Request, RequestQueue
+
+__all__ = [
+    "AdmissionError",
+    "EngineStats",
+    "InferenceEngine",
+    "Request",
+    "RequestQueue",
+]

@@ -1,0 +1,378 @@
+"""Continuous-batching inference engine: a fixed slot batch over one model.
+
+The decode hot loop is ONE jitted step over a ``[slots, ...]`` KV cache
+whose per-row positions live in a ``[slots]`` cache index
+(``LlamaConfig.decode_slot_index``). Requests are admitted mid-flight:
+
+- **prefill on arrival**: the prompt runs through the model as batch-1
+  bucketed chunks (``models.generate.batched_prefill`` — one forward pass
+  per chunk, not per token), producing the request's first token and a
+  fresh ``[1, L, ...]`` cache that is spliced into a free slot of the live
+  batch between decode steps. A request admitted mid-decode starts
+  generating on the very next step — nobody waits for the running batch to
+  drain.
+- **slot free on EOS**: a finished row leaves its slot immediately; the
+  slot's cache rows are fully overwritten by the next insertion and the
+  causal mask never lets a new request see a predecessor's keys (index is
+  reset on free), so tokens cannot leak across requests.
+- **all-done early exit**: with every slot idle the loop parks on the
+  queue's event instead of spinning the device.
+
+Sampling is engine-wide (greedy by default). Under ``temperature>0`` the
+rng stream is shared by the whole batch, so a request's sampled tokens
+depend on what else is in flight — per-request determinism needs
+``temperature=0`` (the serving default).
+
+TTFT, generated tokens, decode step latency, queue depth and slot
+occupancy are exported via ``lzy_tpu.utils.metrics.REGISTRY`` (scraped by
+``/metrics`` on both the console and the metrics server).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lzy_tpu.models.generate import (
+    batched_prefill, decode_config, init_cache, make_prefill_step,
+    sample_token)
+from lzy_tpu.models.llama import Llama, LlamaConfig
+from lzy_tpu.serving.scheduler import AdmissionError, Request, RequestQueue
+from lzy_tpu.utils.log import get_logger
+from lzy_tpu.utils.metrics import REGISTRY
+
+_LOG = get_logger(__name__)
+
+_TTFT = REGISTRY.histogram(
+    "lzy_inference_ttft_seconds",
+    "submit-to-first-token latency (includes queueing and prefill)",
+    buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0, 60.0))
+_STEP = REGISTRY.histogram(
+    "lzy_inference_decode_step_seconds",
+    "one jitted decode step over the slot batch",
+    buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 1.0, 5.0))
+_TOKENS = REGISTRY.counter(
+    "lzy_inference_tokens_total", "generated tokens (all requests)")
+_REQUESTS = REGISTRY.counter(
+    "lzy_inference_requests_total", "finished requests by outcome")
+_BUSY = REGISTRY.gauge(
+    "lzy_inference_slots_busy", "decode slots currently generating")
+_SLOTS = REGISTRY.gauge(
+    "lzy_inference_slots", "decode slot capacity")
+_TPS = REGISTRY.gauge(
+    "lzy_inference_tokens_per_s",
+    "instantaneous decode throughput (active slots / last step wall time)")
+
+
+@dataclasses.dataclass
+class EngineStats:
+    slots: int
+    busy: int
+    queue_depth: int
+    requests_finished: int
+    tokens_generated: int
+
+    def doc(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class InferenceEngine:
+    """Serve ``generate``-style requests from a shared slot batch.
+
+    Drive it either with the background loop (``start()``/``close()``, the
+    serving-front mode) or synchronously with ``step()`` from one thread
+    (the deterministic test mode) — not both at once.
+    """
+
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        params: Any,
+        *,
+        slots: int = 4,
+        max_queue: int = 64,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_token: Optional[int] = None,
+        prefill_chunk: int = 64,
+        seed: int = 0,
+    ):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        base = decode_config(cfg)
+        self.cfg = base
+        self.params = params
+        self.slots = slots
+        self.eos_token = eos_token
+        self.prefill_chunk = prefill_chunk
+        self._temperature = temperature
+        self._top_k, self._top_p = top_k, top_p
+        self._rng = jax.random.PRNGKey(seed)
+
+        # decode model: [slots] per-row cache positions
+        self._model = Llama(dataclasses.replace(base, decode_slot_index=True))
+        self._cache = init_cache(lambda: self._model.init(
+            jax.random.PRNGKey(0), jnp.zeros((slots, 1), jnp.int32)))
+        # prefill model: batch-1, scalar index (what batched_prefill writes)
+        self._prefill_model = Llama(base)
+        self._prefill_step = make_prefill_step(self._prefill_model)
+        # abstract cache shapes ONCE: tracing the full model init on every
+        # admission would sit directly on the TTFT path
+        self._prefill_cache_shapes = jax.eval_shape(
+            lambda: self._prefill_model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32))
+        )["cache"]
+
+        def decode_step(cache, params, tokens, rng):
+            logits, updated = self._model.apply(
+                {"params": params, "cache": cache}, tokens, mutable=["cache"]
+            )
+            nxt, rng = sample_token(logits[:, -1], temperature, rng,
+                                    top_k=top_k, top_p=top_p)
+            return updated["cache"], nxt, rng
+
+        self._decode_step = jax.jit(decode_step, donate_argnums=(0,))
+
+        self.queue = RequestQueue(max_queue)
+        self._active: List[Optional[Request]] = [None] * slots
+        self._cur = np.zeros((slots,), np.int32)   # last token per slot
+        self._finished = 0
+        self._tokens_out = 0
+        self._stop = threading.Event()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        _SLOTS.set(float(slots))
+        _BUSY.set(0.0)
+
+    # -- request surface ---------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], *, max_new_tokens: int = 64,
+               request_id: Optional[str] = None) -> Request:
+        """Admit a request (raises ``AdmissionError`` under backpressure,
+        ``ValueError`` if it can never fit the cache). Returns the
+        :class:`Request`; wait with ``request.result(timeout)``."""
+        if self._closed:
+            # fail fast instead of admitting into a queue no loop will ever
+            # drain (shutdown stops the engine before the RPC server, so
+            # this window is reachable over the wire; the front maps it to
+            # the same retryable Unavailable a full queue produces)
+            raise AdmissionError("inference engine is shut down")
+        prompt = list(prompt)
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        if len(prompt) + max_new_tokens > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_seq_len ({self.cfg.max_seq_len})")
+        req = Request(prompt, max_new_tokens, request_id=request_id)
+        return self.queue.submit(req)
+
+    # -- engine loop -------------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduling round: reap cancelled slots, admit waiting
+        requests into free slots (prefill on arrival), then advance every
+        active slot by one jitted decode step. Returns False when there
+        was nothing to do."""
+        self._reap_cancelled()
+        admitted = self._admit()
+        stepped = self._decode()
+        return admitted or stepped
+
+    def _reap_cancelled(self) -> None:
+        """Free slots whose waiter abandoned the request (client timeout):
+        decode steps are the scarce resource, and spending them on tokens
+        nobody will read starves live requests."""
+        for slot, req in enumerate(self._active):
+            if req is not None and req.cancelled:
+                _REQUESTS.inc(status="cancelled")
+                req.finish(error="cancelled")
+                self._free(slot)
+
+    def _admit(self) -> bool:
+        admitted = False
+        while any(r is None for r in self._active):
+            req = self.queue.pop()
+            if req is None:
+                break
+            if req.cancelled:
+                _REQUESTS.inc(status="cancelled")
+                req.finish(error="cancelled")
+                continue
+            slot = self._active.index(None)
+            try:
+                self._prefill_into(slot, req)
+            except Exception as e:  # noqa: BLE001 — request-scoped failure
+                _LOG.warning("prefill failed for %s: %s", req.id, e)
+                _REQUESTS.inc(status="error")
+                req.finish(error=f"{type(e).__name__}: {e}")
+                continue
+            admitted = True
+            # at most ONE prefill per scheduling round: admissions run
+            # between decode steps, so draining a burst of long prompts
+            # here would stall every in-flight request's token stream for
+            # the whole burst — one per round caps the inter-token latency
+            # spike at a single prefill while the rest of the queue joins
+            # over the next few rounds
+            break
+        _BUSY.set(float(sum(r is not None for r in self._active)))
+        return admitted
+
+    def _prefill_into(self, slot: int, req: Request) -> None:
+        prompt = jnp.asarray([req.prompt], jnp.int32)
+        # fresh zeros each time (prefill donates the cache buffers); the
+        # shapes were computed once at construction
+        cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            self._prefill_cache_shapes)
+        cache, last_logits = batched_prefill(
+            self._prefill_model, cache, self.params, prompt,
+            chunk=self.prefill_chunk, max_seq_len=self.cfg.max_seq_len,
+            prefill_step=self._prefill_step)
+        first, self._rng = sample_token(
+            last_logits, self._temperature, self._rng,
+            top_k=self._top_k, top_p=self._top_p)
+        first = int(first[0])
+        now = time.monotonic()
+        req.first_token_at = now
+        _TTFT.observe(now - req.submitted_at)
+
+        # splice the prefilled batch-1 cache into the slot's rows; the
+        # scalar index leaves land in the [slots] index at this row
+        def ins(big, small):
+            if small.ndim == 0:
+                return big.at[slot].set(small.astype(big.dtype))
+            return big.at[slot].set(small[0])
+
+        self._cache = jax.tree_util.tree_map(ins, self._cache, cache)
+        self._emit(slot, req, first, active=False)
+        if req.done:
+            self._free(slot)      # one-token request: slot never activates
+        else:
+            self._active[slot] = req
+            self._cur[slot] = first
+
+    def _decode(self) -> bool:
+        if not any(r is not None for r in self._active):
+            return False
+        t0 = time.monotonic()
+        tokens = jnp.asarray(self._cur[:, None])
+        self._cache, nxt, self._rng = self._decode_step(
+            self._cache, self.params, tokens, self._rng)
+        nxt = np.asarray(nxt)        # one host transfer for the whole batch
+        dt = time.monotonic() - t0
+        _STEP.observe(dt)
+        n_active = sum(r is not None for r in self._active)
+        _TPS.set(n_active / dt if dt > 0 else 0.0)
+        for slot, req in enumerate(self._active):
+            if req is None:
+                continue
+            self._emit(slot, req, int(nxt[slot]), active=True)
+        _BUSY.set(float(sum(r is not None for r in self._active)))
+        return True
+
+    def _emit(self, slot: int, req: Request, token: int, *,
+              active: bool) -> None:
+        """Record one generated token; finish + free the slot on EOS or
+        length limit. ``active`` distinguishes a slot-resident request
+        (needs freeing) from one still mid-insertion."""
+        req.tokens.append(token)
+        self._tokens_out += 1
+        _TOKENS.inc()
+        hit_eos = self.eos_token is not None and token == self.eos_token
+        if hit_eos or len(req.tokens) >= req.max_new_tokens:
+            req.finish()
+            self._finished += 1
+            _REQUESTS.inc(status="ok")
+            if active:
+                self._free(slot)
+        elif active:
+            self._cur[slot] = token
+
+    def _free(self, slot: int) -> None:
+        self._active[slot] = None
+        self._cur[slot] = 0
+        # rewind the freed row's position: an idle slot must not keep
+        # attending over (or writing past) a dead request's cache, and the
+        # next insertion overwrites the rows wholesale anyway
+        self._cache = jax.tree_util.tree_map(
+            lambda leaf: leaf.at[slot].set(0) if leaf.ndim == 1 else leaf,
+            self._cache)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "InferenceEngine":
+        """Run the engine loop in a daemon thread (the serving-front mode)."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            try:
+                while not self._stop.is_set():
+                    if not self.step():
+                        # all slots drained and the queue is empty: park
+                        # until the next submit instead of spinning the
+                        # device
+                        self.queue.work_available.wait(timeout=0.5)
+                        self.queue.work_available.clear()
+            except BaseException:  # noqa: BLE001 — engine-fatal
+                # a step()-level failure (device OOM, a poisoned compile) is
+                # engine-fatal, not request-scoped: without this the daemon
+                # thread would die silently while the RPC surface stays up —
+                # every in-flight waiter burning its full timeout and every
+                # future submit queueing forever. Fail loudly: log, fail all
+                # outstanding requests, and refuse new admissions.
+                _LOG.exception("inference engine loop died; failing all "
+                               "outstanding requests")
+                self._closed = True
+                for req in self.queue.drain():
+                    _REQUESTS.inc(status="error")
+                    req.finish(error="engine loop died")
+                for slot, req in enumerate(self._active):
+                    if req is not None:
+                        _REQUESTS.inc(status="error")
+                        req.finish(error="engine loop died")
+                        self._active[slot] = None
+                _BUSY.set(0.0)
+
+        self._thread = threading.Thread(
+            target=loop, name="inference-engine", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._closed = True      # refuse admissions before the loop stops
+        self._stop.set()
+        self.queue.work_available.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        for req in self.queue.drain():
+            _REQUESTS.inc(status="shed")
+            req.finish(error="engine shutting down")
+        for slot, req in enumerate(self._active):
+            if req is not None:
+                _REQUESTS.inc(status="shed")
+                req.finish(error="engine shutting down")
+                self._active[slot] = None
+        _BUSY.set(0.0)
+
+    def stats(self) -> EngineStats:
+        return EngineStats(
+            slots=self.slots,
+            busy=sum(r is not None for r in self._active),
+            queue_depth=self.queue.depth(),
+            requests_finished=self._finished,
+            tokens_generated=self._tokens_out,
+        )

@@ -1,0 +1,126 @@
+"""Request admission for the inference engine.
+
+A bounded FIFO with explicit backpressure: ``submit`` raises
+``AdmissionError`` when the queue is full (the serving front maps it to a
+retryable RESOURCE_EXHAUSTED-style error) instead of buffering unboundedly
+— under overload the caller should shed or retry elsewhere, not pile
+latency onto everyone already queued. Queue depth is exported as a gauge so
+operators see saturation before users do.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, List, Optional, Sequence
+
+from lzy_tpu.utils.metrics import REGISTRY
+
+_QUEUE_DEPTH = REGISTRY.gauge(
+    "lzy_inference_queue_depth", "requests admitted but not yet prefilled")
+_REJECTED = REGISTRY.counter(
+    "lzy_inference_rejected_total", "requests refused at admission")
+
+
+class AdmissionError(RuntimeError):
+    """The request queue is full; retry later (backpressure, not failure)."""
+
+
+_ids = itertools.count(1)
+
+
+class Request:
+    """One generation request riding through the engine.
+
+    ``tokens`` accumulates generated ids (no prompt echo); ``result()``
+    blocks until the engine marks the request finished. ``error`` carries
+    an engine-side failure (e.g. over-long prompt at prefill time)."""
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int,
+                 request_id: Optional[str] = None):
+        self.id = request_id or f"req-{next(_ids)}"
+        self.prompt: List[int] = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.tokens: List[int] = []
+        self.error: Optional[str] = None
+        self.cancelled = False
+        self.submitted_at = time.monotonic()
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self._done = threading.Event()
+
+    def cancel(self) -> None:
+        """Best-effort abandon (e.g. the waiting client timed out): a
+        queued request is dropped at pop time, a slot-resident one is
+        freed at the engine's next scheduling round — either way the
+        engine stops spending decode steps on tokens nobody will read."""
+        self.cancelled = True
+
+    def finish(self, error: Optional[str] = None) -> None:
+        self.error = error
+        self.finished_at = time.monotonic()
+        self._done.set()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Generated token ids (prompt excluded); raises on engine error or
+        timeout."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.id} not finished within {timeout}s")
+        if self.error:
+            raise RuntimeError(f"request {self.id} failed: {self.error}")
+        return list(self.tokens)
+
+
+class RequestQueue:
+    """Bounded FIFO; thread-safe; wakes the engine loop on submit."""
+
+    def __init__(self, max_depth: int = 64):
+        self.max_depth = max_depth
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        #: signalled on submit so an idle engine loop wakes immediately
+        self.work_available = threading.Event()
+
+    def submit(self, request: Request) -> Request:
+        with self._lock:
+            if len(self._q) >= self.max_depth:
+                _REJECTED.inc()
+                raise AdmissionError(
+                    f"inference queue full ({self.max_depth} waiting); "
+                    f"retry later")
+            self._q.append(request)
+            _QUEUE_DEPTH.set(float(len(self._q)))
+        self.work_available.set()
+        return request
+
+    def pop(self) -> Optional[Request]:
+        with self._lock:
+            req = self._q.popleft() if self._q else None
+            _QUEUE_DEPTH.set(float(len(self._q)))
+            return req
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def drain(self) -> List[Request]:
+        """Empty the queue (shutdown path); returns the unserved requests."""
+        with self._lock:
+            out = list(self._q)
+            self._q.clear()
+            _QUEUE_DEPTH.set(0.0)
+        return out
+
+
+def any_to_tokens(prompt: Any) -> List[int]:
+    """Normalize a wire-side prompt (list of ints) defensively."""
+    if not isinstance(prompt, (list, tuple)) or not prompt:
+        raise ValueError("prompt must be a non-empty list of token ids")
+    return [int(t) for t in prompt]

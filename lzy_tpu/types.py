@@ -14,8 +14,12 @@ from pathlib import Path
 from typing import Dict, Optional, Tuple
 
 
-class File(Path):
+class File(type(Path())):
     """Marker type for file-valued op arguments/results.
+
+    Subclasses the concrete flavour (``PosixPath``/``WindowsPath``) rather
+    than ``Path``: before Python 3.12 a bare ``Path`` subclass has no
+    ``_flavour`` and cannot be instantiated.
 
     A ``File`` result is stored as raw bytes in storage (no pickling) and
     re-materialized as a local file on the consumer side, like the reference's

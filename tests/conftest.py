@@ -17,8 +17,10 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+from lzy_tpu.utils.compat import request_cpu_devices  # noqa: E402
+
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+request_cpu_devices(8)
 
 import pytest  # noqa: E402
 

@@ -33,6 +33,35 @@ def get(console, path):
         return resp.status, resp.read().decode()
 
 
+class TestDotEscaping:
+    def test_hostile_task_names_cannot_inject_dot(self):
+        """Task/entry names are user input; quotes, backslashes, and
+        newlines must come out escaped, not close the dot string."""
+        from lzy_tpu.service.graphviz import graph_dot
+
+        evil = 'a"]; evil [label="pwned'
+        state = {
+            "graph": {"tasks": [
+                {"id": 't"1', "name": evil,
+                 "outputs": [{"id": "e1", "name": 'x"\ny\\z'}]},
+                {"id": "t2", "name": "b\nmultiline",
+                 "args": [{"id": "e1"}], "outputs": []},
+            ]},
+            "tasks": {},
+        }
+        dot = graph_dot(state)
+        # the classic injection — closing the quote to start a new node —
+        # must never survive unescaped
+        assert 'a"];' not in dot
+        assert 'evil [label="pwned' not in dot
+        assert '\\"' in dot
+        # real newlines in names become literal \n, keeping one statement
+        # per line (a raw newline would break the dot grammar mid-string)
+        assert not any(l.strip() in ("multiline", "y\\z")
+                       for l in dot.splitlines())
+        assert '"t2"' in dot and 'x\\"\\ny\\\\z' in dot
+
+
 class TestWebConsole:
     def test_overview_and_json_api(self, cluster):
         console = StatusConsole(cluster.store, bind_host="127.0.0.1")
@@ -418,7 +447,14 @@ class TestLoginScopingAndGraphs:
         with urllib.request.urlopen(req) as resp:
             page = resp.read().decode()
         assert "<svg" in page and "COMPLETED" in page
-        # bob may not read alice's graph
+        # bob may not read alice's graph — and must not be able to TELL
+        # it exists: not-owned answers exactly like unknown (a 403 here
+        # was a graph-id enumeration oracle)
         status, doc = request(console, "GET", f"/graph/{graph_id}.dot",
                               token=tokens["bob"])
-        assert status == 403
+        assert status == 404
+        status2, doc2 = request(console, "GET", "/graph/no-such-graph.dot",
+                                token=tokens["bob"])
+        assert status2 == 404
+        assert doc["error"].replace(graph_id, "X") == \
+            doc2["error"].replace("no-such-graph", "X")

@@ -48,6 +48,10 @@ def test_quickstart_blocks_execute_in_order(tmp_path):
                         f"{e}\n---\n{block}")
         ran += 1
     assert ran >= 5, f"only {ran} quickstart blocks were runnable"
+    # the serving block must EXECUTE (not get skipped as an illustration):
+    # it is the doc surface of the inference engine (docs/serving.md)
+    assert "InferenceEngine" in ns, "quickstart serving block did not run"
+    assert ns["req"].done
     cluster = ns.get("cluster")
     if cluster is not None:
         cluster.shutdown()

@@ -141,6 +141,55 @@ class TestServeEntrypoint:
         assert "shutting down" in out
 
 
+class TestServeModel:
+    def test_serve_model_generates_over_rpc(self, tmp_path):
+        """--serve-model boots the inference plane in the deployable
+        process: InferGenerate/InferStats answer on the same gRPC port as
+        the workflow surface."""
+        from lzy_tpu.rpc import RpcInferenceClient
+
+        port = _free_port()
+        proc, banner = _spawn_serve([
+            "--db", str(tmp_path / "m.db"),
+            "--storage-uri", f"file://{tmp_path}/s",
+            "--port", str(port),
+            "--serve-model", "tiny",
+            "--serve-slots", "2",
+        ], timeout_s=120)
+        try:
+            assert "model=tiny" in banner
+            client = RpcInferenceClient(f"127.0.0.1:{port}")
+            try:
+                res = client.generate([5, 9, 3], max_new_tokens=4,
+                                      timeout_s=120)
+                assert res["model"] == "tiny"
+                assert len(res["tokens"]) == 4
+                assert res["ttft_ms"] is not None
+                stats = client.stats()
+                assert stats["slots"] == 2
+                assert stats["requests_finished"] >= 1
+            finally:
+                client.close()
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(30) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(10)
+
+    def test_unknown_model_fails_fast(self, tmp_path):
+        res = subprocess.run(
+            [sys.executable, "-m", "lzy_tpu.service.serve",
+             "--db", str(tmp_path / "m.db"),
+             "--storage-uri", f"file://{tmp_path}/s",
+             "--serve-model", "gpt99"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            timeout=120, cwd=REPO_ROOT,
+        )
+        assert res.returncode != 0
+        assert "gpt99" in res.stdout
+
+
 class TestServeArgErrors:
     def _run(self, args):
         return subprocess.run(

@@ -220,8 +220,10 @@ def bench_sharded_spill(tmp: str, nbytes: int) -> None:
     # config-level too: the machine's sitecustomize may have pinned
     # jax_platforms to the relayed TPU plugin, which env alone can't
     # override (same dance as tests/conftest.py)
+    from lzy_tpu.utils.compat import request_cpu_devices
+
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    request_cpu_devices(8)
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
