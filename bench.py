@@ -216,17 +216,24 @@ def supervise() -> None:
 
 
 def pick_config(platform: str):
-    """Model + batch sized for the target: ~350M-param Llama on one v5e chip
-    (fits params + adam moments in 16 GB HBM with room for activations)."""
+    """Model + batch sized for the target: ~350M-param Llama on one v5e chip.
+
+    The PRIMARY config is the fused-CE + full-recompute-remat b16 variant:
+    the only headline candidate whose AOT row actually fits 16 GB HBM
+    (8.55 GB, mfu bound 0.79 — tpu_evidence/AOT_ANALYSIS.md; the dense b8
+    config needs 17.1 GB and would RESOURCE_EXHAUST the chip). The dense
+    no-remat config survives as the ``dense_b8`` secondary probe in run().
+    """
     from lzy_tpu.models.llama import LlamaConfig
 
     if platform in ("tpu", "axon"):
         cfg = LlamaConfig(
             vocab_size=32_768, d_model=1024, n_layers=20, n_heads=8,
-            n_kv_heads=8, d_ff=4096, max_seq_len=2048, remat=False,
+            n_kv_heads=8, d_ff=4096, max_seq_len=2048,
+            remat=True, remat_policy="nothing", fused_ce=True,
             tie_embeddings=True, use_flash_kernel=True,
         )
-        batch_size, seq_len = 8, 2048
+        batch_size, seq_len = 16, 2048
         steps, warmup = 20, 3
     else:
         cfg = LlamaConfig.tiny(vocab_size=2048)
@@ -363,23 +370,29 @@ def run() -> None:
     if extra:
         detail.update(extra)
         emit()
+    extra = paged_decode_measurement(
+        jax, cfg, params,
+        batch_size=8 if is_tpu else 4,
+        prompt_len=128 if is_tpu else 32,
+        new_tokens=64,
+        page_size=64 if is_tpu else 16)
+    if extra:
+        detail.update(extra)
+        emit()
     if platform in ("tpu", "axon"):
         # each extra pass builds a whole second model+optimizer: evict the
         # previous one (buffers AND compiled executables) first or OOM
         _free_buffers(params, batch, metrics)
         params = batch = metrics = None
         jax.clear_caches()
-        # the fused loss frees the ~2 GB logits activation — exactly what a
-        # doubled batch needs; this variant is the headline candidate.
-        # Full-recompute remat: the AOT memory analysis
-        # (tpu_evidence/AOT_ANALYSIS.md) showed b16 needs 23 GB HBM with
-        # remat off and still 21 GB with the dots policy — both would
-        # RESOURCE_EXHAUST on the chip; nothing_saveable fits in 8.6 GB
-        # with an MFU roofline of 0.79
+        # secondary probe: the pre-promotion dense no-remat config. Its
+        # AOT row says 17.1 GB / fits: NO, so an OOM here is EXPECTED
+        # evidence, not a regression — the fused-b16 headline above is
+        # what the chip actually serves (VERDICT top-next #1)
         extra = variant_measurement(
-            jax, cfg, mesh, n_params, "fused_ce_b16",
-            {"fused_ce": True, "remat": True, "remat_policy": "nothing"},
-            batch_size=16, seq_len=2048)
+            jax, cfg, mesh, n_params, "dense_b8",
+            {"fused_ce": False, "remat": False},
+            batch_size=8, seq_len=2048)
         if extra:
             detail.update(extra)
             emit()
@@ -539,6 +552,71 @@ def decode_measurement(jax, cfg, params, *, batch_size: int,
                 "decode_prompt_len": prompt_len}
     except Exception as e:  # noqa: BLE001 — diagnostics only
         _log(f"decode skipped: {type(e).__name__}: {e}")
+        return {}
+
+
+def paged_decode_measurement(jax, cfg, params, *, batch_size: int,
+                             prompt_len: int, new_tokens: int,
+                             page_size: int):
+    """Best-effort paged-serving point: decode throughput through the
+    PAGED attention path (gather KV blocks by page table — the hot loop
+    of serving.PagedInferenceEngine), measured next to the dense
+    ``decode_tokens_per_s`` so the per-step cost of the block gather is a
+    number, not a guess. Pure-throughput shape: identity page tables, the
+    cache index parked at ``prompt_len`` (step cost does not depend on
+    what the K/V bytes contain). Two extra compiles, wrapped so a hiccup
+    never loses the headline metric."""
+    try:
+        import dataclasses
+        import functools
+
+        import jax.numpy as jnp
+
+        from lzy_tpu.models.generate import (
+            _set_cache_index, decode_config, init_cache)
+        from lzy_tpu.models.llama import Llama
+
+        pages_per_seq = cfg.max_seq_len // page_size
+        n_pages = batch_size * pages_per_seq + 1
+        dcfg = dataclasses.replace(
+            decode_config(cfg), decode_paged=True, kv_page_size=page_size,
+            kv_pages=n_pages)
+        model = Llama(dcfg)
+        pt = jnp.arange(
+            1, batch_size * pages_per_seq + 1, dtype=jnp.int32
+        ).reshape(batch_size, pages_per_seq)
+        _log("paged decode: compiling...")
+        cache = init_cache(lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((batch_size, 1), jnp.int32),
+            page_table=pt))
+        cache = _set_cache_index(cache, prompt_len)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(cache, params, tok, pt):
+            logits, updated = model.apply(
+                {"params": params, "cache": cache}, tok, page_table=pt,
+                mutable=["cache"])
+            return (updated["cache"],
+                    jnp.argmax(logits[:, -1], -1).astype(jnp.int32))
+
+        cur = jnp.zeros((batch_size,), jnp.int32)
+        cache, cur = step(cache, params, cur[:, None], pt)  # compile+warmup
+        cur.block_until_ready()
+        _log(f"paged decode: timing {new_tokens} steps x "
+             f"batch {batch_size}...")
+        t0 = time.perf_counter()
+        for _ in range(new_tokens):
+            cache, cur = step(cache, params, cur[:, None], pt)
+        cur.block_until_ready()
+        dt = time.perf_counter() - t0
+        tps = batch_size * new_tokens / dt
+        _log(f"paged decode: {1000 * dt / new_tokens:.2f} ms/step, "
+             f"{tps:.1f} tok/s (page {page_size})")
+        return {"paged_decode_tokens_per_s": round(tps, 1),
+                "paged_decode_step_ms": round(1000 * dt / new_tokens, 3),
+                "paged_decode_page_size": page_size}
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        _log(f"paged decode skipped: {type(e).__name__}: {e}")
         return {}
 
 

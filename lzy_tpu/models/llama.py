@@ -67,6 +67,15 @@ class LlamaConfig:
     # continuous-batching engine (lzy_tpu/serving) needs to admit and retire
     # requests mid-decode without draining the batch
     decode_slot_index: bool = False
+    # paged KV cache: k/v live in a SHARED pool of [kv_pages, kv_page_size,
+    # heads, dim] blocks instead of a dense [B, max_seq_len, ...] row per
+    # batch slot; each forward pass takes a per-row page table (block ids in
+    # position order) and gathers/scatters through it. Block allocation,
+    # prefix reuse and eviction live in lzy_tpu/serving/kv_cache.py; the
+    # index is per-row [B] (continuous batching is the only paged caller).
+    decode_paged: bool = False
+    kv_page_size: int = 16
+    kv_pages: int = 0
     # logits-free loss: the model returns (features, head) and the loss uses
     # chunked_cross_entropy — saves the [B,T,V] activation (ops/chunked_ce.py)
     fused_ce: bool = False
@@ -156,9 +165,13 @@ class Attention(nn.Module):
     #: pipeline's manual region, where constraints on the full mesh are
     #: not expressible — LlamaStage manages its own boundaries)
     anchor_mesh: Any = None
+    #: frozen sharding-rule overrides (parallel.sharding.freeze_rules);
+    #: None = the canonical DEFAULT_RULES table
+    rules: Any = None
 
     @nn.compact
-    def __call__(self, x, positions, mesh=None, segments=None):
+    def __call__(self, x, positions, mesh=None, segments=None,
+                 page_table=None):
         cfg = self.cfg
         dense = lambda features, name, axes: nn.DenseGeneral(  # noqa: E731
             features=features, axis=-1, use_bias=False, name=name,
@@ -174,12 +187,15 @@ class Attention(nn.Module):
         v = dense((kv, d), "v_proj", ("embed", "kv", "head_dim"))(x)
         # in-layer anchors (see Mlp): keep batch sharded through the
         # projections so fsdp gathers weights, not [D,T,B] activations
-        q = _anchor(q, self.anchor_mesh, "batch", "seq", "act_heads", None)
-        k = _anchor(k, self.anchor_mesh, "batch", "seq", None, None)
-        v = _anchor(v, self.anchor_mesh, "batch", "seq", None, None)
+        q = _anchor(q, self.anchor_mesh, "batch", "seq", "act_heads", None,
+                    rules=self.rules)
+        k = _anchor(k, self.anchor_mesh, "batch", "seq", None, None,
+                    rules=self.rules)
+        v = _anchor(v, self.anchor_mesh, "batch", "seq", None, None,
+                    rules=self.rules)
 
         if cfg.decode:
-            return self._decode_step(q, k, v, b)
+            return self._decode_step(q, k, v, b, page_table)
 
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
@@ -211,18 +227,20 @@ class Attention(nn.Module):
             from lzy_tpu.ops.flash_attention import flash_attention
 
             out = _batch_sharded_attention(
-                flash_attention, q, k, v, segments, self.anchor_mesh)
+                flash_attention, q, k, v, segments, self.anchor_mesh,
+                rules=self.rules)
         else:
             # portable fallback: chunked online-softmax attention — O(T·block)
             # activations, never the T×T score matrix (lzy_tpu/ops/attention)
             from lzy_tpu.ops.attention import chunked_attention
 
             out = _batch_sharded_attention(
-                chunked_attention, q, k, v, segments, self.anchor_mesh)
+                chunked_attention, q, k, v, segments, self.anchor_mesh,
+                rules=self.rules)
 
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, t, h * d)
         return _anchor(self._o_proj(out), self.anchor_mesh,
-                       "batch", "seq", "act_embed")
+                       "batch", "seq", "act_embed", rules=self.rules)
 
     def _o_proj(self, out):
         cfg = self.cfg
@@ -234,27 +252,53 @@ class Attention(nn.Module):
             ),
         )(out)
 
-    def _decode_step(self, q, k, v, b):
+    def _decode_step(self, q, k, v, b, page_table=None):
         """Autoregressive step against the KV cache (flax cache collection);
         q/k/v: [B, T, heads|kv, D] pre-RoPE. T=1 is token-by-token decode;
         T>1 is batched prefill: the whole chunk is written into the cache
         first, and the mask below keeps each query position causal within
         it. With ``cfg.decode_slot_index`` the cache index is ``[B]`` and
-        every row reads/writes at its own position (continuous batching)."""
+        every row reads/writes at its own position (continuous batching).
+
+        With ``cfg.decode_paged`` the k/v caches are a SHARED pool of
+        ``[kv_pages, kv_page_size, ...]`` blocks and ``page_table``
+        (``[B, max_seq_len // kv_page_size]`` block ids) maps each row's
+        positions onto pool rows: writes scatter to
+        ``(table[b, pos//page], pos%page)``, reads gather the row's blocks
+        back into position order — after which the score/mask/softmax code
+        is shared with the dense path, which is what keeps the two paths
+        bit-identical (the paged gather reproduces the dense layout
+        exactly; garbage in padded/unwritten slots is masked to a 0.0
+        softmax weight the same way in both)."""
         cfg = self.cfg
         h, kv_heads, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
         L = cfg.max_seq_len
         t = q.shape[1]
-        cache_k = self.variable(
-            "cache", "k", jnp.zeros, (b, L, kv_heads, d), cfg.dtype
-        )
-        cache_v = self.variable(
-            "cache", "v", jnp.zeros, (b, L, kv_heads, d), cfg.dtype
-        )
-        idx_shape = (b,) if cfg.decode_slot_index else ()
-        index = self.variable(
-            "cache", "index", lambda: jnp.zeros(idx_shape, jnp.int32)
-        )
+        if cfg.decode_paged:
+            if cfg.kv_pages < 2 or L % cfg.kv_page_size:
+                raise ValueError(
+                    f"decode_paged needs kv_pages >= 2 and max_seq_len "
+                    f"({L}) divisible by kv_page_size ({cfg.kv_page_size})")
+            page = cfg.kv_page_size
+            cache_k = self.variable(
+                "cache", "k", jnp.zeros,
+                (cfg.kv_pages, page, kv_heads, d), cfg.dtype)
+            cache_v = self.variable(
+                "cache", "v", jnp.zeros,
+                (cfg.kv_pages, page, kv_heads, d), cfg.dtype)
+            index = self.variable(
+                "cache", "index", lambda: jnp.zeros((b,), jnp.int32))
+        else:
+            cache_k = self.variable(
+                "cache", "k", jnp.zeros, (b, L, kv_heads, d), cfg.dtype
+            )
+            cache_v = self.variable(
+                "cache", "v", jnp.zeros, (b, L, kv_heads, d), cfg.dtype
+            )
+            idx_shape = (b,) if cfg.decode_slot_index else ()
+            index = self.variable(
+                "cache", "index", lambda: jnp.zeros(idx_shape, jnp.int32)
+            )
         i = index.value
         starts = i if i.ndim else jnp.broadcast_to(i, (b,))      # [B]
         pos = starts[:, None] + jnp.arange(t, dtype=jnp.int32)   # [B, T]
@@ -263,7 +307,23 @@ class Attention(nn.Module):
         if not self.is_initializing():
             # init() RUNS the module; writing during init would pre-populate
             # the cache with the dummy token and shift every real position
-            if i.ndim:
+            if cfg.decode_paged:
+                if page_table is None:
+                    raise ValueError("decode_paged forward needs page_table")
+                page = cfg.kv_page_size
+                # scatter each (row, position) into its pool block; rows own
+                # their tail blocks exclusively, so real positions never
+                # collide — idle rows (pos 0, zeroed table) land on the
+                # reserved scratch block 0 and write only garbage over
+                # garbage
+                rows = jnp.take_along_axis(page_table, pos // page, axis=1)
+                offs = (pos % page).reshape(-1)
+                rows = rows.reshape(-1)
+                cache_k.value = cache_k.value.at[rows, offs].set(
+                    k.astype(cfg.dtype).reshape(b * t, kv_heads, d))
+                cache_v.value = cache_v.value.at[rows, offs].set(
+                    v.astype(cfg.dtype).reshape(b * t, kv_heads, d))
+            elif i.ndim:
                 # per-row positions: each batch row lands at its own start
                 row_write = jax.vmap(
                     lambda c, kv_chunk, start: jax.lax.dynamic_update_slice(
@@ -281,6 +341,15 @@ class Attention(nn.Module):
                 )
             index.value = i + t
 
+        if cfg.decode_paged:
+            # gather the row's blocks back into position order: [B, P, page,
+            # KV, D] → [B, L, KV, D] — the dense layout, so everything below
+            # is literally the dense code path (bit-identical numerics)
+            keys = cache_k.value[page_table].reshape(b, L, kv_heads, d)
+            vals = cache_v.value[page_table].reshape(b, L, kv_heads, d)
+        else:
+            keys, vals = cache_k.value, cache_v.value
+
         # GQA without jnp.repeat: grouping q as [B, T, KV, G, D] lets the
         # einsum broadcast the shared KV head instead of materializing a
         # G-times larger cache copy every step — decode is HBM-bound, and
@@ -288,7 +357,7 @@ class Attention(nn.Module):
         reps = h // kv_heads
         qg = q.reshape(b, t, kv_heads, reps, d)
         s = jnp.einsum(
-            "btkgd,blkd->bkgtl", qg, cache_k.value,
+            "btkgd,blkd->bkgtl", qg, keys,
             preferred_element_type=jnp.float32,
         ) * (d ** -0.5)                                   # [B, KV, G, T, L]
         # query at (row, chunk offset tq) sees cache slots l <= start + tq:
@@ -300,13 +369,14 @@ class Attention(nn.Module):
                    <= pos[:, None, None, :, None])
         s = jnp.where(visible, s, -1e30)
         p = jax.nn.softmax(s, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bkgtl,blkd->btkgd", p, cache_v.value)
+        out = jnp.einsum("bkgtl,blkd->btkgd", p, vals)
         return self._o_proj(out.reshape(b, t, h * d))
 
 
 class Mlp(nn.Module):
     cfg: LlamaConfig
     mesh: Any = None
+    rules: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -328,9 +398,11 @@ class Mlp(nn.Module):
         # intermediates keeps batch sharded so only WEIGHTS are gathered
         gate = dense(cfg.d_ff, "gate_proj", ("embed", "mlp"))(x)
         up = dense(cfg.d_ff, "up_proj", ("embed", "mlp"))(x)
-        h = _anchor(nn.silu(gate) * up, self.mesh, "batch", "seq", "act_mlp")
+        h = _anchor(nn.silu(gate) * up, self.mesh, "batch", "seq", "act_mlp",
+                    rules=self.rules)
         out = dense(cfg.d_model, "down_proj", ("mlp", "embed"))(h)
-        return _anchor(out, self.mesh, "batch", "seq", "act_embed")
+        return _anchor(out, self.mesh, "batch", "seq", "act_embed",
+                       rules=self.rules)
 
 
 class DecoderLayer(nn.Module):
@@ -345,14 +417,16 @@ class DecoderLayer(nn.Module):
     #: dense-path activation anchors; False inside the pipeline's manual
     #: region (LlamaStage), where full-mesh constraints don't apply
     anchor: bool = False
+    rules: Any = None
 
     @nn.compact
-    def __call__(self, x, positions, segments=None):
+    def __call__(self, x, positions, segments=None, page_table=None):
         cfg, mesh = self.cfg, self.mesh
         amesh = mesh if self.anchor else None
-        x = x + Attention(cfg, anchor_mesh=amesh, name="attn")(
+        x = x + Attention(cfg, anchor_mesh=amesh, rules=self.rules,
+                          name="attn")(
             RMSNorm(cfg.norm_eps, cfg.param_dtype, name="attn_norm")(x),
-            positions, mesh, segments,
+            positions, mesh, segments, page_table,
         )
         h = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="mlp_norm")(x)
         if cfg.n_experts > 0:
@@ -365,10 +439,26 @@ class DecoderLayer(nn.Module):
             ), name="moe")(h)
             self.sow("losses", "moe_aux", aux)
             return x + moe_out
-        return x + Mlp(cfg, mesh=amesh, name="mlp")(h)
+        return x + Mlp(cfg, mesh=amesh, rules=self.rules, name="mlp")(h)
 
 
-def _batch_sharded_attention(fn, q, k, v, segments, mesh):
+def _mesh_axes_for(rules, name, mesh):
+    """Mesh axes a logical axis maps to under the ACTIVE rule table,
+    filtered to axes the mesh actually has (a remapped deployment may
+    drop dp/tp entirely). ``rules`` is a frozen override tuple or None."""
+    from lzy_tpu.parallel.sharding import DEFAULT_RULES
+
+    table = dict(DEFAULT_RULES)
+    if rules:
+        table.update(dict(rules))
+    entry = table.get(name)
+    if entry is None:
+        return ()
+    names = entry if isinstance(entry, tuple) else (entry,)
+    return tuple(a for a in names if a in mesh.shape)
+
+
+def _batch_sharded_attention(fn, q, k, v, segments, mesh, rules=None):
     """Run a non-ring attention body per batch/head shard via shard_map.
 
     The SPMD partitioner cannot see inside the Pallas flash custom call
@@ -379,21 +469,29 @@ def _batch_sharded_attention(fn, q, k, v, segments, mesh):
     batch (tpu_evidence/AOT_ANALYSIS.md, op_name attn/while/body).
     Attention is independent per (batch, head), so mapping those dims is
     exact. Dense path only (``anchor_mesh``); the ring/Ulysses paths and
-    the pipeline's manual region do their own thing."""
+    the pipeline's manual region do their own thing. The batch/head mesh
+    axes come from the ACTIVE rule table (``rules``), not hardcoded
+    dp/fsdp/tp names, so remapped deployments shard instead of crashing
+    on a missing mesh axis."""
     if mesh is None or mesh.size == 1:
         return fn(q, k, v, causal=True, segment_ids=segments)
+    import math
+
+    batch_axes = _mesh_axes_for(rules, "batch", mesh)
+    head_axes = _mesh_axes_for(rules, "heads", mesh)
+    bs = math.prod(mesh.shape[a] for a in batch_axes)
+    hs = math.prod(mesh.shape[a] for a in head_axes)
     # shard_map demands exact divisibility where GSPMD would pad; odd
-    # batch/head counts (eval smoke runs, unusual head configs) keep the
-    # old replicated path — correct, just not bandwidth-optimal
-    bs = mesh.shape["dp"] * mesh.shape["fsdp"]
-    hs = mesh.shape["tp"]
-    if q.shape[0] % bs or q.shape[1] % hs:
+    # batch/head counts (eval smoke runs, unusual head configs) and rule
+    # tables that shard neither dim keep the old replicated path —
+    # correct, just not bandwidth-optimal
+    if bs * hs == 1 or q.shape[0] % bs or q.shape[1] % hs:
         return fn(q, k, v, causal=True, segment_ids=segments)
     from jax.sharding import PartitionSpec as P
 
     from lzy_tpu.utils.compat import shard_map
 
-    qkv_spec = P(("dp", "fsdp"), "tp", None, None)   # [B, H, T, D]
+    qkv_spec = P(batch_axes or None, head_axes or None, None, None)
     if segments is None:
         return shard_map(
             lambda a, b, c: fn(a, b, c, causal=True),
@@ -403,19 +501,22 @@ def _batch_sharded_attention(fn, q, k, v, segments, mesh):
     return shard_map(
         lambda a, b, c, s: fn(a, b, c, causal=True, segment_ids=s),
         mesh=mesh,
-        in_specs=(qkv_spec,) * 3 + (P(("dp", "fsdp"), None),),
+        in_specs=(qkv_spec,) * 3 + (P(batch_axes or None, None),),
         out_specs=qkv_spec, check_vma=False,
     )(q, k, v, segments)
 
 
-def _anchor(x, mesh, *logical_axes):
+def _anchor(x, mesh, *logical_axes, rules=None):
     """Pin an activation's sharding to the logical rules (maxtext-style
     anchor). Without this the TPU partitioner may resolve a
     param-vs-activation axis conflict by un-sharding the *batch* — on an
     fsdp mesh the embed table is (vocab, embed->fsdp), and propagating
     that into the residual stream makes XLA batch-all-gather every
     [B,T,V]-shaped intermediate (33 MB each at test size, 34 GB at
-    flagship scale: tpu_evidence/AOT_ANALYSIS.md)."""
+    flagship scale: tpu_evidence/AOT_ANALYSIS.md). ``rules`` is a frozen
+    override tuple (``parallel.sharding.freeze_rules``) so anchors follow
+    the SAME table the params were laid out with instead of silently
+    assuming DEFAULT_RULES."""
     if mesh is None or mesh.size == 1:
         return x
     from jax.sharding import NamedSharding, PartitionSpec
@@ -423,7 +524,17 @@ def _anchor(x, mesh, *logical_axes):
     from lzy_tpu.parallel.sharding import spec_for
     from lzy_tpu.utils.compat import manual_axes_of
 
-    spec = spec_for(logical_axes)
+    spec = spec_for(logical_axes, dict(rules) if rules else None)
+    # a rule may name axes the mesh doesn't have (remapped deployments);
+    # constraints on absent axes are rejected, so keep only real ones
+    def present(entry):
+        if entry is None:
+            return None
+        names = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(a for a in names if a in mesh.shape)
+        return kept if kept else None
+
+    spec = PartitionSpec(*(present(e) for e in spec))
     manual = manual_axes_of(mesh)
     if manual:
         # inside a manual region (the pp pipeline runs the stage body under
@@ -462,9 +573,14 @@ def _embed_lookup(table, tokens, *, one_hot: bool):
 
 class Llama(nn.Module):
     cfg: LlamaConfig
+    #: frozen sharding-rule overrides (``parallel.sharding.freeze_rules``);
+    #: threads the ACTIVE rule table into every activation anchor so a
+    #: deployment with remapped rules doesn't get DEFAULT_RULES anchors
+    #: fighting its custom param shardings
+    rules: Any = None
 
     @nn.compact
-    def __call__(self, tokens, mesh=None, segments=None):
+    def __call__(self, tokens, mesh=None, segments=None, page_table=None):
         cfg = self.cfg
         emb = self.param(
             "embed_tokens",
@@ -475,7 +591,7 @@ class Llama(nn.Module):
         )
         x = _embed_lookup(emb.astype(cfg.dtype), tokens,
                           one_hot=mesh is not None)
-        x = _anchor(x, mesh, "batch", "seq", "act_embed")
+        x = _anchor(x, mesh, "batch", "seq", "act_embed", rules=self.rules)
         if segments is None:
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1]), tokens.shape
@@ -500,9 +616,10 @@ class Llama(nn.Module):
             # mid-layer and all-gathers [D,T,B] for every matmul (280
             # gathers / 150 GB per step on v5e-16, AOT_ANALYSIS.md). The
             # pp path (LlamaStage) manages its own boundaries.
-            x = layer(cfg, mesh=mesh, anchor=True, name=f"layer_{i}")(
-                x, positions, segments)
-            x = _anchor(x, mesh, "batch", "seq", "act_embed")
+            x = layer(cfg, mesh=mesh, anchor=True, rules=self.rules,
+                      name=f"layer_{i}")(x, positions, segments, page_table)
+            x = _anchor(x, mesh, "batch", "seq", "act_embed",
+                        rules=self.rules)
         x = RMSNorm(cfg.norm_eps, cfg.param_dtype, name="final_norm")(x)
         if cfg.tie_embeddings:
             head = emb
@@ -525,7 +642,8 @@ class Llama(nn.Module):
             "bte,ve->btv", x.astype(cfg.dtype), head.astype(cfg.dtype),
             preferred_element_type=jnp.float32,
         )
-        return _anchor(logits, mesh, "batch", "seq", "act_vocab")
+        return _anchor(logits, mesh, "batch", "seq", "act_vocab",
+                       rules=self.rules)
 
 
 class LlamaStage(nn.Module):
@@ -575,13 +693,6 @@ def _check_pp_config(cfg: LlamaConfig) -> int:
             "decode from staged params with models.generate.pp_generate "
             "(or unstack_pp_params + the dense generate). Ring/Ulysses "
             "sequence parallelism and MoE DO compose with pp."
-        )
-    if cfg.n_experts > 0 and (cfg.use_ring_attention
-                              or cfg.use_ulysses_attention):
-        raise ValueError(
-            "pp_stages>1 composes with MoE or with sequence parallelism, "
-            "not both at once (the MoE aux loss is not yet sp-reduced "
-            "inside the pipeline region)"
         )
     return cfg.n_layers // cfg.pp_stages
 
@@ -780,10 +891,17 @@ def init_params(cfg: LlamaConfig, rng: jax.Array, seq_len: int = 8):
     return boxed, param_logical_axes(boxed)
 
 
-def make_loss_fn(cfg: LlamaConfig, mesh=None):
+def make_loss_fn(cfg: LlamaConfig, mesh=None, rules=None):
     """Causal-LM loss: predict tokens[t+1] from tokens[:t]. MoE configs add
     the routers' load-balancing aux losses. ``pp_stages>1`` streams the
-    decoder stack over the mesh's pp axis (mesh required)."""
+    decoder stack over the mesh's pp axis (mesh required). ``rules``
+    (a ``parallel.sharding.Rules`` override dict) threads the active rule
+    table into the model's activation anchors — pass the SAME table you
+    give ``make_train_step`` or anchors will pin default-rule layouts
+    against custom param shardings."""
+    from lzy_tpu.parallel.sharding import freeze_rules
+
+    frozen = freeze_rules(rules)
     if cfg.pp_stages > 1:
         _check_pp_config(cfg)
         if mesh is None:
@@ -800,10 +918,11 @@ def make_loss_fn(cfg: LlamaConfig, mesh=None):
             shifted_mask = mask[:, 1:] if mask is not None else None
             if segments is not None:
                 shifted_mask = _segment_shift_mask(segments, shifted_mask)
-            return _lm_loss(cfg, out, tokens, shifted_mask, mesh) + aux
+            return _lm_loss(cfg, out, tokens, shifted_mask, mesh,
+                            rules=frozen) + aux
 
         return pp_loss_fn
-    model = Llama(cfg)
+    model = Llama(cfg, rules=frozen)
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]
@@ -824,7 +943,8 @@ def make_loss_fn(cfg: LlamaConfig, mesh=None):
         shifted_mask = mask[:, 1:] if mask is not None else None
         if segments is not None:
             shifted_mask = _segment_shift_mask(segments, shifted_mask)
-        return _lm_loss(cfg, logits, tokens, shifted_mask, mesh) + aux
+        return _lm_loss(cfg, logits, tokens, shifted_mask, mesh,
+                        rules=frozen) + aux
 
     return loss_fn
 
@@ -838,7 +958,8 @@ def _segment_shift_mask(segments, shifted_mask):
         else jnp.logical_and(shifted_mask, same_doc)
 
 
-def _lm_loss(cfg: LlamaConfig, out, tokens, shifted_mask, mesh=None):
+def _lm_loss(cfg: LlamaConfig, out, tokens, shifted_mask, mesh=None,
+             rules=None):
     """Shared next-token loss tail: ``out`` is logits, or (features, head)
     when ``cfg.fused_ce`` (both the dense and pipelined paths end here)."""
     if cfg.fused_ce:
@@ -850,10 +971,11 @@ def _lm_loss(cfg: LlamaConfig, out, tokens, shifted_mask, mesh=None):
         # flagship size) instead of the partitioner keeping its embed dim
         # fsdp-sharded and batch-all-gathering every chunk of the scan —
         # the 193 GB/step pathology AOT_ANALYSIS caught on v5e-16
-        features = _anchor(features, mesh, "batch", "seq", "act_embed")
+        features = _anchor(features, mesh, "batch", "seq", "act_embed",
+                           rules=rules)
         # (vocab, None): "act_embed" here would map to the same mesh axis
         # as "vocab" (both tp) and P("tp","tp") is illegal
-        head = _anchor(head, mesh, "vocab", None)
+        head = _anchor(head, mesh, "vocab", None, rules=rules)
         return chunked_cross_entropy(
             features[:, :-1], head, tokens[:, 1:], mask=shifted_mask,
         )

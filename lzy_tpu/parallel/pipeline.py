@@ -59,11 +59,6 @@ def pipeline_apply(
     Returns ``[n_micro, micro_batch, ...]`` outputs, equal to applying the
     stages sequentially to each microbatch (plus aux when ``with_aux``).
     """
-    if with_aux and seq_axis is not None:
-        raise ValueError(
-            "with_aux does not compose with seq_axis yet: the aux scalar "
-            "is only psummed over the pipeline axis, so per-sp-rank "
-            "partials would silently masquerade as replicated")
     n_stages = mesh.shape[axis]
     n_micro = x.shape[0]
     dtype = x.dtype
@@ -157,7 +152,17 @@ def pipeline_apply(
             # mean over microbatches — equal micro sizes make this exactly
             # the dense full-batch aux; still (1,) at the boundary (see
             # the aux0 note)
-            return outs, lax.psum(aux_acc, axis) / n_micro
+            aux_out = lax.psum(aux_acc, axis) / n_micro
+            if seq_axis is not None:
+                # each sp rank's MoE routers scored only its sequence
+                # chunk, so its aux is a chunk-local estimate; the sp-mean
+                # replicates one consistent value (NOT the exact dense
+                # full-sequence aux — the balancing loss is nonlinear in
+                # the routing stats — but an unbiased per-chunk average,
+                # which is what matters for the gradient pressure). The
+                # replication also makes the P() out_spec truthful.
+                aux_out = lax.pmean(aux_out, seq_axis)
+            return outs, aux_out
         return outs
 
     # only ``pp`` is manual: the other mesh axes (dp/fsdp/tp) stay auto, so

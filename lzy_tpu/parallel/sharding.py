@@ -48,6 +48,16 @@ DEFAULT_RULES: Rules = {
 }
 
 
+def freeze_rules(rules: Optional[Rules]):
+    """A hashable form of a rule-override table, for threading through
+    flax module fields (``models.llama.Llama(cfg, rules=...)``) — module
+    attributes must stay hashable for jit/remat static handling. Thaw
+    with ``dict(frozen)``; None/empty stays None (= DEFAULT_RULES)."""
+    if not rules:
+        return None
+    return tuple(sorted(rules.items()))
+
+
 def spec_for(logical_axes: Sequence[Optional[str]],
              rules: Optional[Rules] = None) -> P:
     rules = {**DEFAULT_RULES, **(rules or {})}

@@ -330,6 +330,7 @@ class ControlPlaneServer:
                     p["prompt"],
                     max_new_tokens=int(p.get("max_new_tokens", 64)),
                     timeout_s=p.get("timeout_s"),
+                    deadline_s=p.get("deadline_s"),
                     token=p.get("token")),
                 "InferStats": lambda p: inference.stats(
                     token=p.get("token")),
@@ -728,14 +729,19 @@ class RpcInferenceClient:
         self._token = token
 
     def generate(self, prompt, *, max_new_tokens: int = 64,
-                 timeout_s: Optional[float] = None) -> dict:
+                 timeout_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None) -> dict:
         """``prompt``: list of token ids. Returns ``{"request_id",
-        "tokens", "ttft_ms", "model"}`` (generated ids only, no echo)."""
+        "tokens", "status", "ttft_ms", "model"}`` (generated ids only, no
+        echo). ``deadline_s`` is the engine-side client deadline: past it
+        the request is evicted mid-decode and the reply carries
+        ``status: "cancelled"`` with the tokens generated so far."""
         rpc_timeout = (timeout_s or 120.0) + 30.0   # server waits first
         return self._client.call("InferGenerate", {
             "prompt": list(prompt),
             "max_new_tokens": int(max_new_tokens),
             "timeout_s": timeout_s,
+            "deadline_s": deadline_s,
             "token": _token_value(self._token),
         }, timeout_s=rpc_timeout)
 

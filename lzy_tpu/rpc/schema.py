@@ -270,7 +270,8 @@ REQUESTS: Dict[str, Schema] = {
     "InferGenerate": Schema("InferGenerateRequest", {
         "prompt": f(list, required=True),
         "max_new_tokens": f(int),
-        "timeout_s": f(float, int), **_TOKEN}),
+        "timeout_s": f(float, int),
+        "deadline_s": f(float, int), **_TOKEN}),
     "InferStats": Schema("InferStatsRequest", {**_TOKEN}),
     # status surface
     "GetStatus": Schema("GetStatusRequest", {

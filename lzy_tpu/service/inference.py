@@ -52,13 +52,17 @@ class InferenceService:
 
     def generate(self, prompt, *, max_new_tokens: int = 64,
                  token: Optional[str] = None,
-                 timeout_s: Optional[float] = None) -> dict:
+                 timeout_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None) -> dict:
         """Blocking generate: admit, wait, return generated token ids.
         Backpressure (full queue OR all waiter threads busy) surfaces as
         ``Unavailable`` BEFORE any work happens — safe for the caller to
         retry with backoff; the plane never buffers unboundedly. On
         timeout the request is cancelled so the engine stops spending
-        decode steps on it."""
+        decode steps on it. ``deadline_s`` is the engine-side client
+        deadline: once it passes, the request is evicted mid-decode and
+        the call RETURNS (not raises) with ``status: "cancelled"`` and
+        whatever tokens were generated before the eviction."""
         self._auth(token)
         from lzy_tpu.rpc.core import Unavailable
 
@@ -69,20 +73,25 @@ class InferenceService:
             try:
                 req = self.engine.submit(
                     any_to_tokens(prompt),
-                    max_new_tokens=int(max_new_tokens))
+                    max_new_tokens=int(max_new_tokens),
+                    deadline_s=deadline_s)
             except AdmissionError as e:
                 raise Unavailable(str(e)) from None
-            try:
-                tokens = req.result(timeout=timeout_s or 120.0)
-            except TimeoutError:
+            if not req.wait(timeout=timeout_s or 120.0):
                 req.cancel()
-                raise
+                raise TimeoutError(
+                    f"request {req.id} not finished within "
+                    f"{timeout_s or 120.0}s")
+            if req.error and req.status != "cancelled":
+                raise RuntimeError(f"request {req.id} failed: {req.error}")
+            tokens = list(req.tokens)
         finally:
             self._waiters.release()
         ttft_ms = None
         if req.first_token_at is not None:
             ttft_ms = round(1000 * (req.first_token_at - req.submitted_at), 3)
         return {"request_id": req.id, "tokens": tokens,
+                "status": req.status or "ok",
                 "ttft_ms": ttft_ms, "model": self.model_name}
 
     def stats(self, *, token: Optional[str] = None) -> dict:
@@ -102,6 +111,9 @@ def build_inference_service(
     checkpoint: Optional[str] = None,
     seed: int = 0,
     prefill_chunk: int = 64,
+    paged: bool = False,
+    page_size: int = 16,
+    kv_blocks: Optional[int] = None,
     start: bool = True,
 ) -> InferenceService:
     """Construct the engine for a named config and wrap it for RPC.
@@ -110,11 +122,17 @@ def build_inference_service(
     weights are random-initialized — enough for smoke tests and load
     drills; real deployments pass an Orbax export
     (``parallel.orbax_interop.export_orbax``) of the matching config.
+
+    ``paged=True`` serves from the paged KV-cache pool with radix prefix
+    caching (``serving.PagedInferenceEngine``): ``kv_blocks`` blocks of
+    ``page_size`` tokens shared by all slots (default: the dense
+    equivalent — size it below that to overcommit HBM, above to grow the
+    prefix cache; docs/serving.md has the tradeoffs).
     """
     import jax
 
     from lzy_tpu.models import llama, unbox
-    from lzy_tpu.serving import InferenceEngine
+    from lzy_tpu.serving import InferenceEngine, PagedInferenceEngine
 
     if model not in MODEL_CONFIGS:
         raise ValueError(
@@ -127,9 +145,13 @@ def build_inference_service(
 
         _LOG.info("restoring %s weights from %s", model, checkpoint)
         params = import_orbax(checkpoint, template=params)
-    engine = InferenceEngine(
-        cfg, params, slots=slots, max_queue=max_queue, eos_token=eos_token,
-        prefill_chunk=prefill_chunk, seed=seed)
+    common = dict(slots=slots, max_queue=max_queue, eos_token=eos_token,
+                  prefill_chunk=prefill_chunk, seed=seed)
+    if paged:
+        engine: InferenceEngine = PagedInferenceEngine(
+            cfg, params, page_size=page_size, kv_blocks=kv_blocks, **common)
+    else:
+        engine = InferenceEngine(cfg, params, **common)
     if start:
         engine.start()
     return InferenceService(engine, model_name=model)

@@ -72,6 +72,18 @@ def main(argv=None) -> int:
                              "shed with UNAVAILABLE)")
     parser.add_argument("--serve-eos-token", type=int, default=None,
                         help="token id that terminates generation early")
+    parser.add_argument("--serve-paged", action="store_true",
+                        help="serve from the paged KV-cache pool with radix "
+                             "prefix caching (shared blocks instead of a "
+                             "dense cache row per slot; docs/serving.md)")
+    parser.add_argument("--serve-page-size", type=int, default=64,
+                        help="tokens per KV block under --serve-paged "
+                             "(must divide the model's max_seq_len)")
+    parser.add_argument("--serve-kv-blocks", type=int, default=None,
+                        help="KV block pool size under --serve-paged "
+                             "(default: the dense equivalent; smaller "
+                             "overcommits HBM, larger grows the prefix "
+                             "cache)")
     args = parser.parse_args(argv)
 
     from lzy_tpu.service import InProcessCluster
@@ -86,6 +98,9 @@ def main(argv=None) -> int:
             max_queue=args.serve_queue,
             eos_token=args.serve_eos_token,
             checkpoint=args.model_checkpoint,
+            paged=args.serve_paged,
+            page_size=args.serve_page_size,
+            kv_blocks=args.serve_kv_blocks,
         )
 
     backend = None

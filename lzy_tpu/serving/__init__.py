@@ -12,18 +12,30 @@ most TPU serving throughput: single-pass prefill and continuous batching).
   fresh batch-1 cache and spliced into a free slot of the live decode
   batch; finished slots free on EOS/limit; one jitted decode step advances
   every active slot at once and the loop idles when all slots drain.
+- ``kv_cache``: the paged KV block pool + ref-counted radix prefix tree
+  behind ``PagedInferenceEngine`` — per-request page tables instead of
+  dense per-slot rows, prefill skipped for cached prompt prefixes, LRU
+  eviction of unreferenced blocks under memory pressure.
 
 Expose over the control plane with ``lzy_tpu.service.inference`` (the
 ``--serve-model`` flag of ``lzy_tpu.service.serve``).
 """
 
-from lzy_tpu.serving.engine import EngineStats, InferenceEngine
+from lzy_tpu.serving.engine import (
+    EngineStats, InferenceEngine, PagedInferenceEngine)
+from lzy_tpu.serving.kv_cache import (
+    BlockPool, KVCacheStats, NoFreeBlocks, RadixCache)
 from lzy_tpu.serving.scheduler import AdmissionError, Request, RequestQueue
 
 __all__ = [
     "AdmissionError",
+    "BlockPool",
     "EngineStats",
     "InferenceEngine",
+    "KVCacheStats",
+    "NoFreeBlocks",
+    "PagedInferenceEngine",
+    "RadixCache",
     "Request",
     "RequestQueue",
 ]

@@ -26,6 +26,12 @@ depend on what else is in flight — per-request determinism needs
 TTFT, generated tokens, decode step latency, queue depth and slot
 occupancy are exported via ``lzy_tpu.utils.metrics.REGISTRY`` (scraped by
 ``/metrics`` on both the console and the metrics server).
+
+:class:`PagedInferenceEngine` (below) swaps the dense per-slot cache rows
+for a shared paged block pool with radix prefix caching
+(``lzy_tpu/serving/kv_cache.py``): prefill runs only the unmatched prompt
+suffix, admission is budgeted against blocks instead of raw slots, and
+per-request deadlines evict mid-decode with a ``cancelled`` status.
 """
 
 from __future__ import annotations
@@ -48,6 +54,14 @@ from lzy_tpu.utils.log import get_logger
 from lzy_tpu.utils.metrics import REGISTRY
 
 _LOG = get_logger(__name__)
+
+
+class PoolCorruption(RuntimeError):
+    """A device call failed AFTER the shared KV block pool's buffers were
+    donated into it — the pool is gone, so the failure is engine-fatal
+    (the loop's death handler fails all outstanding requests), never
+    request-scoped like a dense prefill failure (whose donated cache was
+    private to the request)."""
 
 _TTFT = REGISTRY.histogram(
     "lzy_inference_ttft_seconds",
@@ -77,9 +91,20 @@ class EngineStats:
     queue_depth: int
     requests_finished: int
     tokens_generated: int
+    requests_cancelled: int = 0
+    # KV paging fields (PagedInferenceEngine only; None on the dense
+    # engine and omitted from doc() so the wire schema stays stable)
+    kv_page_size: Optional[int] = None
+    kv_blocks_total: Optional[int] = None
+    kv_blocks_free: Optional[int] = None
+    kv_blocks_cached: Optional[int] = None
+    kv_evictions: Optional[int] = None
+    prefix_hit_rate: Optional[float] = None
+    prefill_tokens_saved: Optional[int] = None
 
     def doc(self) -> dict:
-        return dataclasses.asdict(self)
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
 
 
 class InferenceEngine:
@@ -116,6 +141,24 @@ class InferenceEngine:
         self._top_k, self._top_p = top_k, top_p
         self._rng = jax.random.PRNGKey(seed)
 
+        self._build_decode_path(base)
+
+        self.queue = RequestQueue(max_queue)
+        self._active: List[Optional[Request]] = [None] * slots
+        self._cur = np.zeros((slots,), np.int32)   # last token per slot
+        self._finished = 0
+        self._cancelled = 0
+        self._tokens_out = 0
+        self._stop = threading.Event()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        _SLOTS.set(float(slots))
+        _BUSY.set(0.0)
+
+    def _build_decode_path(self, base: LlamaConfig) -> None:
+        """Construct models, caches and jitted steps (the paged engine
+        overrides this with its pooled-cache counterparts)."""
+        slots = self.slots
         # decode model: [slots] per-row cache positions
         self._model = Llama(dataclasses.replace(base, decode_slot_index=True))
         self._cache = init_cache(lambda: self._model.init(
@@ -134,30 +177,24 @@ class InferenceEngine:
             logits, updated = self._model.apply(
                 {"params": params, "cache": cache}, tokens, mutable=["cache"]
             )
-            nxt, rng = sample_token(logits[:, -1], temperature, rng,
-                                    top_k=top_k, top_p=top_p)
+            nxt, rng = sample_token(
+                logits[:, -1], self._temperature, rng,
+                top_k=self._top_k, top_p=self._top_p)
             return updated["cache"], nxt, rng
 
         self._decode_step = jax.jit(decode_step, donate_argnums=(0,))
 
-        self.queue = RequestQueue(max_queue)
-        self._active: List[Optional[Request]] = [None] * slots
-        self._cur = np.zeros((slots,), np.int32)   # last token per slot
-        self._finished = 0
-        self._tokens_out = 0
-        self._stop = threading.Event()
-        self._closed = False
-        self._thread: Optional[threading.Thread] = None
-        _SLOTS.set(float(slots))
-        _BUSY.set(0.0)
-
     # -- request surface ---------------------------------------------------
 
     def submit(self, prompt: Sequence[int], *, max_new_tokens: int = 64,
-               request_id: Optional[str] = None) -> Request:
+               request_id: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> Request:
         """Admit a request (raises ``AdmissionError`` under backpressure,
         ``ValueError`` if it can never fit the cache). Returns the
-        :class:`Request`; wait with ``request.result(timeout)``."""
+        :class:`Request`; wait with ``request.result(timeout)``.
+        ``deadline_s``: optional client deadline relative to now — once it
+        passes the engine evicts the request mid-decode (slot and cache
+        blocks freed) and finishes it with the ``cancelled`` status."""
         if self._closed:
             # fail fast instead of admitting into a queue no loop will ever
             # drain (shutdown stops the engine before the RPC server, so
@@ -174,7 +211,10 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_seq_len ({self.cfg.max_seq_len})")
-        req = Request(prompt, max_new_tokens, request_id=request_id)
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        req = Request(prompt, max_new_tokens, request_id=request_id,
+                      deadline_s=deadline_s)
         return self.queue.submit(req)
 
     # -- engine loop -------------------------------------------------------
@@ -190,28 +230,56 @@ class InferenceEngine:
         return admitted or stepped
 
     def _reap_cancelled(self) -> None:
-        """Free slots whose waiter abandoned the request (client timeout):
-        decode steps are the scarce resource, and spending them on tokens
-        nobody will read starves live requests."""
+        """Free slots whose waiter abandoned the request (client timeout)
+        or whose client deadline passed: decode steps are the scarce
+        resource, and spending them on tokens nobody will read starves
+        live requests. Either way the request terminates with the
+        ``cancelled`` status (partial tokens stay readable)."""
+        for req in self.queue.reap_dead():
+            self._finish_cancelled(req)
         for slot, req in enumerate(self._active):
-            if req is not None and req.cancelled:
-                _REQUESTS.inc(status="cancelled")
-                req.finish(error="cancelled")
+            if req is None:
+                continue
+            if req.cancelled or req.expired:
+                # free BEFORE finishing: finish() wakes the waiter, and a
+                # client that sees its request done must also see the
+                # slot/blocks released (stats read-your-writes)
                 self._free(slot)
+                self._finish_cancelled(req)
+
+    def _finish_cancelled(self, req: Request) -> None:
+        _REQUESTS.inc(status="cancelled")
+        self._cancelled += 1
+        why = "cancelled: deadline exceeded" if req.expired and \
+            not req.cancelled else "cancelled"
+        req.finish(error=why, status="cancelled")
+
+    def _can_admit(self, req: Request) -> bool:
+        """Resource gate checked BEFORE popping the head of the queue; the
+        dense engine only needs the free slot the caller already found.
+        The paged engine overrides this with its KV block budget."""
+        return True
 
     def _admit(self) -> bool:
         admitted = False
         while any(r is None for r in self._active):
-            req = self.queue.pop()
+            req = self.queue.peek()
             if req is None:
                 break
-            if req.cancelled:
-                _REQUESTS.inc(status="cancelled")
-                req.finish(error="cancelled")
+            if req.cancelled or req.expired:
+                self.queue.pop()
+                self._finish_cancelled(req)
                 continue
+            if not self._can_admit(req):
+                # head-of-line waits for capacity (blocks free as running
+                # requests finish); skipping ahead would starve big prompts
+                break
+            self.queue.pop()
             slot = self._active.index(None)
             try:
                 self._prefill_into(slot, req)
+            except PoolCorruption:
+                raise        # engine-fatal: the shared pool was donated
             except Exception as e:  # noqa: BLE001 — request-scoped failure
                 _LOG.warning("prefill failed for %s: %s", req.id, e)
                 _REQUESTS.inc(status="error")
@@ -242,10 +310,6 @@ class InferenceEngine:
         first, self._rng = sample_token(
             last_logits, self._temperature, self._rng,
             top_k=self._top_k, top_p=self._top_p)
-        first = int(first[0])
-        now = time.monotonic()
-        req.first_token_at = now
-        _TTFT.observe(now - req.submitted_at)
 
         # splice the prefilled batch-1 cache into the slot's rows; the
         # scalar index leaves land in the [slots] index at this row
@@ -255,6 +319,14 @@ class InferenceEngine:
             return big.at[slot].set(small[0])
 
         self._cache = jax.tree_util.tree_map(ins, self._cache, cache)
+        self._finish_prefill(slot, req, int(first[0]))
+
+    def _finish_prefill(self, slot: int, req: Request, first: int) -> None:
+        """Shared prefill tail: record TTFT, emit the first token, and
+        either free the slot (one-token request) or activate it."""
+        now = time.monotonic()
+        req.first_token_at = now
+        _TTFT.observe(now - req.submitted_at)
         self._emit(slot, req, first, active=False)
         if req.done:
             self._free(slot)      # one-token request: slot never activates
@@ -265,21 +337,37 @@ class InferenceEngine:
     def _decode(self) -> bool:
         if not any(r is not None for r in self._active):
             return False
+        if not self._pre_decode():
+            return False
         t0 = time.monotonic()
         tokens = jnp.asarray(self._cur[:, None])
-        self._cache, nxt, self._rng = self._decode_step(
-            self._cache, self.params, tokens, self._rng)
+        self._cache, nxt, self._rng = self._run_decode_step(tokens)
         nxt = np.asarray(nxt)        # one host transfer for the whole batch
         dt = time.monotonic() - t0
         _STEP.observe(dt)
         n_active = sum(r is not None for r in self._active)
         _TPS.set(n_active / dt if dt > 0 else 0.0)
+        self._post_decode_step()
         for slot, req in enumerate(self._active):
             if req is None:
                 continue
             self._emit(slot, req, int(nxt[slot]), active=True)
         _BUSY.set(float(sum(r is not None for r in self._active)))
         return True
+
+    # decode-loop hooks (ONE loop body serves both engines — the paged
+    # subclass plugs in block growth, the page-table jit argument, and
+    # per-row length tracking without copying the metrics/emit choreography)
+
+    def _pre_decode(self) -> bool:
+        """Pre-step resource work; False aborts the round (nothing left)."""
+        return True
+
+    def _run_decode_step(self, tokens):
+        return self._decode_step(self._cache, self.params, tokens, self._rng)
+
+    def _post_decode_step(self) -> None:
+        """Bookkeeping between the device step and token emission."""
 
     def _emit(self, slot: int, req: Request, token: int, *,
               active: bool) -> None:
@@ -291,11 +379,13 @@ class InferenceEngine:
         _TOKENS.inc()
         hit_eos = self.eos_token is not None and token == self.eos_token
         if hit_eos or len(req.tokens) >= req.max_new_tokens:
-            req.finish()
             self._finished += 1
             _REQUESTS.inc(status="ok")
             if active:
+                # free BEFORE finish(): the waiter wakes on finish and
+                # must observe the slot/blocks already released
                 self._free(slot)
+            req.finish()
         elif active:
             self._cur[slot] = token
 
@@ -375,4 +465,304 @@ class InferenceEngine:
             queue_depth=self.queue.depth(),
             requests_finished=self._finished,
             tokens_generated=self._tokens_out,
+            requests_cancelled=self._cancelled,
+        )
+
+
+class PagedInferenceEngine(InferenceEngine):
+    """Continuous batching over a paged KV cache with radix prefix reuse.
+
+    The dense engine gives every slot a private ``[max_seq_len, ...]`` KV
+    row and prefills every prompt from token 0. This engine replaces both
+    with the serving-fabric standard (``lzy_tpu/serving/kv_cache.py``):
+
+    - K/V live in ONE pool of ``page_size``-token blocks shared by all
+      slots; each request holds a page table and commits HBM page by page
+      as it actually grows, so short requests stop paying for the longest
+      possible one and ``kv_blocks`` can be sized well below
+      ``slots * max_seq_len / page_size`` (overcommit).
+    - Prompts are matched against a ref-counted radix tree of previously
+      cached blocks: requests sharing a prompt prefix (system prompts,
+      few-shot headers) skip prefill for every matched block and only the
+      unmatched suffix runs through the model. Full prompt blocks are
+      inserted back after prefill for the next arrival.
+    - Admission is budgeted against free + evictable blocks (the slot
+      count alone no longer gates), eviction under pressure removes only
+      unreferenced cached blocks (LRU), and if overcommit squeezes decode
+      growth dry the YOUNGEST active request is preempted (clean
+      ``preempted`` error) — an in-flight request is never corrupted.
+
+    Outputs are bit-identical to the dense engine (and to the solo
+    ``generate()`` oracle) for greedy and sampled decode: the paged
+    attention path gathers blocks back into exactly the dense layout
+    before the shared score/mask/softmax code runs.
+    """
+
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        params: Any,
+        *,
+        slots: int = 4,
+        page_size: int = 16,
+        kv_blocks: Optional[int] = None,
+        **kwargs,
+    ):
+        from lzy_tpu.serving.kv_cache import RadixCache
+
+        base = decode_config(cfg)
+        if page_size < 1 or base.max_seq_len % page_size:
+            raise ValueError(
+                f"page_size ({page_size}) must divide max_seq_len "
+                f"({base.max_seq_len})")
+        self._page = page_size
+        self._pages_per_seq = base.max_seq_len // page_size
+        if kv_blocks is None:
+            # dense-equivalent HBM by default (+1 scratch); pass less to
+            # overcommit, more to grow the prefix cache's working set
+            kv_blocks = slots * self._pages_per_seq + 1
+        if kv_blocks < 2:
+            raise ValueError(f"kv_blocks must be >= 2, got {kv_blocks}")
+        self._kv_blocks = kv_blocks
+        self.kv = RadixCache(kv_blocks, page_size)
+        # page tables: [slots, pages_per_seq] block ids (0 = scratch pad);
+        # _slot_blocks mirrors the allocated prefix of each row in python
+        self._tables = np.zeros((slots, self._pages_per_seq), np.int32)
+        self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
+        self._lens = np.zeros((slots,), np.int64)      # cached tokens/slot
+        self._admit_seq = np.zeros((slots,), np.int64)  # admission order
+        self._admissions = 0
+        super().__init__(cfg, params, slots=slots, **kwargs)
+
+    # -- construction --------------------------------------------------------
+
+    def _build_decode_path(self, base: LlamaConfig) -> None:
+        pcfg = dataclasses.replace(
+            base, decode_paged=True, kv_page_size=self._page,
+            kv_pages=self._kv_blocks)
+        slots, pages = self.slots, self._pages_per_seq
+        self._model = Llama(pcfg)
+        dummy_pt = jnp.zeros((slots, pages), jnp.int32)
+        self._cache = init_cache(lambda: self._model.init(
+            jax.random.PRNGKey(0), jnp.zeros((slots, 1), jnp.int32),
+            page_table=dummy_pt))
+        # prefill reuses the SAME pool arrays with a batch-1 index; only
+        # the index leaves differ between the two cache trees
+        self._prefill_model = Llama(pcfg)
+
+        import functools
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def prefill_step(cache, params, tokens, page_table, last_idx):
+            logits, updated = self._prefill_model.apply(
+                {"params": params, "cache": cache}, tokens,
+                page_table=page_table, mutable=["cache"])
+            last = jax.lax.dynamic_index_in_dim(
+                logits, last_idx, axis=1, keepdims=False)
+            return updated["cache"], last
+
+        self._prefill_step = prefill_step
+
+        def decode_step(cache, params, tokens, page_table, rng):
+            logits, updated = self._model.apply(
+                {"params": params, "cache": cache}, tokens,
+                page_table=page_table, mutable=["cache"])
+            nxt, rng = sample_token(
+                logits[:, -1], self._temperature, rng,
+                top_k=self._top_k, top_p=self._top_p)
+            return updated["cache"], nxt, rng
+
+        self._decode_step = jax.jit(decode_step, donate_argnums=(0,))
+
+    # -- cache-tree plumbing -------------------------------------------------
+
+    @staticmethod
+    def _is_index(path) -> bool:
+        return any(getattr(p, "key", None) == "index" for p in path)
+
+    def _pool_to_prefill(self, start: int):
+        """The decode cache tree re-skinned for a batch-1 prefill: pool
+        k/v leaves move over unchanged (they are ABOUT to be donated —
+        ``self._cache`` must not be touched until ``_merge_prefill``
+        replaces them), index leaves become ``[1]`` at ``start``."""
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: jnp.full((1,), start, jnp.int32)
+            if self._is_index(path) else leaf,
+            self._cache)
+
+    def _merge_prefill(self, pre_cache, slot: int, length: int) -> None:
+        """Fold a finished prefill back into the decode tree: pool k/v
+        leaves are taken from the prefill output (the decode tree's were
+        donated), the slot's index row is set to the true prompt length
+        (rewinding any padded-chunk advance)."""
+        self._cache = jax.tree_util.tree_map_with_path(
+            lambda path, dec, pre: dec.at[slot].set(length)
+            if self._is_index(path) else pre,
+            self._cache, pre_cache)
+
+    # -- admission / prefill -------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], **kwargs) -> Request:
+        from lzy_tpu.serving.kv_cache import blocks_for
+
+        prompt = list(prompt)
+        # reject prompts the pool can NEVER cover: past submit they would
+        # park at the head of the queue forever (head-of-line admission
+        # waits for blocks that cannot exist) and starve everyone behind
+        if prompt and blocks_for(len(prompt), self._page) > self._kv_blocks - 1:
+            raise ValueError(
+                f"prompt ({len(prompt)} tokens) needs "
+                f"{blocks_for(len(prompt), self._page)} KV blocks but the "
+                f"pool only has {self._kv_blocks - 1}; raise kv_blocks or "
+                f"shorten the prompt")
+        return super().submit(prompt, **kwargs)
+
+    def _can_admit(self, req: Request) -> bool:
+        """Admission is gated on the BLOCK budget, not the slot count: the
+        whole prompt must be coverable right now (matched prefix counted
+        conservatively — it may or may not already be pinned by another
+        request). Decode growth beyond the prompt is overcommitted and
+        backstopped by eviction + youngest-preemption."""
+        from lzy_tpu.serving.kv_cache import blocks_for
+
+        return self.kv.available() >= blocks_for(len(req.prompt), self._page)
+
+    def _prefill_into(self, slot: int, req: Request) -> None:
+        from lzy_tpu.models.generate import prefill_plan
+
+        prompt = req.prompt
+        t0 = len(prompt)
+        page = self._page
+        # longest cached whole-block prefix; capped at prompt[:-1] so at
+        # least one real token remains to forward (logits for the first
+        # generated token must come from an actual prefill position)
+        blocks, matched = self.kv.match(prompt[:-1])
+        suffix = prompt[matched:]
+        plan = prefill_plan(len(suffix), self.prefill_chunk,
+                            self.cfg.max_seq_len - matched)
+        # blocks for the REAL prompt positions only: a padded final
+        # chunk's pad positions (>= t0) fall past the table's allocated
+        # prefix, map to the scratch block, and are masked garbage by
+        # construction — allocating coverage for them would waste up to
+        # bucket_width/page blocks per short request
+        from lzy_tpu.serving.kv_cache import blocks_for
+
+        try:
+            owned = self.kv.allocate(blocks_for(t0, page) - len(blocks))
+        except Exception:
+            self.kv.release(blocks)   # roll back the match refs
+            raise
+        table = blocks + owned
+        self._tables[slot, :len(table)] = table
+        self._tables[slot, len(table):] = 0
+        pt = jnp.asarray(self._tables[slot:slot + 1])
+
+        # everything device-side below donates the SHARED pool: a failure
+        # here poisons every request, not just this one
+        try:
+            cache = self._pool_to_prefill(matched)
+            suffix_arr = jnp.asarray([suffix], jnp.int32)
+            last = None
+            for start, take, width in plan:
+                tokens = suffix_arr[:, start:start + take]
+                if width != take:
+                    tokens = jnp.pad(tokens, ((0, 0), (0, width - take)))
+                cache, last = self._prefill_step(
+                    cache, self.params, tokens, pt,
+                    jnp.asarray(take - 1, jnp.int32))
+            first, self._rng = sample_token(
+                last, self._temperature, self._rng,
+                top_k=self._top_k, top_p=self._top_p)
+            self._merge_prefill(cache, slot, t0)
+        except Exception as e:  # noqa: BLE001 — see PoolCorruption
+            raise PoolCorruption(
+                f"paged prefill died mid-flight for {req.id}: "
+                f"{type(e).__name__}: {e}") from e
+
+        # register the prompt's full blocks for future prefix hits (the
+        # matched prefix nodes already exist and are skipped; pad garbage
+        # only ever lands at positions >= t0, never inside a full block)
+        n_full = t0 // page
+        if n_full:
+            self.kv.insert(prompt[:n_full * page], table[:n_full])
+        self._slot_blocks[slot] = table
+        self._lens[slot] = t0
+        self._admissions += 1
+        self._admit_seq[slot] = self._admissions
+        self._finish_prefill(slot, req, int(first[0]))
+
+    # -- decode --------------------------------------------------------------
+
+    def _grow_for_decode(self) -> None:
+        """Make sure every active slot has a block for its next write
+        position; under a squeeze, evict cached blocks (allocate does)
+        and as a last resort preempt the youngest active request — never
+        a block some other in-flight request references."""
+        from lzy_tpu.serving.kv_cache import NoFreeBlocks
+
+        for slot, req in enumerate(self._active):
+            if req is None:
+                continue
+            pidx = int(self._lens[slot]) // self._page
+            while pidx >= len(self._slot_blocks[slot]):
+                try:
+                    block = self.kv.allocate(1)[0]
+                except NoFreeBlocks:
+                    victim = self._preempt_youngest()
+                    if victim == slot:
+                        break     # preempted ourselves; slot is free now
+                    continue
+                self._slot_blocks[slot].append(block)
+                self._tables[slot, len(self._slot_blocks[slot]) - 1] = block
+
+    def _preempt_youngest(self) -> int:
+        """Fail the most recently admitted active request (its waiter gets
+        a clean ``preempted`` error) and free its blocks; protecting older
+        requests first matches their larger sunk decode cost."""
+        victim = max(
+            (s for s, r in enumerate(self._active) if r is not None),
+            key=lambda s: self._admit_seq[s])
+        req = self._active[victim]
+        _LOG.warning("kv block pool exhausted: preempting %s", req.id)
+        _REQUESTS.inc(status="preempted")
+        self._free(victim)     # free before finish (see _reap_cancelled)
+        req.finish(error="preempted: kv block pool exhausted")
+        return victim
+
+    def _pre_decode(self) -> bool:
+        self._grow_for_decode()
+        # False when the squeeze preempted everyone
+        return any(r is not None for r in self._active)
+
+    def _run_decode_step(self, tokens):
+        pt = jnp.asarray(self._tables)
+        return self._decode_step(self._cache, self.params, tokens, pt,
+                                 self._rng)
+
+    def _post_decode_step(self) -> None:
+        for slot, req in enumerate(self._active):
+            if req is not None:
+                self._lens[slot] += 1     # the step wrote at the old length
+
+    def _free(self, slot: int) -> None:
+        super()._free(slot)
+        blocks = self._slot_blocks[slot]
+        self._slot_blocks[slot] = []
+        self._tables[slot, :] = 0
+        self._lens[slot] = 0
+        self._admit_seq[slot] = 0
+        self.kv.release(blocks)
+
+    def stats(self) -> EngineStats:
+        s = super().stats()
+        ks = self.kv.stats()
+        return dataclasses.replace(
+            s,
+            kv_page_size=self._page,
+            kv_blocks_total=ks.blocks_total,
+            kv_blocks_free=ks.blocks_free,
+            kv_blocks_cached=ks.blocks_cached,
+            kv_evictions=ks.evictions,
+            prefix_hit_rate=round(ks.hit_rate, 4),
+            prefill_tokens_saved=ks.prefill_tokens_saved,
         )

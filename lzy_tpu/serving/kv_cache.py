@@ -1,0 +1,349 @@
+"""Paged KV-cache pool with radix prefix caching (serving plane).
+
+The continuous-batching engine historically gave every decode slot a dense
+``[max_seq_len, ...]`` KV allocation and prefilled every prompt from token
+0 — HBM paid for the *longest possible* request while serving mostly short
+ones, and shared prompt prefixes (system prompts, few-shot headers) were
+recomputed on every arrival. This module is the standard serving-fabric
+fix, in two pieces:
+
+- :class:`BlockPool` — a fixed pool of ``page_size``-token KV **blocks**.
+  A request's cache is a *page table* (list of block ids) instead of a
+  dense row, so HBM is committed page-by-page as the request actually
+  grows. Block 0 is a reserved scratch page: idle decode rows and padded
+  positions write there, so an engine-side indexing bug can corrupt only
+  garbage nobody reads.
+- :class:`RadixCache` — the pool plus a ref-counted radix tree over
+  **full-block token chunks**: node = one block whose ``page_size`` token
+  ids are the edge key. A new request walks its prompt down the tree and
+  reuses every matched block (prefill skips those tokens entirely); full
+  prompt blocks are inserted back after prefill so the next request can
+  hit them. Blocks referenced by an in-flight request are pinned
+  (refcount > 0); unreferenced tree leaves are evicted LRU under memory
+  pressure — eviction can therefore never touch live state.
+
+LRU order uses a logical clock (a counter bumped per tree operation), not
+wall time, so eviction order is deterministic under test.
+
+Prefix hit rate, blocks in use/free, evictions, and prefill tokens saved
+are exported via ``lzy_tpu.utils.metrics.REGISTRY`` and surfaced through
+``InferStats`` (see ``serving/engine.py``) and ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from lzy_tpu.utils.metrics import REGISTRY
+
+_BLOCKS = REGISTRY.gauge(
+    "lzy_kv_blocks", "KV block pool capacity (scratch block included)")
+_FREE = REGISTRY.gauge(
+    "lzy_kv_blocks_free", "KV blocks on the free list")
+_CACHED = REGISTRY.gauge(
+    "lzy_kv_blocks_cached",
+    "unreferenced blocks held by the prefix tree (reusable, evictable)")
+_EVICTIONS = REGISTRY.counter(
+    "lzy_kv_evictions_total", "prefix-tree blocks evicted under pressure")
+_HIT_TOKENS = REGISTRY.counter(
+    "lzy_kv_prefix_hit_tokens_total",
+    "prompt tokens served from cached prefix blocks (prefill skipped)")
+_LOOKUP_TOKENS = REGISTRY.counter(
+    "lzy_kv_prefix_lookup_tokens_total",
+    "prompt tokens offered to the prefix tree at admission")
+_HIT_RATE = REGISTRY.gauge(
+    "lzy_kv_prefix_hit_rate",
+    "cumulative hit tokens / lookup tokens")
+
+
+class NoFreeBlocks(RuntimeError):
+    """The pool cannot satisfy an allocation even after evicting every
+    unreferenced cached block — the caller must wait, shed, or preempt."""
+
+
+@dataclasses.dataclass
+class KVCacheStats:
+    blocks_total: int          # pool capacity minus the scratch block
+    blocks_free: int
+    blocks_cached: int         # unreferenced blocks kept by the tree
+    evictions: int
+    prefix_hit_tokens: int
+    prefix_lookup_tokens: int
+
+    @property
+    def prefill_tokens_saved(self) -> int:
+        return self.prefix_hit_tokens
+
+    @property
+    def hit_rate(self) -> float:
+        if self.prefix_lookup_tokens == 0:
+            return 0.0
+        return self.prefix_hit_tokens / self.prefix_lookup_tokens
+
+
+class BlockPool:
+    """Fixed pool of ``page_size``-token KV blocks with refcounts.
+
+    Allocation hands out block *ids* (rows of the engine's pooled
+    ``[n_blocks, page_size, kv, d]`` cache arrays); the K/V data itself
+    lives on device. Refcounts count request holders — the pool never
+    decides what an unreferenced block means (cached vs dead); that policy
+    lives in :class:`RadixCache`.
+    """
+
+    def __init__(self, n_blocks: int, page_size: int):
+        if n_blocks < 2:
+            raise ValueError(
+                f"pool needs >= 2 blocks (1 scratch + 1 usable), got "
+                f"{n_blocks}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.n_blocks = n_blocks
+        self.page_size = page_size
+        # LIFO free list, block 0 reserved as the scratch page
+        self._free: List[int] = list(range(n_blocks - 1, 0, -1))
+        self._ref = [0] * n_blocks
+
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc(self) -> int:
+        """One fresh block, refcount 1 (the caller's reference)."""
+        if not self._free:
+            raise NoFreeBlocks("kv block pool exhausted")
+        block = self._free.pop()
+        self._ref[block] = 1
+        return block
+
+    def incref(self, block: int) -> int:
+        self._ref[block] += 1
+        return self._ref[block]
+
+    def decref(self, block: int) -> int:
+        if self._ref[block] <= 0:
+            raise AssertionError(f"decref of unreferenced block {block}")
+        self._ref[block] -= 1
+        return self._ref[block]
+
+    def refcount(self, block: int) -> int:
+        return self._ref[block]
+
+    def release_to_free(self, block: int) -> None:
+        if self._ref[block] != 0:
+            raise AssertionError(
+                f"freeing block {block} with refcount {self._ref[block]}")
+        self._free.append(block)
+
+
+class _Node:
+    """One radix-tree node: a full block whose edge key is its token chunk."""
+
+    __slots__ = ("chunk", "block", "children", "parent", "last_access")
+
+    def __init__(self, chunk: Optional[Tuple[int, ...]], block: Optional[int],
+                 parent: Optional["_Node"]):
+        self.chunk = chunk
+        self.block = block
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.parent = parent
+        self.last_access = 0
+
+
+class RadixCache:
+    """Block pool + ref-counted radix tree over token-id chunks.
+
+    The engine calls, per request lifecycle:
+
+    - :meth:`match` at prefill — longest cached whole-block prefix; the
+      matched blocks are incref'd (pinned for the request's lifetime).
+    - :meth:`allocate` — fresh blocks for the unmatched suffix and for
+      decode growth, evicting LRU unreferenced tree leaves as needed.
+    - :meth:`insert` after prefill — registers the prompt's full blocks
+      so future requests can hit them.
+    - :meth:`release` on EOS/cancel/preempt — drops the request's refs;
+      unreferenced blocks *in* the tree stay cached (evictable),
+      unreferenced blocks *outside* it return to the free list.
+    """
+
+    def __init__(self, n_blocks: int, page_size: int):
+        self.pool = BlockPool(n_blocks, page_size)
+        self.page_size = page_size
+        self._root = _Node(None, None, None)
+        self._node_of: Dict[int, _Node] = {}
+        self._clock = 0          # logical LRU clock — deterministic
+        self.evictions = 0
+        self.hit_tokens = 0
+        self.lookup_tokens = 0
+        self._update_gauges()
+
+    # -- tree ----------------------------------------------------------------
+
+    def _chunks(self, tokens: Sequence[int]) -> List[Tuple[int, ...]]:
+        page = self.page_size
+        return [tuple(tokens[i:i + page])
+                for i in range(0, len(tokens) - len(tokens) % page, page)]
+
+    def match(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached prefix of ``tokens`` in whole blocks; returns
+        ``(block_ids, n_tokens_matched)``. Matched blocks are incref'd —
+        callers own one reference per returned block (drop it with
+        :meth:`release`). Pass ``prompt[:-1]`` to guarantee at least one
+        suffix token remains for prefill (logits need a real forward
+        position)."""
+        self._clock += 1
+        node = self._root
+        blocks: List[int] = []
+        for chunk in self._chunks(tokens):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            child.last_access = self._clock
+            blocks.append(child.block)
+            node = child
+        for b in blocks:
+            self.pool.incref(b)
+        self.hit_tokens += len(blocks) * self.page_size
+        self.lookup_tokens += len(tokens)
+        _HIT_TOKENS.inc(len(blocks) * self.page_size)
+        _LOOKUP_TOKENS.inc(len(tokens))
+        self._update_gauges()
+        return blocks, len(blocks) * self.page_size
+
+    def match_len(self, tokens: Sequence[int]) -> int:
+        """Read-only probe of :meth:`match` — no refs taken, no metrics,
+        no LRU bump. Safe to call repeatedly (tests and operators peek at
+        cache contents with it) without distorting hit-rate stats or
+        eviction order."""
+        node = self._root
+        n = 0
+        for chunk in self._chunks(tokens):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            n += 1
+            node = child
+        return n * self.page_size
+
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> int:
+        """Register full-chunk ``blocks`` (one per ``page_size`` chunk of
+        ``tokens``) in the tree; returns how many nodes were newly created.
+        Chunks that already have a node keep the existing block — the
+        caller's duplicate block simply stays private to its request."""
+        self._clock += 1
+        node = self._root
+        created = 0
+        for chunk, block in zip(self._chunks(tokens), blocks):
+            child = node.children.get(chunk)
+            if child is None:
+                child = _Node(chunk, block, node)
+                node.children[chunk] = child
+                self._node_of[block] = child
+                created += 1
+            child.last_access = self._clock
+            node = child
+        self._update_gauges()
+        return created
+
+    # -- allocation / eviction ----------------------------------------------
+
+    def allocate(self, n: int) -> List[int]:
+        """``n`` fresh blocks (refcount 1 each), evicting LRU unreferenced
+        tree leaves as needed. Raises :class:`NoFreeBlocks` — *before*
+        taking any block — if the pool cannot cover the request even after
+        evicting everything evictable."""
+        if n > self.available():
+            raise NoFreeBlocks(
+                f"need {n} blocks, only {self.available()} available "
+                f"(free + evictable)")
+        out = []
+        for _ in range(n):
+            if self.pool.free_count() == 0:
+                evicted = self._evict_one()
+                assert evicted, "available() promised an evictable block"
+            out.append(self.pool.alloc())
+        self._update_gauges()
+        return out
+
+    def release(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per block. Unreferenced blocks in the tree
+        stay cached (evictable); unreferenced blocks outside it return to
+        the free list immediately."""
+        for b in blocks:
+            if self.pool.decref(b) == 0 and b not in self._node_of:
+                self.pool.release_to_free(b)
+        self._update_gauges()
+
+    def _evictable_leaves(self) -> List[_Node]:
+        out: List[_Node] = []
+
+        def walk(node: _Node) -> None:
+            for child in node.children.values():
+                if child.children:
+                    walk(child)
+                elif self.pool.refcount(child.block) == 0:
+                    out.append(child)
+
+        walk(self._root)
+        return out
+
+    def _evict_one(self) -> bool:
+        """Evict the least-recently-used unreferenced leaf; returns False
+        when nothing is evictable (every cached block is pinned by an
+        in-flight request)."""
+        leaves = self._evictable_leaves()
+        if not leaves:
+            return False
+        victim = min(leaves, key=lambda node: node.last_access)
+        del victim.parent.children[victim.chunk]
+        del self._node_of[victim.block]
+        self.pool.release_to_free(victim.block)
+        self.evictions += 1
+        _EVICTIONS.inc()
+        return True
+
+    def available(self) -> int:
+        """Blocks an :meth:`allocate` could obtain right now: the free
+        list plus every tree block in a fully-unreferenced subtree (those
+        evict leaf-by-leaf until the whole subtree is gone)."""
+
+        def count(node: _Node) -> Tuple[int, bool]:
+            n_evictable, all_free = 0, True
+            for child in node.children.values():
+                c_n, c_free = count(child)
+                n_evictable += c_n
+                all_free = all_free and c_free
+            if node is self._root:
+                return n_evictable, all_free
+            if all_free and self.pool.refcount(node.block) == 0:
+                return n_evictable + 1, True
+            return n_evictable, False
+
+        return self.pool.free_count() + count(self._root)[0]
+
+    def cached_count(self) -> int:
+        """Tree blocks currently unreferenced (reusable, evictable)."""
+        return sum(1 for b in self._node_of if self.pool.refcount(b) == 0)
+
+    # -- observability -------------------------------------------------------
+
+    def _update_gauges(self) -> None:
+        _BLOCKS.set(float(self.pool.n_blocks))
+        _FREE.set(float(self.pool.free_count()))
+        _CACHED.set(float(self.cached_count()))
+        _HIT_RATE.set(self.stats().hit_rate)
+
+    def stats(self) -> KVCacheStats:
+        return KVCacheStats(
+            blocks_total=self.pool.n_blocks - 1,    # scratch excluded
+            blocks_free=self.pool.free_count(),
+            blocks_cached=self.cached_count(),
+            evictions=self.evictions,
+            prefix_hit_tokens=self.hit_tokens,
+            prefix_lookup_tokens=self.lookup_tokens,
+        )
+
+
+def blocks_for(n_tokens: int, page_size: int) -> int:
+    """Blocks needed to hold ``n_tokens`` cache positions."""
+    return -(-n_tokens // page_size)

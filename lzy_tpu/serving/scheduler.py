@@ -36,17 +36,28 @@ class Request:
 
     ``tokens`` accumulates generated ids (no prompt echo); ``result()``
     blocks until the engine marks the request finished. ``error`` carries
-    an engine-side failure (e.g. over-long prompt at prefill time)."""
+    an engine-side failure (e.g. over-long prompt at prefill time).
+
+    ``deadline_s`` is a client deadline relative to submission: once it
+    passes, the engine evicts the request mid-decode (slot and KV-cache
+    blocks freed) and finishes it with the ``cancelled`` terminal status —
+    partial tokens stay readable on ``tokens``, and the RPC surface
+    returns them with ``status: "cancelled"`` instead of raising."""
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 deadline_s: Optional[float] = None):
         self.id = request_id or f"req-{next(_ids)}"
         self.prompt: List[int] = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.tokens: List[int] = []
         self.error: Optional[str] = None
+        self.status: Optional[str] = None     # "ok" | "cancelled" | "error"
         self.cancelled = False
         self.submitted_at = time.monotonic()
+        self.deadline: Optional[float] = (
+            self.submitted_at + float(deadline_s)
+            if deadline_s is not None else None)
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self._done = threading.Event()
@@ -58,10 +69,21 @@ class Request:
         engine stops spending decode steps on tokens nobody will read."""
         self.cancelled = True
 
-    def finish(self, error: Optional[str] = None) -> None:
+    @property
+    def expired(self) -> bool:
+        """Client deadline passed (the engine reaps these like cancels)."""
+        return self.deadline is not None and time.monotonic() > self.deadline
+
+    def finish(self, error: Optional[str] = None,
+               status: Optional[str] = None) -> None:
         self.error = error
+        self.status = status or ("ok" if error is None else "error")
         self.finished_at = time.monotonic()
         self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until finished (any terminal status); True if it did."""
+        return self._done.wait(timeout)
 
     @property
     def done(self) -> bool:
@@ -105,6 +127,25 @@ class RequestQueue:
             req = self._q.popleft() if self._q else None
             _QUEUE_DEPTH.set(float(len(self._q)))
             return req
+
+    def peek(self) -> Optional[Request]:
+        """Head of the queue without removing it — the engine budgets a
+        request's KV blocks BEFORE committing to pop it (single consumer,
+        so peek-then-pop returns the same request)."""
+        with self._lock:
+            return self._q[0] if self._q else None
+
+    def reap_dead(self) -> List[Request]:
+        """Remove every cancelled/expired request, wherever it sits in
+        the queue — a passed deadline must terminate promptly even while
+        every slot is busy, not when a slot finally frees."""
+        with self._lock:
+            dead = [r for r in self._q if r.cancelled or r.expired]
+            if dead:
+                self._q = deque(r for r in self._q
+                                if not (r.cancelled or r.expired))
+                _QUEUE_DEPTH.set(float(len(self._q)))
+        return dead
 
     def depth(self) -> int:
         with self._lock:

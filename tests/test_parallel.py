@@ -419,3 +419,69 @@ class TestEvalStep:
                         {"x": jnp.arange(8.0)})
         np.testing.assert_allclose(float(out["mean"]), 7.0)
         np.testing.assert_allclose(float(out["max"]), 14.0)
+
+
+class TestCustomRuleThreading:
+    """ADVICE r5: activation anchors and the batch-sharded attention
+    wrapper must follow the ACTIVE rule table, not assume DEFAULT_RULES
+    and dp/fsdp/tp axis names — a remapped deployment (here: one custom
+    'data' axis) used to crash on the missing mesh axes."""
+
+    def _rules(self):
+        return {"batch": "data", "embed": "data", "vocab": None,
+                "mlp": None, "heads": None, "heads_merged": None,
+                "seq": None, "act_embed": None, "act_vocab": None,
+                "act_mlp": None, "act_heads": None, "channels_out": None}
+
+    def test_llama_trains_on_remapped_mesh(self):
+        from jax.sharding import Mesh
+
+        from lzy_tpu.models import llama, unbox
+        from lzy_tpu.models.llama import LlamaConfig
+
+        cfg = LlamaConfig.tiny(vocab_size=128)
+        mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+        rules = self._rules()
+        boxed, axes = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tx = optax.adamw(1e-3)
+        step, shard_state, _ = make_train_step(
+            llama.make_loss_fn(cfg, mesh, rules=rules), tx, mesh=mesh,
+            param_logical_axes=axes, rules=rules,
+            batch_logical_axes=("batch", "seq"))
+        state = shard_state(TrainState.create(unbox(boxed), tx))
+        batch = {"tokens": jax.random.randint(
+            jax.random.PRNGKey(1), (16, 32), 0, cfg.vocab_size)}
+        state, metrics = step(state, batch)
+        assert 0.0 < float(metrics["loss"]) < 20.0
+        emb = state.params["embed_tokens"]
+        assert "data" in str(emb.sharding.spec), emb.sharding.spec
+
+    def test_remapped_matches_default_rules_numerics(self):
+        """Sharding rules relocate data; they must not change the loss."""
+        from lzy_tpu.models import llama, unbox
+        from lzy_tpu.models.llama import LlamaConfig
+
+        cfg = LlamaConfig.tiny(vocab_size=128)
+        boxed, _ = llama.init_params(cfg, jax.random.PRNGKey(0))
+        params = unbox(boxed)
+        batch = {"tokens": jax.random.randint(
+            jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab_size)}
+
+        from jax.sharding import Mesh
+
+        default_mesh = mesh_for(8, fsdp=-1)
+        ref = float(jax.jit(llama.make_loss_fn(cfg, default_mesh))(
+            params, batch))
+        custom = Mesh(np.array(jax.devices()[:8]), ("data",))
+        got = float(jax.jit(llama.make_loss_fn(
+            cfg, custom, rules=self._rules()))(params, batch))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_freeze_rules_roundtrip(self):
+        from lzy_tpu.parallel.sharding import freeze_rules
+
+        rules = {"batch": ("dp", "fsdp"), "embed": "fsdp", "seq": None}
+        frozen = freeze_rules(rules)
+        assert hash(frozen) is not None
+        assert dict(frozen) == rules
+        assert freeze_rules(None) is None and freeze_rules({}) is None

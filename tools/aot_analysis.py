@@ -340,59 +340,61 @@ def targets() -> dict:
 
     from bench import pick_config
 
+    # pick_config now returns the PROMOTED fused-b16 headline (fused CE +
+    # nothing-saveable remat, batch 16 — the config whose row says fits:
+    # yes); the pre-promotion dense no-remat config survives here as the
+    # secondary probe and the kept-as-evidence non-fitting northstar row
     cfg, batch, seq, _, _ = pick_config("tpu")
+    dense = dataclasses.replace(cfg, fused_ce=False, remat=False)
+    dense_batch = 8
     return {
-        # exactly the driver-bench headline: one v5e chip, 350M llama
+        # exactly the driver-bench headline: one v5e chip, 350M llama,
+        # fused-b16 (8.55 GB / bound 0.79 — fits)
         "bench_1chip": dict(
             cfg=cfg, topo="v5e-1", global_batch=batch, seq_len=seq,
             mesh_axes={"fsdp": -1}),
-        # the fused-CE doubled-batch variant bench promotes when it fits;
-        # remat=False OOMs at 23 GB and even the dots policy still needed
-        # 21 GB (both recorded by earlier runs of this tool), so the
-        # variant recomputes everything in backward (nothing_saveable)
-        "bench_1chip_fused_b16": dict(
-            cfg=dataclasses.replace(cfg, fused_ce=True, remat=True,
-                                    remat_policy="nothing"),
-            topo="v5e-1", global_batch=16, seq_len=seq,
+        # the demoted dense b8 secondary probe; its row documents WHY the
+        # promotion happened (17.1 GB with remat off — fits: NO)
+        "bench_1chip_dense_b8": dict(
+            cfg=dense, topo="v5e-1", global_batch=dense_batch, seq_len=seq,
             mesh_axes={"fsdp": -1}),
         # BASELINE.json north star: multi-host v5e-16, pure fsdp,
-        # same per-chip load as the 1-chip headline. The plain config is
+        # same per-chip load as the old dense headline. The plain config is
         # kept although it does NOT fit (17.05 GB, the f32 logits +
         # remat=False activations) — that OOM row is itself evidence the
         # driver bench needs the fused variant on this topology
         "northstar_v5e16_fsdp": dict(
-            cfg=cfg, topo="v5e-16", global_batch=batch * 16, seq_len=seq,
-            mesh_axes={"fsdp": -1}),
+            cfg=dense, topo="v5e-16", global_batch=dense_batch * 16,
+            seq_len=seq, mesh_axes={"fsdp": -1}),
         # the config the driver bench should actually run on a v5e-16:
         # logits-free chunked CE + dots-remat restores the memory headroom
         # (fused alone missed the 15.75 GB budget by 221 MB), which also
         # stops the scheduler's all-gather refetching (param re-gathers
         # under HBM pressure) that inflates t_ici
         "northstar_v5e16_fsdp_fused": dict(
-            cfg=dataclasses.replace(cfg, fused_ce=True, remat=True,
-                                    remat_policy="dots"),
-            topo="v5e-16", global_batch=batch * 16, seq_len=seq,
+            cfg=dataclasses.replace(cfg, remat_policy="dots"),
+            topo="v5e-16", global_batch=dense_batch * 16, seq_len=seq,
             mesh_axes={"fsdp": -1}),
         # best-per-chip candidate on the slice: fused CE WITHOUT remat —
         # logits-free frees enough HBM at b8/chip that no recompute
         # re-reads are needed; dots-remat costs ~2x HBM traffic
         "northstar_v5e16_fsdp_fused_noremat": dict(
-            cfg=dataclasses.replace(cfg, fused_ce=True), topo="v5e-16",
-            global_batch=batch * 16, seq_len=seq, mesh_axes={"fsdp": -1}),
+            cfg=dataclasses.replace(cfg, remat=False), topo="v5e-16",
+            global_batch=dense_batch * 16, seq_len=seq,
+            mesh_axes={"fsdp": -1}),
         # control experiment: identical config on a single-host 16-chip
         # topology — separates what the partitioner does to the sharding
         # from what it does about the DCN (4-process) boundary
         "northstar_v5e16_1host_fused": dict(
-            cfg=dataclasses.replace(cfg, fused_ce=True, remat=True,
-                                    remat_policy="dots"),
-            topo="v5e-16-1host", global_batch=batch * 16, seq_len=seq,
+            cfg=dataclasses.replace(cfg, remat_policy="dots"),
+            topo="v5e-16-1host", global_batch=dense_batch * 16, seq_len=seq,
             mesh_axes={"fsdp": -1}),
         # dp x fsdp hybrid on the same slice: dp=4 cuts the param
         # all-gather ring from 16 to 4 chips at the cost of 4x grad
         # all-reduce participants — the analysis quantifies the tradeoff
         "v5e16_dp4_fsdp4": dict(
-            cfg=cfg, topo="v5e-16", global_batch=batch * 16, seq_len=seq,
-            mesh_axes={"dp": 4, "fsdp": -1}),
+            cfg=dense, topo="v5e-16", global_batch=dense_batch * 16,
+            seq_len=seq, mesh_axes={"dp": 4, "fsdp": -1}),
     }
 
 
