@@ -379,6 +379,16 @@ def run() -> None:
     if extra:
         detail.update(extra)
         emit()
+    extra = fleet_decode_measurement(
+        jax, cfg, params,
+        replicas=2,
+        slots=4 if is_tpu else 2,
+        prompt_len=64 if is_tpu else 16,
+        new_tokens=32 if is_tpu else 8,
+        n_requests=8 if is_tpu else 4)
+    if extra:
+        detail.update(extra)
+        emit()
     if platform in ("tpu", "axon"):
         # each extra pass builds a whole second model+optimizer: evict the
         # previous one (buffers AND compiled executables) first or OOM
@@ -617,6 +627,76 @@ def paged_decode_measurement(jax, cfg, params, *, batch_size: int,
                 "paged_decode_page_size": page_size}
     except Exception as e:  # noqa: BLE001 — diagnostics only
         _log(f"paged decode skipped: {type(e).__name__}: {e}")
+        return {}
+
+
+def fleet_decode_measurement(jax, cfg, params, *, replicas: int,
+                             slots: int, prompt_len: int,
+                             new_tokens: int, n_requests: int):
+    """Best-effort serving-fleet point: aggregate decode throughput of a
+    multi-replica gateway (lzy_tpu/gateway) over the SAME engines the
+    single-engine ``decode_tokens_per_s`` probe models — the fleet number
+    next to the single number is the scaling evidence. Drives a
+    shared-prefix workload through the prefix-affinity router with one
+    client thread per decode slot, and reports the per-replica token
+    breakdown so imbalance is a number, not a guess. Wrapped so a hiccup
+    never loses the headline metric."""
+    try:
+        from concurrent import futures as _futures
+
+        from lzy_tpu.gateway import (
+            GatewayService, PrefixAffinityRouter, ReplicaFleet)
+        from lzy_tpu.serving import InferenceEngine
+
+        _log(f"fleet decode: building {replicas} replicas x "
+             f"{slots} slots...")
+        fleet = ReplicaFleet(
+            lambda: InferenceEngine(cfg, params, slots=slots,
+                                    max_queue=2 * n_requests))
+        # router chunk 8 so the shared prefix below spans FULL chunks on
+        # every config — prompts must share whole chunks or affinity is
+        # structurally unmeasurable
+        gw = GatewayService(fleet, router=PrefixAffinityRouter(8),
+                            model_name="bench",
+                            max_waiters=replicas * slots + 2)
+        try:
+            for _ in range(replicas):
+                fleet.add_replica()
+            shared = list(range(1, prompt_len - prompt_len % 8 + 1))
+            prompts = [shared + [i % 50 + 2, i % 30 + 2]
+                       for i in range(n_requests)]
+            # warmup: compile prefill + decode once (shared jit cache)
+            gw.generate(prompts[0], max_new_tokens=2, timeout_s=300)
+            # engine counters are cumulative — snapshot after warmup so
+            # the reported breakdown covers exactly the timed window
+            base = {r.id: r.engine.stats().tokens_generated
+                    for r in fleet.replicas()}
+            _log(f"fleet decode: timing {n_requests} requests x "
+                 f"{new_tokens} tokens...")
+            t0 = time.perf_counter()
+            with _futures.ThreadPoolExecutor(replicas * slots) as pool:
+                results = list(pool.map(
+                    lambda p: gw.generate(p, max_new_tokens=new_tokens,
+                                          timeout_s=300),
+                    prompts))
+            dt = time.perf_counter() - t0
+            total = sum(len(r["tokens"]) for r in results)
+            per_replica = {
+                r.id: r.engine.stats().tokens_generated - base.get(r.id, 0)
+                for r in fleet.replicas()}
+            stats = gw.stats()
+        finally:
+            gw.close()
+        tps = total / dt
+        _log(f"fleet decode: {tps:.1f} tok/s aggregate over "
+             f"{replicas} replicas ({per_replica})")
+        return {"fleet_decode_tokens_per_s": round(tps, 1),
+                "fleet_replicas": replicas,
+                "fleet_slots_per_replica": slots,
+                "fleet_per_replica_tokens": per_replica,
+                "fleet_prefix_route_rate": stats["prefix_route_rate"]}
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        _log(f"fleet decode skipped: {type(e).__name__}: {e}")
         return {}
 
 

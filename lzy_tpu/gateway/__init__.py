@@ -1,0 +1,55 @@
+"""Serving fleet gateway: one ``InferGenerate`` endpoint over N engines.
+
+PR 1–2 made ``lzy_tpu/serving`` a real single-replica inference engine
+(continuous batching, paged KV, radix prefix cache). A single engine
+process tops out at its slot count; heavy traffic needs a *fleet* — and a
+fleet needs a control-plane layer that the platform's existing machinery
+almost entirely provides. This package composes it:
+
+- ``router`` — prefix-cache-aware request routing: the gateway hashes the
+  prompt's page-size token chunks (the SAME chunking as the engine's
+  ``RadixCache``) and routes to the replica with the longest *expected*
+  cached prefix, falling back to least-loaded with a bounded load
+  imbalance, so few-shot/system-prompt traffic concentrates where its KV
+  already lives.
+- ``fleet`` — replica lifecycle. Replicas are leased through
+  ``service/allocator.py`` (one gang per replica: the allocator's durable
+  FSM, heartbeats and session cache are reused instead of inventing a
+  process registry) and run their engine loops in threads.
+- ``health`` — failure accrual: heartbeat staleness (from the allocator's
+  VM records) and consecutive request failures mark a replica dead; the
+  fleet then drains it and the router stops selecting it.
+- ``autoscale`` — allocator-driven scaling: sustained aggregate queue
+  depth adds a replica (through the same lease path, so a recently
+  drained gang is reused from the session cache); a sustained idle fleet
+  drains its coldest replica.
+- ``service`` — the ``InferGenerate``-compatible front. A request that
+  dies mid-stream on one replica is resubmitted to another with the
+  already-emitted tokens *fenced* (the retry continues from them), so the
+  client-visible stream stays correct across a failover.
+"""
+
+from lzy_tpu.gateway.autoscale import Autoscaler, ScaleDecision
+from lzy_tpu.gateway.fleet import (
+    DEAD, DRAINING, READY, STARTING, Replica, ReplicaFleet)
+from lzy_tpu.gateway.health import HealthPolicy, HealthTracker
+from lzy_tpu.gateway.router import (
+    PrefixAffinityRouter, RoundRobinRouter, chunk_hashes)
+from lzy_tpu.gateway.service import GatewayService
+
+__all__ = [
+    "Autoscaler",
+    "DEAD",
+    "DRAINING",
+    "GatewayService",
+    "HealthPolicy",
+    "HealthTracker",
+    "PrefixAffinityRouter",
+    "READY",
+    "Replica",
+    "ReplicaFleet",
+    "RoundRobinRouter",
+    "STARTING",
+    "ScaleDecision",
+    "chunk_hashes",
+]

@@ -1,0 +1,303 @@
+"""Replica fleet: engine lifecycle over allocator leases.
+
+A replica is (an inference engine running its loop in a thread) + (a gang
+leased from ``service/allocator.py``). The lease is what plugs the fleet
+into the platform's existing control machinery instead of a bespoke
+process registry:
+
+- the allocator's durable ``allocate_gang`` FSM makes replica acquisition
+  crash-safe and observable like any other allocation (same ops views,
+  same metrics);
+- the leased gang's worker agents heartbeat through AllocatorPrivate, so
+  replica *host* health is read off ``Vm.heartbeat_ts`` — no second
+  prober;
+- draining FREES the gang back to the session cache rather than
+  destroying it, so a scale-up shortly after a scale-down reuses the warm
+  gang (the allocator's reuse cache becomes the fleet's boot
+  accelerator).
+
+Run unleased (``allocator=None``) the fleet is plain threads — the unit
+test mode, and the degenerate single-host deployment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from lzy_tpu.gateway.health import HealthPolicy, HealthTracker
+from lzy_tpu.utils.log import get_logger
+from lzy_tpu.utils.metrics import REGISTRY
+
+_LOG = get_logger(__name__)
+
+_REPLICAS = REGISTRY.gauge(
+    "lzy_gateway_replicas", "fleet replicas by state")
+_R_QUEUE = REGISTRY.gauge(
+    "lzy_gateway_replica_queue_depth", "per-replica admission queue depth")
+_R_BUSY = REGISTRY.gauge(
+    "lzy_gateway_replica_slots_busy", "per-replica busy decode slots")
+_RETIRED = REGISTRY.counter(
+    "lzy_gateway_replicas_retired_total", "replicas retired by cause")
+
+STARTING = "STARTING"
+READY = "READY"
+DRAINING = "DRAINING"
+DEAD = "DEAD"
+
+
+@dataclasses.dataclass
+class Replica:
+    id: str
+    engine: object                      # InferenceEngine-compatible
+    state: str = READY
+    vm_ids: List[str] = dataclasses.field(default_factory=list)
+    created_ts: float = dataclasses.field(default_factory=time.time)
+    drain_since: Optional[float] = None
+
+    @property
+    def leased(self) -> bool:
+        return bool(self.vm_ids)
+
+
+class ReplicaFleet:
+    """Owns replicas; the gateway service routes over :meth:`loads` and
+    calls :meth:`check_health` / :meth:`reap_drained` from its tick."""
+
+    def __init__(
+        self,
+        engine_factory: Callable[[], object],
+        *,
+        allocator=None,                  # Optional[AllocatorService]
+        pool_label: str = "cpu-small",
+        session_owner: str = "gateway-fleet",
+        lease_timeout_s: float = 60.0,
+        health: Optional[HealthTracker] = None,
+        start_engines: bool = True,
+    ):
+        self._factory = engine_factory
+        self._allocator = allocator
+        self._pool_label = pool_label
+        self._session_owner = session_owner
+        self._lease_timeout_s = lease_timeout_s
+        self.health = health or HealthTracker(HealthPolicy())
+        self._start_engines = start_engines
+        self._replicas: Dict[str, Replica] = {}
+        self._session_id: Optional[str] = None
+        self._seq = 0
+        self._lock = threading.RLock()
+        self._closed = False
+        # terminal counters of retired replicas: fleet aggregates must
+        # stay MONOTONIC across scale-downs/failovers (a stats consumer
+        # computing rates over InferStats would otherwise see negative
+        # spikes every time a replica's history vanishes with it)
+        self._retired_totals = {
+            "requests_finished": 0, "tokens_generated": 0,
+            "prefix_hit_tokens": 0, "prefix_lookup_tokens": 0}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def add_replica(self) -> Replica:
+        """Lease (if an allocator is wired) and start one replica. The
+        engine is only built AFTER the lease lands, so a failed/timed-out
+        allocation never leaves a loose engine thread."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("fleet is closed")
+            self._seq += 1
+            rid = f"replica-{self._seq}"
+        vm_ids: List[str] = []
+        if self._allocator is not None:
+            vm_ids = self._lease()
+        try:
+            engine = self._factory()
+        except BaseException:
+            if vm_ids:
+                self._allocator.free(vm_ids)
+            raise
+        if self._start_engines:
+            engine.start()
+        replica = Replica(id=rid, engine=engine, vm_ids=vm_ids)
+        with self._lock:
+            if self._closed:
+                # the fleet closed while we were blocked in the lease:
+                # inserting now would leak a running engine thread and a
+                # never-freed gang — unwind instead
+                unwind = True
+            else:
+                unwind = False
+                self._replicas[rid] = replica
+        if unwind:
+            try:
+                engine.close()
+            except Exception:  # noqa: BLE001 — best-effort unwind
+                pass
+            if vm_ids:
+                try:
+                    self._allocator.free(vm_ids)
+                except Exception:  # noqa: BLE001 — lease may be gone
+                    pass
+            raise RuntimeError("fleet is closed")
+        self.health.record_success(rid)       # fresh streak
+        _LOG.info("fleet: replica %s up (lease %s)", rid, vm_ids or "none")
+        self._update_gauges()
+        return replica
+
+    def _lease(self) -> List[str]:
+        with self._lock:
+            if self._session_id is None:
+                self._session_id = self._allocator.create_session(
+                    self._session_owner)
+            session = self._session_id
+        return self._allocator.lease_gang(
+            session, self._pool_label, timeout_s=self._lease_timeout_s)
+
+    def drain(self, replica_id: str) -> None:
+        """Stop routing to the replica; its in-flight work finishes and
+        :meth:`reap_drained` retires it once idle."""
+        with self._lock:
+            replica = self._replicas.get(replica_id)
+            if replica is None or replica.state != READY:
+                return
+            replica.state = DRAINING
+            replica.drain_since = time.time()
+        _LOG.info("fleet: draining %s", replica_id)
+        self._update_gauges()
+
+    def reap_drained(self) -> List[str]:
+        """Retire DRAINING replicas whose engines went idle."""
+        retired = []
+        for replica in self.replicas(state=DRAINING):
+            s = replica.engine.stats()
+            if s.busy == 0 and s.queue_depth == 0:
+                self._retire(replica, cause="drained")
+                retired.append(replica.id)
+        return retired
+
+    def check_health(self, now: Optional[float] = None) -> List[str]:
+        """Mark-and-retire dead replicas; returns their ids. A dead
+        replica's engine is closed (failing whatever it still held — the
+        gateway's failover fences and resubmits) and its lease is
+        RELEASED, not reused: the allocator's own GC decides whether the
+        gang itself is still sound."""
+        dead = []
+        for replica in self.replicas() + self.replicas(state=DRAINING):
+            hb = None
+            if replica.leased and self._allocator is not None:
+                try:
+                    hb = self._allocator.vm(replica.vm_ids[0]).heartbeat_ts
+                except KeyError:
+                    dead.append((replica, "lease vanished"))
+                    continue
+            reason = self.health.verdict(
+                replica.id, heartbeat_ts=hb,
+                engine_closed=bool(getattr(replica.engine, "closed", False)),
+                now=now)
+            if reason is not None:
+                dead.append((replica, reason))
+        for replica, reason in dead:
+            _LOG.warning("fleet: replica %s dead (%s); retiring",
+                         replica.id, reason)
+            self._retire(replica, cause="failed")
+        return [r.id for r, _ in dead]
+
+    def _retire(self, replica: Replica, *, cause: str) -> None:
+        with self._lock:
+            if self._replicas.pop(replica.id, None) is None:
+                return
+            replica.state = DEAD
+        try:
+            # bank the terminal counters BEFORE closing: aggregates must
+            # not go backwards when this replica's engine is dropped
+            s = replica.engine.stats()
+            with self._lock:
+                self._retired_totals["requests_finished"] += \
+                    s.requests_finished
+                self._retired_totals["tokens_generated"] += \
+                    s.tokens_generated
+                kv = getattr(replica.engine, "kv", None)
+                if kv is not None:
+                    self._retired_totals["prefix_hit_tokens"] += \
+                        kv.hit_tokens
+                    self._retired_totals["prefix_lookup_tokens"] += \
+                        kv.lookup_tokens
+        except Exception:  # noqa: BLE001 — stats from a dying engine
+            pass
+        try:
+            replica.engine.close()
+        except Exception:  # noqa: BLE001 — already-dead engines may throw
+            pass
+        if replica.leased and self._allocator is not None:
+            try:
+                self._allocator.free(replica.vm_ids)
+            except Exception:  # noqa: BLE001 — lease may already be gone
+                pass
+        self.health.forget(replica.id)
+        _RETIRED.inc(cause=cause)
+        self._update_gauges()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            replicas = list(self._replicas.values())
+        for replica in replicas:
+            self._retire(replica, cause="shutdown")
+        if self._session_id is not None and self._allocator is not None:
+            try:
+                self._allocator.delete_session(self._session_id)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+    # -- views ---------------------------------------------------------------
+
+    def get(self, replica_id: str) -> Optional[Replica]:
+        with self._lock:
+            return self._replicas.get(replica_id)
+
+    def replicas(self, state: str = READY) -> List[Replica]:
+        with self._lock:
+            return [r for r in self._replicas.values() if r.state == state]
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def loads(self) -> Dict[str, int]:
+        """Routable replicas -> load (queue depth + busy slots)."""
+        out = {}
+        for replica in self.replicas():
+            s = replica.engine.stats()
+            out[replica.id] = s.queue_depth + s.busy
+            _R_QUEUE.set(float(s.queue_depth), replica=replica.id)
+            _R_BUSY.set(float(s.busy), replica=replica.id)
+        return out
+
+    def aggregate(self) -> dict:
+        """Fleet-level sums over READY+DRAINING engines (the numbers the
+        autoscaler and stats surface read)."""
+        with self._lock:
+            agg = {"replicas": 0, "queue_depth": 0, "busy": 0, "slots": 0,
+                   **self._retired_totals}
+        for replica in self.replicas() + self.replicas(state=DRAINING):
+            s = replica.engine.stats()
+            agg["replicas"] += 1
+            agg["queue_depth"] += s.queue_depth
+            agg["busy"] += s.busy
+            agg["slots"] += s.slots
+            agg["requests_finished"] += s.requests_finished
+            agg["tokens_generated"] += s.tokens_generated
+            kv = getattr(replica.engine, "kv", None)
+            if kv is not None:
+                agg["prefix_hit_tokens"] += kv.hit_tokens
+                agg["prefix_lookup_tokens"] += kv.lookup_tokens
+        return agg
+
+    def _update_gauges(self) -> None:
+        with self._lock:
+            counts: Dict[str, int] = {}
+            for replica in self._replicas.values():
+                counts[replica.state] = counts.get(replica.state, 0) + 1
+        for state in (READY, DRAINING):
+            _REPLICAS.set(float(counts.get(state, 0)), state=state)

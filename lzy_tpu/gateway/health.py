@@ -1,0 +1,78 @@
+"""Replica health: heartbeat staleness + consecutive failure accrual.
+
+Two independent signals, one verdict:
+
+- **heartbeat staleness** comes from the allocator's VM records — the
+  replica's leased gang already heartbeats through the platform's
+  AllocatorPrivate machinery (``service/allocator.py``), so the gateway
+  reads ``Vm.heartbeat_ts`` instead of running a second prober;
+- **consecutive request failures** come from the gateway's own traffic:
+  a replica whose engine keeps failing requests (or whose engine loop
+  died) is unhealthy even while its host still heartbeats.
+
+A success resets the failure streak — transient hiccups under load must
+not accumulate into an eviction; only an uninterrupted streak does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthPolicy:
+    #: heartbeat older than this marks the replica's host dead (matches
+    #: the allocator GC's own judgement window by default)
+    heartbeat_timeout_s: float = 30.0
+    #: uninterrupted request-failure streak that marks the replica dead
+    max_consecutive_failures: int = 3
+
+
+class HealthTracker:
+    """Per-replica failure accrual; the fleet consults :meth:`verdict`."""
+
+    def __init__(self, policy: Optional[HealthPolicy] = None):
+        self.policy = policy or HealthPolicy()
+        self._failures: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def record_success(self, replica_id: str) -> None:
+        with self._lock:
+            self._failures[replica_id] = 0
+
+    def record_failure(self, replica_id: str) -> int:
+        with self._lock:
+            self._failures[replica_id] = self._failures.get(replica_id, 0) + 1
+            return self._failures[replica_id]
+
+    def failures(self, replica_id: str) -> int:
+        with self._lock:
+            return self._failures.get(replica_id, 0)
+
+    def forget(self, replica_id: str) -> None:
+        with self._lock:
+            self._failures.pop(replica_id, None)
+
+    def verdict(self, replica_id: str, *,
+                heartbeat_ts: Optional[float] = None,
+                engine_closed: bool = False,
+                now: Optional[float] = None) -> Optional[str]:
+        """None when healthy, else a human-readable reason the replica is
+        dead. ``heartbeat_ts`` is the leased VM's last heartbeat (None
+        when the replica runs unleased — then only the other signals
+        apply)."""
+        if engine_closed:
+            return "engine loop died"
+        with self._lock:
+            streak = self._failures.get(replica_id, 0)
+        if streak >= self.policy.max_consecutive_failures:
+            return f"{streak} consecutive request failures"
+        if heartbeat_ts is not None:
+            now = now if now is not None else time.time()
+            if now - heartbeat_ts > self.policy.heartbeat_timeout_s:
+                return (f"heartbeat stale by "
+                        f"{now - heartbeat_ts:.0f}s")
+        return None

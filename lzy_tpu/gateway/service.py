@@ -1,0 +1,396 @@
+"""The gateway front: one ``InferGenerate`` endpoint over the fleet.
+
+Method-compatible with ``service/inference.InferenceService`` (generate/
+stats/close + an ``iam`` attribute), so the control-plane server registers
+it on the same RPC routes and ``serve.py --gateway`` slots it in where a
+single engine used to sit. What it adds over one engine:
+
+- **cache-aware dispatch**: every request is routed by the
+  ``PrefixAffinityRouter`` (longest expected cached prefix, bounded load
+  imbalance) and the router's expectation index is updated on submit;
+- **failover with fenced tokens**: a request that dies mid-stream on one
+  replica (engine loop death, preemption, replica shutdown) is resubmitted
+  to another with the tokens already emitted *fenced* — the retry prompt
+  is ``prompt + emitted`` and the final reply is ``emitted +
+  continuation``, so the client-visible stream never repeats or drops a
+  token. Under greedy decode the result is bit-identical to an
+  uninterrupted run (deterministic continuation); failures that are the
+  request's own fault (over-long prompt, invalid args) are NOT failed
+  over — they would fail identically everywhere;
+- **health + autoscaling tick**: a background loop (or an explicit
+  ``tick(now)`` under test) retires dead replicas, reaps drained ones,
+  and applies the autoscaler's lease/drain decisions.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from lzy_tpu.gateway.autoscale import DOWN, UP, Autoscaler
+from lzy_tpu.gateway.fleet import ReplicaFleet
+from lzy_tpu.gateway.router import PrefixAffinityRouter
+from lzy_tpu.serving.scheduler import AdmissionError, any_to_tokens
+from lzy_tpu.utils.log import get_logger
+from lzy_tpu.utils.metrics import REGISTRY
+
+_LOG = get_logger(__name__)
+
+_FAILOVERS = REGISTRY.counter(
+    "lzy_gateway_failovers_total",
+    "requests resubmitted to another replica after a mid-stream failure")
+_SCALE = REGISTRY.counter(
+    "lzy_gateway_scale_events_total", "autoscale decisions by direction")
+_REQUESTS = REGISTRY.counter(
+    "lzy_gateway_requests_total", "gateway requests by outcome")
+
+#: engine-side failure prefixes that indicate the REPLICA failed, not the
+#: request — safe (and required) to resubmit elsewhere with fenced tokens
+_FAILOVER_ERRORS = ("engine loop died", "preempted", "engine shutting down")
+#: failover-eligible errors that are CAPACITY signals, not replica faults:
+#: resubmit elsewhere, but do not accrue toward the health verdict — a
+#: paged engine preempting its youngest request under KV pressure is
+#: working as designed, and retiring it would dump its whole load onto
+#: the rest of the fleet mid-squeeze
+_CAPACITY_ERRORS = ("preempted",)
+
+
+class GatewayService:
+    def __init__(
+        self,
+        fleet: ReplicaFleet,
+        *,
+        router=None,
+        autoscaler: Optional[Autoscaler] = None,
+        model_name: str = "custom",
+        iam=None,
+        page_size: int = 16,
+        max_waiters: int = 16,
+        max_failovers: int = 3,
+        tick_period_s: float = 1.0,
+    ):
+        self.fleet = fleet
+        self.router = router if router is not None else PrefixAffinityRouter(
+            page_size)
+        self.autoscaler = autoscaler
+        self.model_name = model_name
+        self.iam = iam                 # harness wires the cluster's IAM in
+        self._max_failovers = max_failovers
+        self._tick_period_s = tick_period_s
+        self._waiters = threading.BoundedSemaphore(max_waiters)
+        self._failovers = 0
+        self._finished = 0
+        self._scale_ups = 0
+        self._scale_downs = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- request surface -----------------------------------------------------
+
+    def _auth(self, token: Optional[str]) -> None:
+        if self.iam is not None:
+            self.iam.authenticate(token)
+
+    def generate(self, prompt, *, max_new_tokens: int = 64,
+                 token: Optional[str] = None,
+                 timeout_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None) -> dict:
+        """Blocking generate over the fleet; same contract as the single
+        engine's RPC surface plus route metadata (``replica``,
+        ``routed_by``, ``failovers``) in the reply. Backpressure is
+        fleet-wide: only when EVERY routable replica refuses admission
+        does the caller see ``Unavailable``."""
+        self._auth(token)
+        from lzy_tpu.rpc.core import Unavailable
+
+        if not self._waiters.acquire(blocking=False):
+            raise Unavailable(
+                "all gateway waiter threads are busy; retry later")
+        try:
+            return self._generate(any_to_tokens(prompt),
+                                  int(max_new_tokens),
+                                  timeout_s=timeout_s or 120.0,
+                                  deadline_s=deadline_s)
+        finally:
+            self._waiters.release()
+
+    def _generate(self, prompt: List[int], max_new_tokens: int, *,
+                  timeout_s: float, deadline_s: Optional[float]) -> dict:
+        from lzy_tpu.rpc.core import Unavailable
+
+        t0 = time.monotonic()
+        wall_deadline = t0 + timeout_s
+        emitted: List[int] = []          # fenced: already streamed tokens
+        failovers = 0
+        tried_after_failure: set = set()
+        route = None                     # (replica, reason) that SERVED it
+        first_ttft_ms = None
+        while True:
+            remaining = max_new_tokens - len(emitted)
+            if remaining <= 0:
+                break
+            effective_prompt = prompt + emitted
+            replica, routed_by, req = self._submit_routed(
+                effective_prompt, remaining,
+                deadline_s=self._remaining_deadline(t0, deadline_s),
+                exclude=tried_after_failure)
+            route = (replica.id, routed_by)
+            if not req.wait(timeout=max(0.0,
+                                        wall_deadline - time.monotonic())):
+                req.cancel()
+                raise TimeoutError(
+                    f"request {req.id} not finished within {timeout_s}s")
+            if first_ttft_ms is None and req.first_token_at is not None:
+                first_ttft_ms = round(
+                    1000 * (req.first_token_at - t0), 3)
+            if req.error and req.status != "cancelled":
+                if not req.error.startswith(_FAILOVER_ERRORS):
+                    # request-scoped failure: identical on every replica
+                    _REQUESTS.inc(status="error")
+                    raise RuntimeError(
+                        f"request {req.id} failed: {req.error}")
+                # replica-scoped failure: fence what it emitted and
+                # resubmit elsewhere. Only genuine replica faults accrue
+                # toward the health verdict — a KV-pressure preemption is
+                # the engine working as designed, not a sick host
+                emitted.extend(req.tokens)
+                if not req.error.startswith(_CAPACITY_ERRORS):
+                    self.fleet.health.record_failure(replica.id)
+                    self.router.forget(replica.id)
+                    self.fleet.check_health()
+                    # a FAULTED replica is out for this request; a merely
+                    # SQUEEZED one stays eligible — the resubmission
+                    # re-queues behind its admission gate (head-of-line
+                    # waits for blocks), which on a single-replica fleet
+                    # is the only way the request can ever finish
+                    tried_after_failure.add(replica.id)
+                failovers += 1
+                self._note_failover()
+                if failovers > self._max_failovers:
+                    _REQUESTS.inc(status="error")
+                    raise Unavailable(
+                        f"request failed over {failovers} times; last "
+                        f"error: {req.error}")
+                _LOG.warning(
+                    "gateway: failover %d for request (replica %s: %s); "
+                    "%d tokens fenced", failovers, replica.id, req.error,
+                    len(emitted))
+                continue
+            # terminal: ok or cancelled-with-partials
+            self.fleet.health.record_success(replica.id)
+            emitted.extend(req.tokens)
+            status = req.status or "ok"
+            with self._lock:
+                self._finished += 1
+            _REQUESTS.inc(status=status)
+            return {
+                "request_id": req.id,
+                "tokens": emitted,
+                "status": status,
+                "ttft_ms": first_ttft_ms,
+                "model": self.model_name,
+                # the replica that actually FINISHED the stream (after a
+                # failover that is the retry's replica, not the dead one)
+                "replica": route[0],
+                "routed_by": route[1],
+                "failovers": failovers,
+            }
+        # emitted already covers max_new_tokens (failover landed exactly
+        # on the boundary): the stream is complete
+        with self._lock:
+            self._finished += 1
+        _REQUESTS.inc(status="ok")
+        return {"request_id": None, "tokens": emitted, "status": "ok",
+                "ttft_ms": first_ttft_ms, "model": self.model_name,
+                "replica": route[0] if route else None,
+                "routed_by": route[1] if route else None,
+                "failovers": failovers}
+
+    @staticmethod
+    def _remaining_deadline(t0: float,
+                            deadline_s: Optional[float]) -> Optional[float]:
+        """The client deadline is absolute from first submission; a
+        failover resubmits with whatever is left of it."""
+        if deadline_s is None:
+            return None
+        return max(0.001, deadline_s - (time.monotonic() - t0))
+
+    def _submit_routed(self, prompt: List[int], max_new_tokens: int, *,
+                       deadline_s: Optional[float], exclude: set):
+        """Route + submit with per-replica admission fallback: a replica
+        refusing admission (full queue, closed engine) drops out of the
+        candidate set and the next-best one is tried; only an empty set
+        is fleet-wide backpressure."""
+        from lzy_tpu.rpc.core import Unavailable
+
+        loads = {rid: load for rid, load in self.fleet.loads().items()
+                 if rid not in exclude}
+        last_err: Optional[Exception] = None
+        while loads:
+            rid, reason = self.router.choose(prompt, loads)
+            replica = self.fleet.get(rid)
+            if replica is None:
+                loads.pop(rid, None)
+                continue
+            try:
+                req = replica.engine.submit(
+                    prompt, max_new_tokens=max_new_tokens,
+                    deadline_s=deadline_s)
+            except AdmissionError as e:
+                last_err = e
+                loads.pop(rid, None)
+                continue
+            self.router.observe(rid, prompt)
+            return replica, reason, req
+        raise Unavailable(
+            f"no replica can admit the request: "
+            f"{last_err or 'no routable replicas'}")
+
+    def _note_failover(self) -> None:
+        with self._lock:
+            self._failovers += 1
+        _FAILOVERS.inc()
+
+    # -- control loop --------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> Optional[str]:
+        """One health + autoscale round (the background loop calls this
+        every ``tick_period_s``; tests call it with an injected clock).
+        Returns the applied scale direction, if any."""
+        now = now if now is not None else time.time()
+        for rid in self.fleet.check_health(now=now):
+            self.router.forget(rid)
+        for rid in self.fleet.reap_drained():
+            self.router.forget(rid)
+        if self.autoscaler is None:
+            return None
+        ready = len(self.fleet.replicas())
+        if ready < self.autoscaler.min_replicas:
+            # recovery, not scaling: health-based retirement can take the
+            # fleet below its floor (or to zero, where no queue pressure
+            # can ever build because nothing admits) — re-lease without
+            # waiting for pressure windows or cooldowns, one per tick
+            _LOG.warning("gateway: %d/%d replicas; re-leasing",
+                         ready, self.autoscaler.min_replicas)
+            try:
+                self.fleet.add_replica()
+            except Exception:  # noqa: BLE001 — retried next tick
+                _LOG.exception("gateway: recovery lease failed")
+                return None
+            with self._lock:
+                self._scale_ups += 1
+            _SCALE.inc(direction="up")
+            return UP
+        agg = self.fleet.aggregate()
+        decision = self.autoscaler.tick(
+            now, replicas=ready, queue_depth=agg["queue_depth"],
+            busy=agg["busy"], slots=agg["slots"])
+        if decision is None:
+            return None
+        if decision.direction == UP:
+            _LOG.info("gateway: scaling up (%s)", decision.reason)
+            try:
+                self.fleet.add_replica()
+            except Exception:  # noqa: BLE001 — a failed lease must not
+                _LOG.exception("gateway: scale-up failed")  # kill the loop
+                return None
+            with self._lock:
+                self._scale_ups += 1
+            _SCALE.inc(direction="up")
+            return UP
+        _LOG.info("gateway: scaling down (%s)", decision.reason)
+        coldest = self._coldest_replica()
+        if coldest is None:
+            return None
+        self.fleet.drain(coldest)
+        with self._lock:
+            self._scale_downs += 1
+        _SCALE.inc(direction="down")
+        return DOWN
+
+    def _coldest_replica(self) -> Optional[str]:
+        """Drain victim: the replica with the least routing heat (fewest
+        indexed prefix chains), load as tie-break — evicting the coldest
+        cache forfeits the least accumulated prefill work."""
+        loads = self.fleet.loads()
+        if not loads:
+            return None
+        chains = self.router.stats().get("indexed_chains", {})
+        return min(sorted(loads),
+                   key=lambda r: (chains.get(r, 0), loads[r]))
+
+    def start(self) -> "GatewayService":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self._tick_period_s):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — the tick must not die
+                    _LOG.exception("gateway tick failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="gateway-tick", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self.fleet.close()
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self, *, token: Optional[str] = None) -> dict:
+        """Fleet-level ``InferStats`` doc: aggregates + routing + scaling
+        counters. Per-replica breakdown lives in :meth:`fleet_stats`."""
+        self._auth(token)
+        agg = self.fleet.aggregate()
+        routing = self.router.stats()
+        hit_rate = 0.0
+        if agg["prefix_lookup_tokens"]:
+            hit_rate = agg["prefix_hit_tokens"] / agg["prefix_lookup_tokens"]
+        with self._lock:
+            fo, fin = self._failovers, self._finished
+            ups, downs = self._scale_ups, self._scale_downs
+        return {
+            "model": self.model_name,
+            "gateway": True,
+            "replicas": agg["replicas"],
+            "replicas_ready": len(self.fleet.replicas()),
+            "slots": agg["slots"],
+            "busy": agg["busy"],
+            "queue_depth": agg["queue_depth"],
+            "requests_finished": fin,
+            "tokens_generated": agg["tokens_generated"],
+            "failovers": fo,
+            "scale_ups": ups,
+            "scale_downs": downs,
+            "routed_total": routing["routed_total"],
+            "routed_by_prefix": routing["routed_by_prefix"],
+            "prefix_route_rate": routing["prefix_route_rate"],
+            "fleet_prefix_hit_rate": round(hit_rate, 4),
+        }
+
+    def fleet_stats(self, *, token: Optional[str] = None) -> dict:
+        """Per-replica breakdown (engine stats + lease + health)."""
+        self._auth(token)
+        rows = []
+        for state in ("READY", "DRAINING"):
+            for replica in self.fleet.replicas(state=state):
+                doc = replica.engine.stats().doc()
+                doc.update({
+                    "replica": replica.id,
+                    "state": replica.state,
+                    "vm_ids": list(replica.vm_ids),
+                    "consecutive_failures":
+                        self.fleet.health.failures(replica.id),
+                })
+                rows.append(doc)
+        return {"model": self.model_name, "replicas": rows}

@@ -321,20 +321,48 @@ class ControlPlaneServer:
                         token=p.get("token"))]},
             })
         inference = getattr(cluster, "inference_service", None)
-        if inference is not None:
+        if inference is not None \
+                or getattr(cluster, "_inference_factory", None) is not None:
+            # resolved at CALL time, not registration time: a gateway
+            # fleet built by inference_factory comes up AFTER this server
+            # (its leased process workers dial back here to register), so
+            # at registration the service may not exist yet
+            def _infer_svc():
+                svc = getattr(cluster, "inference_service", None)
+                if svc is None:
+                    from lzy_tpu.rpc.core import Unavailable
+
+                    raise Unavailable(
+                        "inference service is still booting; retry")
+                return svc
+
             handlers.update({
                 # inference surface (serving plane; serve.py --serve-model):
                 # blocking generate rides the same gRPC stack — deadlines,
                 # status codes, and backpressure as UNAVAILABLE
-                "InferGenerate": lambda p: inference.generate(
+                "InferGenerate": lambda p: _infer_svc().generate(
                     p["prompt"],
                     max_new_tokens=int(p.get("max_new_tokens", 64)),
                     timeout_s=p.get("timeout_s"),
                     deadline_s=p.get("deadline_s"),
                     token=p.get("token")),
-                "InferStats": lambda p: inference.stats(
+                "InferStats": lambda p: _infer_svc().stats(
                     token=p.get("token")),
             })
+            if inference is None or hasattr(inference, "fleet_stats"):
+                # gateway-fronted planes (serve.py --gateway) additionally
+                # expose the per-replica breakdown; single-engine planes
+                # answer NOT_FOUND / UNIMPLEMENTED for the method, which
+                # is the honest capability signal (there is no fleet)
+                def h_fleet_stats(p):
+                    svc = _infer_svc()
+                    if not hasattr(svc, "fleet_stats"):
+                        raise KeyError(
+                            "this plane serves a single engine, not a "
+                            "fleet")
+                    return svc.fleet_stats(token=p.get("token"))
+
+                handlers["InferFleetStats"] = h_fleet_stats
         if debug:
             def _dbg(fn):
                 def handler(p):
@@ -747,6 +775,15 @@ class RpcInferenceClient:
 
     def stats(self) -> dict:
         return self._client.call("InferStats", {
+            "token": _token_value(self._token),
+        }, retry=True)
+
+    def fleet_stats(self) -> dict:
+        """Per-replica breakdown of a gateway-fronted plane (``serve.py
+        --gateway``); raises NOT_FOUND against a single-engine plane. The
+        reply's ``replicas`` rows carry each replica's engine stats plus
+        its lease (``vm_ids``), state, and failure streak."""
+        return self._client.call("InferFleetStats", {
             "token": _token_value(self._token),
         }, retry=True)
 

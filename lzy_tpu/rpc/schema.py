@@ -266,13 +266,20 @@ REQUESTS: Dict[str, Schema] = {
         "tags": f(list),
         "not_before": f(str),
         "not_after": f(str), **_TOKEN}),
-    # inference surface (serving plane; serve.py --serve-model)
+    # inference surface (serving plane; serve.py --serve-model). On a
+    # gateway-fronted plane (--gateway) the InferGenerate REPLY carries
+    # route metadata next to the tokens: "replica" (which engine served
+    # it), "routed_by" ("prefix" | "load" | "round_robin"), and
+    # "failovers" (mid-stream resubmissions, 0 on the happy path) —
+    # unknown reply fields are preserved by older clients (proto3 rule)
     "InferGenerate": Schema("InferGenerateRequest", {
         "prompt": f(list, required=True),
         "max_new_tokens": f(int),
         "timeout_s": f(float, int),
         "deadline_s": f(float, int), **_TOKEN}),
     "InferStats": Schema("InferStatsRequest", {**_TOKEN}),
+    # gateway-only: per-replica fleet breakdown (serve.py --gateway)
+    "InferFleetStats": Schema("InferFleetStatsRequest", {**_TOKEN}),
     # status surface
     "GetStatus": Schema("GetStatusRequest", {
         "view": f(str, required=True), **_TOKEN}),

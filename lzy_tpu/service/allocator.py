@@ -184,9 +184,12 @@ class AllocatorService:
 
     # -- allocation ------------------------------------------------------------
 
-    def allocate(self, session_id: str, pool_label: str) -> str:
+    def allocate(self, session_id: str, pool_label: str, *,
+                 deadline_s: Optional[float] = None) -> str:
         """Start a durable gang-allocation; returns the operation id. The op
-        result is ``{"gang_id", "vm_ids": [...]}`` with every host RUNNING."""
+        result is ``{"gang_id", "vm_ids": [...]}`` with every host RUNNING.
+        ``deadline_s`` overrides the allocator default — past it the op
+        expires and rolls the gang back (all-or-nothing)."""
         with self._lock:
             if session_id not in self._sessions:
                 raise KeyError(f"unknown session {session_id!r}")
@@ -195,8 +198,38 @@ class AllocatorService:
             "allocate_gang",
             {"session_id": session_id, "pool_label": pool_label,
              "gang_size": pool.hosts},
-            deadline_s=self._allocate_timeout_s,
+            deadline_s=deadline_s or self._allocate_timeout_s,
         )
+
+    def lease_gang(self, session_id: str, pool_label: str, *,
+                   timeout_s: float = 60.0) -> List[str]:
+        """Blocking allocation convenience (the serving fleet's lease
+        surface): start a gang allocation and wait for every host to be
+        RUNNING. Returns the vm ids in host order; raises on timeout or
+        allocation failure. Hand the ids back with :meth:`free` (returns
+        the warm gang to the session cache) when done."""
+        from lzy_tpu.durable.store import FAILED
+
+        # the op's expiry is pinned to OUR patience: if we stop waiting,
+        # the op expires too and its rollback destroys the gang instead of
+        # leaking it (see the TimeoutError path below for the tail race)
+        op_id = self.allocate(session_id, pool_label, deadline_s=timeout_s)
+        try:
+            record = self._executor.await_op(op_id, timeout_s=timeout_s)
+        except TimeoutError:
+            # the durable op is still running and may land AFTER we give
+            # up — a gang nobody references would leak (RUNNING + fresh
+            # heartbeats, so GC never reaps it). If it has in fact landed
+            # by now, hand it back to the session cache; otherwise the
+            # op's own allocate deadline expires it and rolls back.
+            record = self._store.load(op_id)
+            if record.done and record.result:
+                self.free(record.result["vm_ids"])
+            raise
+        if record.status == FAILED or not record.result:
+            raise RuntimeError(
+                f"gang lease failed: {record.error or 'no result'}")
+        return list(record.result["vm_ids"])
 
     def mount_disk(self, vm_id: str, disk_id: str, mount_name: str,
                    *, read_only: bool = False) -> str:

@@ -15,6 +15,14 @@ cookie over token exchange), ``/keys`` (key-management forms),
 ``/graph/<graph-op-id>.dot`` (graphviz, reference parity),
 ``/api/<view>`` (JSON), ``/healthz``, ``/metrics`` (Prometheus text).
 
+Mutating routes (key create/delete/rotate, graph kill) are CSRF-guarded:
+a request authorized by the session COOKIE must also carry the per-session
+CSRF token (hidden form field ``csrf`` or ``X-CSRF-Token`` header) that
+the console embeds in its own forms — a cross-site form post rides the
+cookie but cannot read the token (same-origin policy), so it is refused.
+``Authorization: Bearer`` callers are exempt: a header is no ambient
+credential, the attacker page would have to know the secret itself.
+
 Auth model with ``iam=`` wired (site Auth/Keys/Tasks parity):
 
 - **login** is a token exchange: POST the bearer token once at ``/login``
@@ -117,8 +125,14 @@ class StatusConsole:
         for the control-plane host): loopback bind by default, expose only
         deliberately. With ``iam`` every data route needs a bearer token
         or the ``/login`` session cookie."""
+        import secrets
+
         self._store = store
         self._iam = iam
+        # per-process CSRF key: tokens are HMAC(secret, session credential),
+        # never stored — a console restart invalidates them along with
+        # nothing else (the form re-renders a fresh one on next load)
+        self._csrf_secret = secrets.token_bytes(32)
         # optional callable run before every MUTATING route; returning a
         # string refuses the mutation with 503 + that reason (serve-console
         # uses it to re-check the control-plane lease at request time — a
@@ -161,6 +175,15 @@ class StatusConsole:
 
     # -- auth helpers ----------------------------------------------------------
 
+    @staticmethod
+    def _session_credential(req: BaseHTTPRequestHandler) -> Optional[str]:
+        """The session-cookie credential, if any (None without one)."""
+        from http.cookies import SimpleCookie
+
+        cookies = SimpleCookie(req.headers.get("Cookie", ""))
+        morsel = cookies.get(_SESSION_COOKIE)
+        return morsel.value if morsel is not None else None
+
     def _bearer(self, req: BaseHTTPRequestHandler) -> Optional[str]:
         """Header first, session cookie second. NEVER the query string —
         tokens in URLs leak through proxy/access logs and history
@@ -168,11 +191,33 @@ class StatusConsole:
         auth = req.headers.get("Authorization", "")
         if auth.startswith("Bearer "):
             return auth[len("Bearer "):].strip()
-        from http.cookies import SimpleCookie
+        return self._session_credential(req)
 
-        cookies = SimpleCookie(req.headers.get("Cookie", ""))
-        morsel = cookies.get(_SESSION_COOKIE)
-        return morsel.value if morsel is not None else None
+    def _csrf_for(self, credential: Optional[str]) -> str:
+        """The CSRF token for a session credential (the cookie value; ""
+        on an IAM-less console). Deterministic per console process, so
+        every page render embeds the same token the check recomputes."""
+        import hashlib
+        import hmac as _hmac
+
+        return _hmac.new(self._csrf_secret, (credential or "").encode(),
+                         hashlib.sha256).hexdigest()[:40]
+
+    def _csrf_ok(self, req: BaseHTTPRequestHandler,
+                 body: Dict[str, Any]) -> bool:
+        """True when the mutation may proceed. Bearer-header callers pass
+        (no ambient credential to ride); cookie-/open-console callers must
+        present the matching token in the ``csrf`` field or the
+        ``X-CSRF-Token`` header."""
+        import hmac as _hmac
+
+        if req.headers.get("Authorization", "").startswith("Bearer "):
+            return True
+        credential = self._session_credential(req) or ""
+        supplied = (body.get("csrf")
+                    or req.headers.get("X-CSRF-Token") or "")
+        return _hmac.compare_digest(str(supplied),
+                                    self._csrf_for(credential))
 
     def _subject(self, req: BaseHTTPRequestHandler, *,
                  page: bool = False):
@@ -274,7 +319,8 @@ class StatusConsole:
                 if subject is None:
                     return
             self._send(req, 200, "text/html; charset=utf-8",
-                       self._render_keys(subject).encode())
+                       self._render_keys(
+                           subject, csrf=self._page_csrf(req)).encode())
         elif path.startswith("/graph/"):
             self._route_graph(req, path[len("/graph/"):])
         elif path == "/api/tasks":
@@ -354,11 +400,21 @@ class StatusConsole:
         tasks = state.get("tasks", {})
         done = sum(1 for t in tasks.values()
                    if t.get("status") == "COMPLETED")
+        kill = ""
+        if state.get("_status") == "RUNNING":
+            kill = (
+                f'<form class="inline" method="post" '
+                f'action="/graph/{html.escape(graph_op_id)}/kill" '
+                f'enctype="application/x-www-form-urlencoded">'
+                f'<input type="hidden" name="csrf" '
+                f'value="{self._page_csrf(req)}">'
+                f"<button>kill graph</button></form>")
         body = (
             f"<h1>graph {html.escape(graph_op_id)}</h1>"
             f"<p>status {html.escape(state.get('_status', '?'))} · "
             f"{done}/{len(tasks)} tasks done · "
-            f'<a href="/graph/{html.escape(graph_op_id)}.dot">dot</a></p>'
+            f'<a href="/graph/{html.escape(graph_op_id)}.dot">dot</a> '
+            f"{kill}</p>"
             + graphviz.graph_svg(state)
         )
         self._send(req, 200, "text/html; charset=utf-8",
@@ -394,6 +450,22 @@ class StatusConsole:
             if refusal:
                 self._json(req, 503, {"error": refusal})
                 return
+        try:
+            body = self._body(req)
+        except ValueError as e:
+            self._json(req, 400, {"error": str(e)})
+            return
+        if not self._csrf_ok(req, body):
+            # session-cookie (or open-console) mutation without the
+            # embedded token: a cross-site form post rides the cookie but
+            # cannot read the token — refuse before any auth side effects
+            self._json(req, 403, {"error": "missing or invalid CSRF "
+                                           "token"})
+            return
+        if req.command == "POST" and path.startswith("/graph/") \
+                and path.endswith("/kill"):
+            self._kill_graph(req, path[len("/graph/"):-len("/kill")])
+            return
         subject = self._subject(req)
         if subject is None:
             return
@@ -434,10 +506,10 @@ class StatusConsole:
                                            "INTERNAL role"})
             return
         if req.command == "POST" and path == "/api/keys":
+            doc = body
             try:
-                doc = self._body(req)
                 subject_id = doc["subject_id"]
-            except (ValueError, KeyError, TypeError):
+            except (KeyError, TypeError):
                 self._json(req, 400,
                            {"error": "body must carry subject_id"})
                 return
@@ -470,6 +542,35 @@ class StatusConsole:
                                  redirect=False)
         else:
             self._json(req, 404, {"error": "not found"})
+
+    def _page_csrf(self, req) -> str:
+        """The CSRF token to embed in this response's forms — bound to the
+        session cookie the form post will ride (or "" on open consoles)."""
+        return self._csrf_for(self._session_credential(req) or "")
+
+    def _kill_graph(self, req, graph_op_id: str) -> None:
+        """POST /graph/<op-id>/kill — cooperative stop: writes the
+        ``graph_stops`` flag the graph executor's scheduler loop honours
+        (``GraphExecutor.stop`` parity over the shared store). Scoped
+        exactly like the graph views: owners and INTERNAL; unknown and
+        not-owned answer identically (no enumeration oracle)."""
+        from lzy_tpu.service import graphviz
+
+        user = None
+        if self._iam is not None:
+            subject = self._subject(req)
+            if subject is None:
+                return
+            user = self._scope(subject)
+        state = graphviz.load_graph_state(self._store, graph_op_id)
+        if state is None or (user is not None and state.get("user") != user):
+            self._json(req, 404, {"error": f"unknown graph {graph_op_id!r}"})
+            return
+        self._store.kv_put("graph_stops", graph_op_id, True)
+        if self._wants_html(req):
+            self._redirect(req, f"/graph/{graph_op_id}")
+        else:
+            self._json(req, 200, {"stopping": graph_op_id})
 
     def _delete_subject(self, req, subject_id: str, *, redirect: bool) -> None:
         if not self._subject_docs(subject_id):
@@ -539,12 +640,13 @@ class StatusConsole:
         )
         return _page("sign in", body, nav=False)
 
-    def _render_keys(self, subject) -> str:
+    def _render_keys(self, subject, csrf: str = "") -> str:
         only = self._scope(subject) if self._iam is not None else None
         subjects = self._subject_docs(only) if self._iam is not None else []
         from lzy_tpu.iam import INTERNAL
 
         is_op = subject is not None and subject.role == INTERNAL
+        token_field = f'<input type="hidden" name="csrf" value="{csrf}">'
         rows = []
         for s in subjects:
             actions = ""
@@ -553,7 +655,7 @@ class StatusConsole:
                     f'<form class="inline" method="post" '
                     f'action="/api/keys/{html.escape(s["id"])}/delete" '
                     f'enctype="application/x-www-form-urlencoded">'
-                    f"<button>delete</button></form>")
+                    f"{token_field}<button>delete</button></form>")
             rows.append(
                 f"<tr><td>{html.escape(s['id'])}</td>"
                 f"<td>{html.escape(str(s['kind']))}</td>"
@@ -570,6 +672,7 @@ class StatusConsole:
             '<h2>rotate my credential</h2>'
             '<form method="post" action="/api/keys/rotate" '
             'enctype="application/x-www-form-urlencoded">'
+            f"{token_field}"
             "<button>rotate (invalidates all my outstanding tokens)"
             "</button></form>"
             '<p class="note">HMAC subjects: fetch the fresh token via '
@@ -581,6 +684,7 @@ class StatusConsole:
                 "<h2>create subject</h2>"
                 '<form method="post" action="/api/keys" '
                 'enctype="application/x-www-form-urlencoded">'
+                f"{token_field}"
                 '<input type="text" name="subject_id" '
                 'placeholder="subject id"> '
                 '<input type="text" name="role" placeholder="OWNER"> '

@@ -66,6 +66,10 @@ class InProcessCluster:
         backend=None,                     # explicit VmBackend (e.g. GKE)
         leader_lease_ttl_s: float = 30.0,      # control-plane leader lease
         inference_service=None,           # serving plane (serve --serve-model)
+        inference_factory=None,           # callable(cluster) -> service;
+                                          # runs AFTER the allocator exists so
+                                          # a gateway fleet (serve --gateway)
+                                          # can lease replicas through it
     ):
         self._rpc_port = rpc_port
         self.storage_uri = storage_uri
@@ -130,6 +134,7 @@ class InProcessCluster:
                 worker_pythonpath=worker_pythonpath, debug_rpc=debug_rpc,
                 gc_period_s=gc_period_s, execution_ttl_s=execution_ttl_s,
                 backend=backend, inference_service=inference_service,
+                inference_factory=inference_factory,
             )
         except BaseException:
             if self._lease_acquired:
@@ -158,7 +163,7 @@ class InProcessCluster:
                        p2p_spill_root, with_iam, container_runtime,
                        worker_mode, worker_pythonpath, debug_rpc,
                        gc_period_s, execution_ttl_s, backend,
-                       inference_service=None):
+                       inference_service=None, inference_factory=None):
         self.executor = OperationsExecutor(self.store, workers=workers)
         self.channels = ChannelManager(store=self.store)
         self.serializers = default_registry()
@@ -230,6 +235,16 @@ class InProcessCluster:
         # every other route (wired here so the service never runs open on
         # an IAM-enabled plane)
         self.inference_service = inference_service
+        # a factory builds the service against the LIVE cluster — the
+        # multi-replica gateway fleet leases replicas through this
+        # cluster's allocator. It must run AFTER the RPC server exists
+        # (below): with a process backend the leased workers dial back to
+        # that server to register, so building the fleet first would
+        # deadlock the lease. The server registers the inference routes
+        # when either the service or the pending factory is present, and
+        # resolves the service at call time.
+        self._inference_factory = (
+            inference_factory if inference_service is None else None)
         if (inference_service is not None
                 and getattr(inference_service, "iam", None) is None):
             inference_service.iam = self.iam
@@ -238,6 +253,10 @@ class InProcessCluster:
 
             self.rpc_server = ControlPlaneServer(self, port=self._rpc_port,
                                                  debug=debug_rpc)
+        if self._inference_factory is not None:
+            self.inference_service = self._inference_factory(self)
+            if getattr(self.inference_service, "iam", None) is None:
+                self.inference_service.iam = self.iam
         # background GC (the reference runs GarbageCollector timers inside
         # each service; here one timer covers allocator + executions)
         self._gc_stop = None

@@ -102,6 +102,108 @@ class InferenceService:
         self.engine.close()
 
 
+def _build_engine_parts(model: str, *, checkpoint: Optional[str],
+                        seed: int):
+    """Config + params for a named model (shared by the single-engine and
+    fleet builders; the fleet shares ONE params tree across replicas —
+    the engines never mutate it)."""
+    import jax
+
+    from lzy_tpu.models import llama, unbox
+
+    if model not in MODEL_CONFIGS:
+        raise ValueError(
+            f"unknown --serve-model {model!r}; known: {MODEL_CONFIGS}")
+    cfg = getattr(llama.LlamaConfig, model)()
+    boxed, _ = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    params: Any = unbox(boxed)
+    if checkpoint:
+        from lzy_tpu.parallel.orbax_interop import import_orbax
+
+        _LOG.info("restoring %s weights from %s", model, checkpoint)
+        params = import_orbax(checkpoint, template=params)
+    return cfg, params
+
+
+def build_gateway_service(
+    model: str,
+    *,
+    replicas: int = 3,
+    slots: int = 4,
+    max_queue: int = 64,
+    eos_token: Optional[int] = None,
+    checkpoint: Optional[str] = None,
+    seed: int = 0,
+    prefill_chunk: int = 64,
+    paged: bool = False,
+    page_size: int = 16,
+    kv_blocks: Optional[int] = None,
+    routing: str = "prefix",
+    allocator=None,
+    pool_label: str = "cpu-small",
+    autoscale: bool = True,
+    min_replicas: Optional[int] = None,
+    max_replicas: Optional[int] = None,
+    start: bool = True,
+):
+    """Construct the serving fleet gateway (``serve.py --gateway``): N
+    engine replicas behind one ``InferGenerate`` endpoint with
+    prefix-affinity routing, health/failover, and (optionally)
+    allocator-driven autoscaling between ``min_replicas`` and
+    ``max_replicas`` (defaults: ``replicas`` .. ``2 * replicas``).
+
+    ``routing``: ``"prefix"`` (cache-aware, the default) or ``"rr"``
+    (round-robin — the measurable baseline). ``allocator``: an
+    ``AllocatorService`` to lease replica gangs through (None runs the
+    fleet unleased, plain threads).
+    """
+    from lzy_tpu.gateway import (
+        Autoscaler, GatewayService, PrefixAffinityRouter, ReplicaFleet,
+        RoundRobinRouter)
+    from lzy_tpu.serving import InferenceEngine, PagedInferenceEngine
+
+    if replicas < 1:
+        raise ValueError(f"replicas must be >= 1, got {replicas}")
+    if routing not in ("prefix", "rr"):
+        raise ValueError(f"unknown routing {routing!r}; use prefix or rr")
+    cfg, params = _build_engine_parts(model, checkpoint=checkpoint,
+                                      seed=seed)
+    common = dict(slots=slots, max_queue=max_queue, eos_token=eos_token,
+                  prefill_chunk=prefill_chunk, seed=seed)
+
+    def engine_factory():
+        if paged:
+            return PagedInferenceEngine(
+                cfg, params, page_size=page_size, kv_blocks=kv_blocks,
+                **common)
+        return InferenceEngine(cfg, params, **common)
+
+    fleet = ReplicaFleet(engine_factory, allocator=allocator,
+                         pool_label=pool_label)
+    router_cls = PrefixAffinityRouter if routing == "prefix" \
+        else RoundRobinRouter
+    autoscaler = None
+    if autoscale:
+        autoscaler = Autoscaler(
+            min_replicas=min_replicas or replicas,
+            max_replicas=max_replicas or 2 * replicas)
+    service = GatewayService(
+        fleet,
+        router=router_cls(page_size if paged else prefill_chunk),
+        autoscaler=autoscaler,
+        model_name=model,
+    )
+    try:
+        for _ in range(replicas):
+            fleet.add_replica()
+    except BaseException:
+        service.close()
+        raise
+    if start:
+        service.start()
+    return service
+
+
 def build_inference_service(
     model: str,
     *,
@@ -129,22 +231,10 @@ def build_inference_service(
     equivalent — size it below that to overcommit HBM, above to grow the
     prefix cache; docs/serving.md has the tradeoffs).
     """
-    import jax
-
-    from lzy_tpu.models import llama, unbox
     from lzy_tpu.serving import InferenceEngine, PagedInferenceEngine
 
-    if model not in MODEL_CONFIGS:
-        raise ValueError(
-            f"unknown --serve-model {model!r}; known: {MODEL_CONFIGS}")
-    cfg = getattr(llama.LlamaConfig, model)()
-    boxed, _ = llama.init_params(cfg, jax.random.PRNGKey(seed))
-    params: Any = unbox(boxed)
-    if checkpoint:
-        from lzy_tpu.parallel.orbax_interop import import_orbax
-
-        _LOG.info("restoring %s weights from %s", model, checkpoint)
-        params = import_orbax(checkpoint, template=params)
+    cfg, params = _build_engine_parts(model, checkpoint=checkpoint,
+                                      seed=seed)
     common = dict(slots=slots, max_queue=max_queue, eos_token=eos_token,
                   prefill_chunk=prefill_chunk, seed=seed)
     if paged:

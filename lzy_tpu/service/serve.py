@@ -84,12 +84,58 @@ def main(argv=None) -> int:
                              "(default: the dense equivalent; smaller "
                              "overcommits HBM, larger grows the prefix "
                              "cache)")
+    parser.add_argument("--gateway", action="store_true",
+                        help="front --serve-model with the serving fleet "
+                             "gateway: N engine replicas behind one "
+                             "InferGenerate endpoint with prefix-affinity "
+                             "routing, health/failover, and autoscaling "
+                             "(docs/serving.md 'Fleet serving')")
+    parser.add_argument("--replicas", type=int, default=3,
+                        help="initial replica count under --gateway "
+                             "(autoscaling may grow the fleet to 2x this, "
+                             "or cap it with --max-replicas)")
+    parser.add_argument("--max-replicas", type=int, default=None,
+                        help="autoscaling ceiling under --gateway")
+    parser.add_argument("--gateway-routing", choices=("prefix", "rr"),
+                        default="prefix",
+                        help="prefix: cache-aware routing (default); "
+                             "rr: round-robin baseline")
+    parser.add_argument("--gateway-pool", default="cpu-small",
+                        help="allocator pool the gateway leases replica "
+                             "gangs from")
     args = parser.parse_args(argv)
 
     from lzy_tpu.service import InProcessCluster
 
+    if args.gateway and not args.serve_model:
+        parser.error("--gateway requires --serve-model")
+
     inference_service = None
-    if args.serve_model:
+    inference_factory = None
+    if args.serve_model and args.gateway:
+        from lzy_tpu.service.inference import build_gateway_service
+
+        # built via factory so the fleet can lease its replicas through
+        # the cluster's allocator (which exists only once the cluster is
+        # up); the gateway then rides the same RPC routes a single engine
+        # would
+        def inference_factory(cluster):
+            return build_gateway_service(
+                args.serve_model,
+                replicas=args.replicas,
+                max_replicas=args.max_replicas,
+                slots=args.serve_slots,
+                max_queue=args.serve_queue,
+                eos_token=args.serve_eos_token,
+                checkpoint=args.model_checkpoint,
+                paged=args.serve_paged,
+                page_size=args.serve_page_size,
+                kv_blocks=args.serve_kv_blocks,
+                routing=args.gateway_routing,
+                allocator=cluster.allocator,
+                pool_label=args.gateway_pool,
+            )
+    elif args.serve_model:
         from lzy_tpu.service.inference import build_inference_service
 
         inference_service = build_inference_service(
@@ -127,9 +173,13 @@ def main(argv=None) -> int:
         debug_rpc=args.debug_rpc,
         gc_period_s=args.gc_period_s,
         inference_service=inference_service,
+        inference_factory=inference_factory,
     )
     server = cluster.serve(args.port)
     model = f", model={args.serve_model}" if args.serve_model else ""
+    if args.gateway:
+        model += (f", gateway={args.replicas}x"
+                  f" ({args.gateway_routing} routing)")
     print(f"lzy-tpu control plane serving on {server.address} "
           f"(backend={args.backend}, "
           f"iam={'on' if args.with_iam else 'off'}{model})",

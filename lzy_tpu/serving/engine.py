@@ -401,6 +401,13 @@ class InferenceEngine:
 
     # -- lifecycle ---------------------------------------------------------
 
+    @property
+    def closed(self) -> bool:
+        """True once the engine refuses admissions — clean shutdown OR a
+        dead loop. The gateway's health checks read this to retire a
+        replica whose engine died under it."""
+        return self._closed
+
     def start(self) -> "InferenceEngine":
         """Run the engine loop in a daemon thread (the serving-front mode)."""
         if self._thread is not None:

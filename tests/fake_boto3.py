@@ -1,0 +1,176 @@
+"""In-process boto3/botocore stand-in for executing ``storage/s3.py``.
+
+The image deliberately ships without boto3, so the S3 client used to get
+only import-gated "it raises ImportError" coverage — its multipart and
+retry paths never ran (VERDICT missing #5). This module is the missing
+server: an in-memory S3 (the reference's InMemoryS3Storage idea) behind
+the exact client slice ``S3StorageClient`` calls, installed into
+``sys.modules`` as ``boto3``/``botocore`` for the duration of a test so
+the real code path — lazy import included — executes unchanged.
+
+Fault injection: ``FakeS3Client.fail_next[op]`` holds a countdown of
+calls of ``op`` (e.g. ``"upload_part"``) to fail with a retryable error,
+which is how the tests drive the transfer engine's per-part retry and
+the abort-on-failure guarantee.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import types
+from typing import Dict, Tuple
+
+
+class FakeClientError(Exception):
+    """Shape-compatible with botocore.exceptions.ClientError."""
+
+    def __init__(self, code: str, op: str = "Unknown"):
+        super().__init__(f"An error occurred ({code}) calling {op}")
+        self.response = {"Error": {"Code": code}}
+
+
+class _Body:
+    def __init__(self, data: bytes):
+        self._data = data
+
+    def read(self) -> bytes:
+        return self._data
+
+
+class FakeS3Client:
+    """The client-surface slice storage/s3.py uses, over a dict."""
+
+    def __init__(self):
+        self._objects: Dict[Tuple[str, str], bytes] = {}
+        self._mpu: Dict[str, dict] = {}
+        self._mpu_seq = 0
+        self._lock = threading.RLock()
+        self.fail_next: Dict[str, int] = {}    # op -> remaining failures
+        self.calls: Dict[str, int] = {}        # op -> total invocations
+        self.aborted: list = []                # aborted multipart UploadIds
+
+    def _enter(self, op: str) -> None:
+        with self._lock:
+            self.calls[op] = self.calls.get(op, 0) + 1
+            if self.fail_next.get(op, 0) > 0:
+                self.fail_next[op] -= 1
+                raise FakeClientError("SlowDown", op)
+
+    # -- plain object ops ----------------------------------------------------
+
+    def upload_fileobj(self, fileobj, bucket, key):
+        self._enter("upload_fileobj")
+        self._objects[(bucket, key)] = fileobj.read()
+
+    def download_fileobj(self, bucket, key, fileobj):
+        self._enter("download_fileobj")
+        fileobj.write(self._require(bucket, key))
+
+    def put_object(self, *, Bucket, Key, Body):
+        self._enter("put_object")
+        self._objects[(Bucket, Key)] = bytes(Body)
+
+    def get_object(self, *, Bucket, Key, Range=None):
+        self._enter("get_object")
+        data = self._require(Bucket, Key)
+        if Range is not None:
+            spec = Range[len("bytes="):]
+            start_s, _, end_s = spec.partition("-")
+            start = int(start_s)
+            data = data[start:] if end_s == "" else data[start:int(end_s) + 1]
+        return {"Body": _Body(data)}
+
+    def head_object(self, *, Bucket, Key):
+        self._enter("head_object")
+        return {"ContentLength": len(self._require(Bucket, Key))}
+
+    def delete_object(self, *, Bucket, Key):
+        self._enter("delete_object")
+        self._objects.pop((Bucket, Key), None)
+
+    def get_paginator(self, op):
+        assert op == "list_objects_v2", op
+        client = self
+
+        class _Paginator:
+            def paginate(self, *, Bucket, Prefix):
+                items = sorted(
+                    k for (b, k) in client._objects if b == Bucket
+                    and k.startswith(Prefix))
+                # two pages exercise the pagination loop, not just one
+                mid = (len(items) + 1) // 2
+                for chunk in (items[:mid], items[mid:]):
+                    yield {"Contents": [{"Key": k} for k in chunk]}
+
+        return _Paginator()
+
+    def generate_presigned_url(self, op, *, Params, ExpiresIn):
+        self._enter("generate_presigned_url")
+        return (f"https://fake-s3/{Params['Bucket']}/{Params['Key']}"
+                f"?sig=deadbeef&expires={ExpiresIn}")
+
+    # -- multipart -----------------------------------------------------------
+
+    def create_multipart_upload(self, *, Bucket, Key):
+        self._enter("create_multipart_upload")
+        with self._lock:
+            self._mpu_seq += 1
+            upload_id = f"mpu-{self._mpu_seq}"
+            self._mpu[upload_id] = {"bucket": Bucket, "key": Key,
+                                    "parts": {}}
+        return {"UploadId": upload_id}
+
+    def upload_part(self, *, Bucket, Key, UploadId, PartNumber, Body):
+        self._enter("upload_part")
+        mpu = self._mpu[UploadId]
+        data = bytes(Body)
+        with self._lock:
+            mpu["parts"][PartNumber] = data
+        return {"ETag": f'"etag-{PartNumber}-{len(data)}"'}
+
+    def complete_multipart_upload(self, *, Bucket, Key, UploadId,
+                                  MultipartUpload):
+        self._enter("complete_multipart_upload")
+        mpu = self._mpu.pop(UploadId)
+        listed = [p["PartNumber"] for p in MultipartUpload["Parts"]]
+        assert listed == sorted(listed), "parts must complete in order"
+        assert set(listed) == set(mpu["parts"]), "missing uploaded parts"
+        self._objects[(Bucket, Key)] = b"".join(
+            mpu["parts"][n] for n in listed)
+
+    def abort_multipart_upload(self, *, Bucket, Key, UploadId):
+        self._enter("abort_multipart_upload")
+        self._mpu.pop(UploadId, None)
+        self.aborted.append(UploadId)
+
+    # -- helpers -------------------------------------------------------------
+
+    def _require(self, bucket: str, key: str) -> bytes:
+        try:
+            return self._objects[(bucket, key)]
+        except KeyError:
+            raise FakeClientError("NoSuchKey", "GetObject") from None
+
+    def dangling_multipart(self) -> int:
+        return len(self._mpu)
+
+
+def install(monkeypatch) -> FakeS3Client:
+    """Register fake ``boto3``/``botocore`` modules for one test (undone
+    automatically with the monkeypatch fixture, so the absence contract
+    checked by test_image_contract is untouched elsewhere)."""
+    client = FakeS3Client()
+
+    boto3 = types.ModuleType("boto3")
+    boto3.client = lambda service, **kw: client if service == "s3" else None
+
+    botocore = types.ModuleType("botocore")
+    exceptions = types.ModuleType("botocore.exceptions")
+    exceptions.ClientError = FakeClientError
+    botocore.exceptions = exceptions
+
+    monkeypatch.setitem(sys.modules, "boto3", boto3)
+    monkeypatch.setitem(sys.modules, "botocore", botocore)
+    monkeypatch.setitem(sys.modules, "botocore.exceptions", exceptions)
+    return client

@@ -1,0 +1,152 @@
+"""Executed coverage for ``storage/s3.py`` (VERDICT missing #5).
+
+The container has no boto3, so these tests install the in-process stub
+from ``fake_boto3`` into ``sys.modules`` and run the REAL client code —
+construction through the lazy import, every object op, and the manual
+multipart path with per-part retries and abort-on-failure. The gated
+ImportError contract (no boto3 → clear error at construction) keeps its
+own test at the bottom.
+"""
+
+import io
+
+import pytest
+
+from fake_boto3 import FakeClientError, install
+
+from lzy_tpu.storage.api import StorageConfig
+from lzy_tpu.storage.transfer import TransferConfig, upload_bytes
+
+
+@pytest.fixture()
+def s3(monkeypatch):
+    """(client, fake) — a real S3StorageClient over the in-memory S3."""
+    fake = install(monkeypatch)
+    from lzy_tpu.storage.registry import client_for
+
+    client = client_for(StorageConfig(uri="s3://bucket/prefix",
+                                      endpoint="http://fake",
+                                      access_key="k", secret_key="s"))
+    assert client.scheme == "s3"
+    return client, fake
+
+
+SMALL_CFG = TransferConfig(part_size=64, max_workers=4, retries=3,
+                           backoff_s=0.001)
+
+
+class TestObjectOps:
+    def test_write_read_roundtrip_counts_bytes(self, s3):
+        client, _ = s3
+        payload = b"x" * 1000
+        n = client.write("s3://bucket/a/obj", io.BytesIO(payload))
+        assert n == 1000
+        out = io.BytesIO()
+        assert client.read("s3://bucket/a/obj", out) == 1000
+        assert out.getvalue() == payload
+
+    def test_read_range(self, s3):
+        client, _ = s3
+        client.write("s3://bucket/r", io.BytesIO(b"0123456789"))
+        assert client.read_range("s3://bucket/r", 2, 3) == b"234"
+        assert client.read_range("s3://bucket/r", 7) == b"789"
+
+    def test_exists_size_delete(self, s3):
+        client, _ = s3
+        assert not client.exists("s3://bucket/missing")
+        client.write("s3://bucket/e", io.BytesIO(b"abc"))
+        assert client.exists("s3://bucket/e")
+        assert client.size("s3://bucket/e") == 3
+        client.delete("s3://bucket/e")
+        assert not client.exists("s3://bucket/e")
+
+    def test_exists_surfaces_non_404_errors(self, s3):
+        """Auth/throttling failures must raise, never read as 'absent' —
+        a False here would let cache layers recompute and clobber."""
+        client, fake = s3
+        fake.fail_next["head_object"] = 1
+        with pytest.raises(FakeClientError):
+            client.exists("s3://bucket/whatever")
+
+    def test_list_paginates(self, s3):
+        client, fake = s3
+        keys = [f"s3://bucket/list/{i:02d}" for i in range(5)]
+        for uri in keys:
+            client.write(uri, io.BytesIO(b"d"))
+        client.write("s3://bucket/other", io.BytesIO(b"d"))
+        assert list(client.list("s3://bucket/list/")) == keys
+
+    def test_sign_uri(self, s3):
+        client, _ = s3
+        client.write("s3://bucket/signed", io.BytesIO(b"d"))
+        url = client.sign_uri("s3://bucket/signed")
+        assert url.startswith("https://") and "signed" in url
+
+
+class TestMultipart:
+    def test_small_payload_uses_single_put(self, s3):
+        """multipart_upload's own small-object branch: one retried
+        put_object, no multipart ceremony."""
+        client, fake = s3
+        data = b"s" * SMALL_CFG.part_size          # == part_size: no MPU
+        n = client.multipart_upload(
+            "s3://bucket/small", size=len(data),
+            read_span=lambda off, ln: data[off:off + ln],
+            config=SMALL_CFG, advance=lambda n: None)
+        assert n == len(data)
+        assert fake.calls.get("put_object") == 1
+        assert "create_multipart_upload" not in fake.calls
+        out = io.BytesIO()
+        client.read("s3://bucket/small", out)
+        assert out.getvalue() == data
+
+    def test_multipart_assembles_parts_in_order(self, s3):
+        client, fake = s3
+        data = bytes(range(256)) * 2               # 512 B -> 8 parts of 64
+        n = upload_bytes(client, "s3://bucket/big", data, config=SMALL_CFG)
+        assert n == len(data)
+        assert fake.calls["upload_part"] == 8
+        assert fake.calls["complete_multipart_upload"] == 1
+        out = io.BytesIO()
+        client.read("s3://bucket/big", out)
+        assert out.getvalue() == data
+        assert fake.dangling_multipart() == 0
+
+    def test_per_part_retry_recovers(self, s3):
+        client, fake = s3
+        fake.fail_next["upload_part"] = 2           # two throttles, then ok
+        data = b"r" * 300
+        assert upload_bytes(client, "s3://bucket/retry", data,
+                            config=SMALL_CFG) == 300
+        assert fake.calls["upload_part"] >= 5 + 2   # 5 parts + 2 retries
+        out = io.BytesIO()
+        client.read("s3://bucket/retry", out)
+        assert out.getvalue() == data
+
+    def test_exhausted_retries_abort_the_upload(self, s3):
+        """A dangling multipart upload bills storage forever — on failure
+        the client must abort it, and the target key must not appear."""
+        client, fake = s3
+        fake.fail_next["upload_part"] = 10 * SMALL_CFG.retries
+        with pytest.raises(Exception):
+            upload_bytes(client, "s3://bucket/doomed", b"d" * 300,
+                         config=SMALL_CFG)
+        assert fake.aborted, "failed multipart upload was not aborted"
+        assert fake.dangling_multipart() == 0
+        assert not client.exists("s3://bucket/doomed")
+
+
+def test_without_boto3_construction_fails_clearly():
+    """The gated contract on this image (no boto3): a clear ImportError
+    at construction, never at first use."""
+    pytest.importorskip  # keep flake quiet about the unused module dance
+    try:
+        import boto3  # noqa: F401
+
+        pytest.skip("boto3 genuinely installed; gate does not apply")
+    except ImportError:
+        pass
+    from lzy_tpu.storage.s3 import S3StorageClient
+
+    with pytest.raises(ImportError, match="boto3"):
+        S3StorageClient(StorageConfig(uri="s3://bucket/prefix"))
