@@ -1,3 +1,9 @@
+from lzy_tpu.channels.kv_transfer import (
+    InMemoryKVTransport,
+    KVBlockExport,
+    KVTransferError,
+    StorageKVTransport,
+)
 from lzy_tpu.channels.manager import (
     CONSUMER,
     PRODUCER,
@@ -14,4 +20,8 @@ __all__ = [
     "ChannelFailed",
     "ChannelManager",
     "DeviceResidency",
+    "InMemoryKVTransport",
+    "KVBlockExport",
+    "KVTransferError",
+    "StorageKVTransport",
 ]

@@ -1,0 +1,261 @@
+"""Paged-KV block transfer over the channels data plane.
+
+Disaggregated serving (``lzy_tpu/serving/disagg``) splits a request's
+lifecycle across two replica pools: a *prefill* replica computes the
+prompt's KV blocks, a *decode* replica consumes them. The bytes in
+between ride the SAME machinery every other cross-host value in this
+platform rides (SURVEY §3.4): a small JSON **manifest** naming the
+payload pieces — mirroring ``channels/sharded_spill``'s sharded-array
+manifest — plus either
+
+- the **direct peer fast path** (:class:`InMemoryKVTransport`): the
+  producer keeps the export in RAM and the consumer pulls it by key,
+  the in-process analog of a ``channels/p2p.SlotPeer`` stream (and the
+  mode an in-process fleet actually uses — no serialization, no copy);
+- the **storage spill path** (:class:`StorageKVTransport`): every KV
+  leaf is uploaded through the transfer engine (multipart + retries,
+  ``storage/transfer.py``) under ``<base>.kv/``, then the manifest
+  object is written last — so a manifest that exists names a payload
+  that is whole, exactly the sharded-spill completion contract.
+
+Either way the transfer is *advisory*: a consumer that cannot fetch
+(producer died mid-stream, pool too hot to import) simply re-prefills
+locally — a lost transfer costs FLOPs, never correctness and never a
+failed request.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+KV_MANIFEST_FORMAT = "kv_block_manifest"
+_MAGIC = {"format": KV_MANIFEST_FORMAT, "v": 1}
+
+
+@dataclasses.dataclass
+class KVBlockExport:
+    """Host-side snapshot of one prompt prefix's paged KV blocks.
+
+    ``tokens`` is the whole-block token prefix the blocks cover (length a
+    multiple of ``page_size``); ``leaves`` maps a cache-tree leaf key
+    (``jax.tree_util.keystr`` of the pooled k/v leaf's path) to that
+    leaf's block rows ``[n_blocks, page_size, kv_heads, head_dim]`` in
+    prefix order. Block *ids* never travel: they are pool-local, and the
+    importer allocates its own.
+    """
+
+    tokens: List[int]
+    page_size: int
+    leaves: Dict[str, np.ndarray]
+    prefilled_by: Optional[str] = None
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self.tokens) // self.page_size
+
+    @property
+    def nbytes(self) -> int:
+        return sum(a.nbytes for a in self.leaves.values())
+
+
+def build_kv_manifest(export: KVBlockExport,
+                      leaf_uris: Dict[str, str]) -> bytes:
+    """The manifest document: token prefix + per-leaf uri/dtype/shape.
+    Shard uris are absolute (sharded_spill convention) so any consumer
+    can fetch with just this document."""
+    doc = {
+        **_MAGIC,
+        "page_size": export.page_size,
+        "tokens": [int(t) for t in export.tokens],
+        "prefilled_by": export.prefilled_by,
+        "leaves": {
+            key: {"uri": leaf_uris[key],
+                  "dtype": str(arr.dtype),
+                  "shape": list(arr.shape)}
+            for key, arr in export.leaves.items()
+        },
+    }
+    return json.dumps(doc).encode("utf-8")
+
+
+def parse_kv_manifest(raw: bytes) -> dict:
+    doc = json.loads(raw.decode("utf-8"))
+    if doc.get("format") != KV_MANIFEST_FORMAT:
+        raise ValueError("not a kv-block manifest")
+    if doc.get("v") != 1:
+        raise ValueError(f"unknown kv-block manifest version {doc.get('v')}")
+    return doc
+
+
+def _leaf_key_to_name(index: int) -> str:
+    # leaf keystrs contain brackets/quotes; object keys must stay
+    # URL-safe, so payload objects are named by sorted-key index and the
+    # manifest carries the mapping
+    return f"leaf_{index:04d}"
+
+
+def spill_kv_export(storage, base_uri: str, export: KVBlockExport) -> str:
+    """Upload the export under ``base_uri``: leaves first (parallel,
+    multipart + retries via the transfer engine), the manifest object at
+    ``base_uri`` itself LAST — a visible manifest names a whole payload.
+    Returns the manifest uri."""
+    from concurrent import futures as _futures
+
+    from lzy_tpu.serialization.jax_ser import JaxArraySerializer
+    from lzy_tpu.storage.api import join_uri
+    from lzy_tpu.storage.transfer import upload_bytes
+
+    ser = JaxArraySerializer()
+    keys = sorted(export.leaves)
+    uris = {key: join_uri(base_uri + ".kv", _leaf_key_to_name(i))
+            for i, key in enumerate(keys)}
+
+    def put(key: str) -> None:
+        buf = io.BytesIO()
+        ser.serialize(export.leaves[key], buf)
+        upload_bytes(storage, uris[key], buf.getvalue())
+
+    with _futures.ThreadPoolExecutor(min(8, max(1, len(keys)))) as pool:
+        list(pool.map(put, keys))
+    storage.write_bytes(base_uri, build_kv_manifest(export, uris))
+    return base_uri
+
+
+def fetch_kv_export(storage, manifest_uri: str) -> KVBlockExport:
+    """Inverse of :func:`spill_kv_export`: read the manifest, fetch every
+    leaf concurrently, reassemble the export."""
+    from concurrent import futures as _futures
+
+    from lzy_tpu.serialization.jax_ser import JaxArraySerializer
+
+    ser = JaxArraySerializer()
+    doc = parse_kv_manifest(storage.read_bytes(manifest_uri))
+
+    def get(item):
+        key, meta = item
+        src = storage.open_read(meta["uri"])
+        try:
+            arr = np.asarray(ser.deserialize(src))
+        finally:
+            src.close()
+        if list(arr.shape) != list(meta["shape"]):
+            raise ValueError(
+                f"kv leaf {key} shape {list(arr.shape)} != manifest "
+                f"{meta['shape']}")
+        return key, arr
+
+    leaves = {}
+    items = list(doc["leaves"].items())
+    with _futures.ThreadPoolExecutor(min(8, max(1, len(items)))) as pool:
+        for key, arr in pool.map(get, items):
+            leaves[key] = arr
+    return KVBlockExport(
+        tokens=[int(t) for t in doc["tokens"]],
+        page_size=int(doc["page_size"]),
+        leaves=leaves,
+        prefilled_by=doc.get("prefilled_by"),
+    )
+
+
+class KVTransferError(RuntimeError):
+    """The producer side of a KV transfer is gone (peer died mid-stream,
+    payload discarded); the consumer must fall back to re-prefill."""
+
+
+class InMemoryKVTransport:
+    """Direct producer→consumer path for in-process pools (the
+    ``SlotPeer`` analog: while the producer is alive the payload streams
+    straight across; here "alive" is "still published").
+
+    ``fail_next_fetch`` is the test hook for a peer dying mid-stream:
+    each armed failure makes one ``fetch`` raise :class:`KVTransferError`
+    after the publish succeeded — exactly the window a real stream dies
+    in.
+    """
+
+    def __init__(self):
+        self._payloads: Dict[str, KVBlockExport] = {}
+        self._lock = threading.Lock()
+        self.fail_next_fetch = 0
+        self.published = 0
+        self.fetched = 0
+
+    def publish(self, key: str, export: KVBlockExport) -> str:
+        with self._lock:
+            self._payloads[key] = export
+            self.published += 1
+        return key
+
+    def fetch(self, ref: str) -> KVBlockExport:
+        with self._lock:
+            if self.fail_next_fetch > 0:
+                self.fail_next_fetch -= 1
+                raise KVTransferError(
+                    f"kv transfer {ref} died mid-stream (injected)")
+            export = self._payloads.get(ref)
+            if export is None:
+                raise KVTransferError(f"kv payload {ref} is gone")
+            self.fetched += 1
+        return export
+
+    def discard(self, ref: str) -> None:
+        with self._lock:
+            self._payloads.pop(ref, None)
+
+
+class StorageKVTransport:
+    """Durable fallback path: the export spills through the storage
+    plane (manifest + leaf objects) and the consumer reassembles it —
+    survives the producer's death AFTER publish, at storage round-trip
+    cost."""
+
+    def __init__(self, storage, base_uri: str):
+        self._storage = storage
+        self._base = base_uri.rstrip("/")
+        self.published = 0
+        self.fetched = 0
+
+    def publish(self, key: str, export: KVBlockExport) -> str:
+        from lzy_tpu.storage.api import join_uri
+
+        uri = spill_kv_export(self._storage, join_uri(self._base, key),
+                              export)
+        self.published += 1
+        return uri
+
+    def fetch(self, ref: str) -> KVBlockExport:
+        try:
+            export = fetch_kv_export(self._storage, ref)
+        except Exception as e:  # noqa: BLE001 — consumer falls back
+            raise KVTransferError(
+                f"kv payload {ref} unavailable: {type(e).__name__}: {e}"
+            ) from e
+        self.fetched += 1
+        return export
+
+    def discard(self, ref: str) -> None:
+        doc = None
+        try:
+            doc = parse_kv_manifest(self._storage.read_bytes(ref))
+        except Exception:  # noqa: BLE001 — manifest may never have landed
+            pass
+        if doc:
+            for meta in doc["leaves"].values():
+                try:
+                    self._storage.delete(meta["uri"])
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
+        try:
+            self._storage.delete(ref)
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Optional
+from typing import Optional, Sequence
 
 from lzy_tpu.utils.log import get_logger
 
@@ -41,3 +41,19 @@ def fetch_via_peer(peer: SlotPeer, dest_path: str) -> bool:
         _LOG.info("peer transfer of %s unavailable (%s); storage fallback",
                   peer.name, e)
         return False
+
+
+def fetch_via_peers(peers: Sequence[SlotPeer], dest_path: str) -> bool:
+    """Pull from the first peer that can serve the value, RESUMING across
+    peers: a pull that died mid-stream leaves a partial ``dest_path``, and
+    the next peer's ``pull_with_resume`` continues from its byte offset
+    instead of starting over (replicated values — e.g. a gang's identical
+    spill files — are served by every member, so the consumer survives any
+    single producer's death without re-transferring the prefix it already
+    has). The FNV check still gates success, so a resume that spliced
+    mismatched bytes is discarded, not returned. False only when every
+    peer failed — the caller's storage fallback."""
+    for peer in peers:
+        if fetch_via_peer(peer, dest_path):
+            return True
+    return False

@@ -30,6 +30,7 @@ almost entirely provides. This package composes it:
 """
 
 from lzy_tpu.gateway.autoscale import Autoscaler, ScaleDecision
+from lzy_tpu.gateway.disagg import DisaggGatewayService
 from lzy_tpu.gateway.fleet import (
     DEAD, DRAINING, READY, STARTING, Replica, ReplicaFleet)
 from lzy_tpu.gateway.health import HealthPolicy, HealthTracker
@@ -41,6 +42,7 @@ __all__ = [
     "Autoscaler",
     "DEAD",
     "DRAINING",
+    "DisaggGatewayService",
     "GatewayService",
     "HealthPolicy",
     "HealthTracker",

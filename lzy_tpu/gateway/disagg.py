@@ -1,0 +1,322 @@
+"""Disaggregated prefill/decode gateway: two pools, one endpoint.
+
+Extends :class:`~lzy_tpu.gateway.service.GatewayService` (whose fleet is
+the **decode pool** — routing, fenced-token failover, health ticks and
+autoscaling all apply to it unchanged) with a **prefill pool** and the
+staging step that connects them. Per request:
+
+1. route to a decode replica with the ordinary
+   :class:`PrefixAffinityRouter` — the SAME index that predicts engine
+   cache hits predicts when a transfer is pointless;
+2. if the chosen decode replica is *expected* to hold the prompt's
+   whole-block prefix already, **skip the transfer entirely** (counted:
+   ``lzy_disagg_transfer_skipped_by_cache_total``) — repeat traffic to a
+   warm replica pays neither prefill-pool time nor transfer bytes;
+3. otherwise dispatch the prompt to a prefill replica (its own affinity
+   router: prefill replicas accumulate radix caches too, so shared
+   headers prefill once per *prefill* pool, not once per request), wait
+   for the KV export, move it through the channels transport, and queue
+   the import on the decode replica;
+4. submit the FULL prompt to the decode engine. Its prefix match hits
+   the imported blocks and only the sub-block tail prefills locally.
+
+**Failure semantics**: every stage of (3) — prefill replica dead or
+refusing admission, prefill failed mid-flight, transport stream dying
+mid-transfer, import skipped under pool pressure — degrades to the
+decode replica re-prefilling the prompt locally
+(``lzy_disagg_reprefill_fallbacks_total``); the request itself NEVER
+fails because of the prefill pool. Decode-side mid-stream death keeps
+the parent's fenced-token failover (the retry re-stages KV for the new
+replica).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from lzy_tpu.channels.kv_transfer import InMemoryKVTransport
+from lzy_tpu.gateway.fleet import ReplicaFleet
+from lzy_tpu.gateway.router import PrefixAffinityRouter
+from lzy_tpu.gateway.service import GatewayService
+from lzy_tpu.serving.scheduler import AdmissionError
+from lzy_tpu.utils.log import get_logger
+from lzy_tpu.utils.metrics import REGISTRY
+
+_LOG = get_logger(__name__)
+
+_TRANSFERS = REGISTRY.counter(
+    "lzy_disagg_transfers_total",
+    "prefill→decode KV staging attempts by outcome "
+    "(transferred/skipped_cache/skipped_short/fallback)")
+_SKIPPED_CACHE = REGISTRY.counter(
+    "lzy_disagg_transfer_skipped_by_cache_total",
+    "transfers skipped because the decode replica already held the prefix")
+_FALLBACKS = REGISTRY.counter(
+    "lzy_disagg_reprefill_fallbacks_total",
+    "requests that re-prefilled on the decode side after a prefill-pool "
+    "or transfer failure")
+_XFER_BYTES = REGISTRY.counter(
+    "lzy_disagg_transfer_bytes_total",
+    "KV bytes moved prefill→decode")
+_XFER_SECONDS = REGISTRY.histogram(
+    "lzy_disagg_transfer_seconds",
+    "one KV staging round trip (prefill wait + transport + import queue)",
+    buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0))
+_PREFILL_REPLICAS = REGISTRY.gauge(
+    "lzy_disagg_prefill_replicas", "prefill pool replicas (READY)")
+
+
+class DisaggGatewayService(GatewayService):
+    """Two-pool serving front; wire-compatible with ``GatewayService``
+    (``InferGenerate`` replies additionally carry ``prefilled_by`` and
+    ``kv_transfer_ms``)."""
+
+    def __init__(
+        self,
+        fleet: ReplicaFleet,                 # the DECODE pool
+        prefill_fleet: ReplicaFleet,
+        *,
+        page_size: int = 16,
+        prefill_router=None,
+        transport=None,
+        prefill_replicas: int = 1,
+        prefill_timeout_s: float = 120.0,
+        **kwargs,
+    ):
+        super().__init__(fleet, page_size=page_size, **kwargs)
+        self.prefill_fleet = prefill_fleet
+        self.prefill_router = (prefill_router if prefill_router is not None
+                               else PrefixAffinityRouter(page_size))
+        self.transport = transport if transport is not None \
+            else InMemoryKVTransport()
+        self._page = page_size
+        self._prefill_target = prefill_replicas
+        self._prefill_timeout_s = prefill_timeout_s
+        self._tls = threading.local()
+        self._xfer_lock = threading.Lock()
+        self._transferred = 0
+        self._skipped_cache = 0
+        self._skipped_short = 0
+        self._fallbacks = 0
+        self._xfer_bytes = 0
+
+    # -- request surface -----------------------------------------------------
+
+    def generate(self, prompt, **kwargs) -> dict:
+        self._tls.meta = {}        # fresh per call (failovers accumulate)
+        return super().generate(prompt, **kwargs)
+
+    def _meta(self) -> dict:
+        meta = getattr(self._tls, "meta", None)
+        if meta is None:
+            meta = self._tls.meta = {}
+        return meta
+
+    def _reply_extras(self) -> dict:
+        meta = self._meta()
+        return {
+            # the prefill replica whose KV was STAGED for the final
+            # serving attempt (None: transfer skipped, sub-block prompt,
+            # or fallback). Staged, not "used": the decode engine folds
+            # imports in opportunistically, and a refusal under pool
+            # pressure silently re-prefills — by design the gateway
+            # never blocks a request on the import's fate
+            "prefilled_by": meta.get("prefilled_by"),
+            "kv_transfer_ms": meta.get("kv_transfer_ms"),
+            "kv_transfer_skipped": bool(meta.get("skipped", False)),
+            "reprefills": int(meta.get("reprefills", 0)),
+        }
+
+    def _pre_submit(self, replica, prompt: List[int]) -> bool:
+        """Parent routing loop's staging hook: probe the decode replica's
+        admission gate FIRST — staging KV for a replica that cannot admit
+        would waste a whole prefill + transfer and park imported blocks on
+        a replica no routed request will match — then stage. Staged
+        before submit so the import is queued (and therefore applied)
+        before any scheduling round can admit the request."""
+        engine = replica.engine
+        if getattr(engine, "closed", False) or \
+                engine.queue.depth() >= engine.queue.max_depth:
+            return False
+        self._stage_kv(replica, prompt)
+        return True
+
+    # -- KV staging ----------------------------------------------------------
+
+    def _stage_kv(self, replica, prompt: List[int]) -> None:
+        """Best-effort: land the prompt's whole-block KV prefix on the
+        chosen decode replica. Never raises — every failure path means
+        the decode engine re-prefills locally."""
+        meta = self._meta()
+        meta.pop("prefilled_by", None)      # per-attempt: a failover
+        meta.pop("kv_transfer_ms", None)    # restages for the new replica
+        meta.pop("skipped", None)
+        # only blocks the decode engine will actually match: it offers
+        # prompt[:-1] to its radix tree so >=1 token always prefills
+        n_full = (len(prompt) - 1) // self._page
+        if n_full == 0:
+            self._count("skipped_short")
+            return
+        prefix_len = n_full * self._page
+        if self.router.match_len(replica.id, prompt) >= prefix_len:
+            # the router EXPECTS the prefix resident on this replica; if
+            # the expectation is stale the engine just prefills locally —
+            # one redundant prefill, never a wrong token
+            meta["skipped"] = True
+            self._count("skipped_cache")
+            _SKIPPED_CACHE.inc()
+            return
+        t0 = time.monotonic()
+        staged = self._prefill_remote(prompt)
+        if staged is None:
+            meta["reprefills"] = meta.get("reprefills", 0) + 1
+            self._count("fallback")
+            _FALLBACKS.inc()
+            return
+        prefilled_by, export = staged
+        replica.engine.queue_kv_import(export)
+        dt = time.monotonic() - t0
+        with self._xfer_lock:
+            self._transferred += 1
+            self._xfer_bytes += export.nbytes
+        _TRANSFERS.inc(outcome="transferred")
+        _XFER_BYTES.inc(export.nbytes)
+        _XFER_SECONDS.observe(dt)
+        meta["prefilled_by"] = prefilled_by
+        meta["kv_transfer_ms"] = round(1000 * dt, 3)
+
+    def _prefill_remote(self, prompt: List[int]):
+        """Run the prompt through a prefill replica and pull the export
+        over the transport. Returns ``(prefill_replica_id, export)`` or
+        None (→ re-prefill fallback). A prefill replica that fails
+        mid-flight accrues toward its health verdict and the next
+        candidate is tried; transport failures after a successful
+        prefill fall straight back (the payload is gone)."""
+        loads = dict(self.prefill_fleet.loads())
+        while loads:
+            rid, _ = self.prefill_router.choose(prompt, loads)
+            replica = self.prefill_fleet.get(rid)
+            if replica is None:
+                loads.pop(rid, None)
+                continue
+            try:
+                req = replica.engine.submit(prompt)
+            except AdmissionError:
+                loads.pop(rid, None)
+                continue
+            except ValueError:
+                return None       # request-scoped (prompt > pool): no pool
+            self.prefill_router.observe(rid, prompt)
+            if not req.wait(timeout=self._prefill_timeout_s):
+                req.cancel()
+                _LOG.warning("disagg: prefill of %s on %s timed out",
+                             req.id, rid)
+                return None
+            if req.error:
+                _LOG.warning("disagg: prefill replica %s failed (%s); "
+                             "retiring from candidates", rid, req.error)
+                self.prefill_fleet.health.record_failure(rid)
+                self.prefill_router.forget(rid)
+                self.prefill_fleet.check_health()
+                loads.pop(rid, None)
+                continue
+            self.prefill_fleet.health.record_success(rid)
+            export = getattr(req, "kv_export", None)
+            if export is None:
+                return None       # sub-block prompt: nothing to move
+            export.prefilled_by = rid
+            ref = None
+            try:
+                ref = self.transport.publish(f"kv-{req.id}", export)
+                fetched = self.transport.fetch(ref)
+            except Exception as e:  # noqa: BLE001 — mid-transfer death
+                _LOG.warning("disagg: kv transfer for %s died mid-stream "
+                             "(%s: %s); decode side will re-prefill",
+                             req.id, type(e).__name__, e)
+                return None
+            finally:
+                if ref is not None:
+                    try:
+                        self.transport.discard(ref)
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+            return rid, fetched
+        return None               # no live prefill replica at all
+
+    def _count(self, outcome: str) -> None:
+        with self._xfer_lock:
+            if outcome == "skipped_cache":
+                self._skipped_cache += 1
+            elif outcome == "skipped_short":
+                self._skipped_short += 1
+            elif outcome == "fallback":
+                self._fallbacks += 1
+        _TRANSFERS.inc(outcome=outcome)
+
+    # -- control loop --------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> Optional[str]:
+        """Parent tick (decode-pool health/autoscale) plus prefill-pool
+        maintenance: retire dead prefill replicas and re-lease back to
+        the configured pool size, one per tick."""
+        for rid in self.prefill_fleet.check_health(now=now):
+            self.prefill_router.forget(rid)
+        ready = len(self.prefill_fleet.replicas())
+        if ready < self._prefill_target:
+            _LOG.warning("disagg: %d/%d prefill replicas; re-leasing",
+                         ready, self._prefill_target)
+            try:
+                self.prefill_fleet.add_replica()
+            except Exception:  # noqa: BLE001 — retried next tick
+                _LOG.exception("disagg: prefill re-lease failed")
+        _PREFILL_REPLICAS.set(float(len(self.prefill_fleet.replicas())))
+        return super().tick(now)
+
+    def close(self) -> None:
+        super().close()
+        self.prefill_fleet.close()
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self, *, token: Optional[str] = None) -> dict:
+        doc = super().stats(token=token)
+        with self._xfer_lock:
+            doc.update({
+                "disagg": True,
+                "prefill_replicas": len(self.prefill_fleet.replicas()),
+                "kv_transfers": self._transferred,
+                "kv_transfer_bytes": self._xfer_bytes,
+                "kv_transfer_skipped_by_cache": self._skipped_cache,
+                "kv_transfer_skipped_short": self._skipped_short,
+                "reprefill_fallbacks": self._fallbacks,
+            })
+        return doc
+
+    def fleet_stats(self, *, token: Optional[str] = None) -> dict:
+        """Per-replica breakdown with a per-pool split: decode rows keep
+        the parent shape (plus ``pool: "decode"``), prefill rows ride
+        alongside with ``pool: "prefill"``."""
+        doc = super().fleet_stats(token=token)
+        for row in doc["replicas"]:
+            row["pool"] = "decode"
+        for state in ("READY", "DRAINING"):
+            for replica in self.prefill_fleet.replicas(state=state):
+                row = replica.engine.stats().doc()
+                row.update({
+                    "replica": replica.id,
+                    "state": replica.state,
+                    "pool": "prefill",
+                    "vm_ids": list(replica.vm_ids),
+                    "consecutive_failures":
+                        self.prefill_fleet.health.failures(replica.id),
+                })
+                doc["replicas"].append(row)
+        doc["pools"] = {
+            "decode": sum(1 for r in doc["replicas"]
+                          if r["pool"] == "decode"),
+            "prefill": sum(1 for r in doc["replicas"]
+                           if r["pool"] == "prefill"),
+        }
+        return doc

@@ -76,6 +76,7 @@ class ReplicaFleet:
         lease_timeout_s: float = 60.0,
         health: Optional[HealthTracker] = None,
         start_engines: bool = True,
+        replica_prefix: str = "replica",
     ):
         self._factory = engine_factory
         self._allocator = allocator
@@ -84,6 +85,10 @@ class ReplicaFleet:
         self._lease_timeout_s = lease_timeout_s
         self.health = health or HealthTracker(HealthPolicy())
         self._start_engines = start_engines
+        # distinct prefixes keep ids unambiguous when several fleets share
+        # a surface (the disagg gateway runs a "prefill" and a "decode"
+        # pool behind one endpoint and replies name the prefill replica)
+        self._replica_prefix = replica_prefix
         self._replicas: Dict[str, Replica] = {}
         self._session_id: Optional[str] = None
         self._seq = 0
@@ -107,7 +112,7 @@ class ReplicaFleet:
             if self._closed:
                 raise RuntimeError("fleet is closed")
             self._seq += 1
-            rid = f"replica-{self._seq}"
+            rid = f"{self._replica_prefix}-{self._seq}"
         vm_ids: List[str] = []
         if self._allocator is not None:
             vm_ids = self._lease()

@@ -196,6 +196,7 @@ class GatewayService:
                 "replica": route[0],
                 "routed_by": route[1],
                 "failovers": failovers,
+                **self._reply_extras(),
             }
         # emitted already covers max_new_tokens (failover landed exactly
         # on the boundary): the stream is complete
@@ -206,7 +207,8 @@ class GatewayService:
                 "ttft_ms": first_ttft_ms, "model": self.model_name,
                 "replica": route[0] if route else None,
                 "routed_by": route[1] if route else None,
-                "failovers": failovers}
+                "failovers": failovers,
+                **self._reply_extras()}
 
     @staticmethod
     def _remaining_deadline(t0: float,
@@ -231,7 +233,7 @@ class GatewayService:
         while loads:
             rid, reason = self.router.choose(prompt, loads)
             replica = self.fleet.get(rid)
-            if replica is None:
+            if replica is None or not self._pre_submit(replica, prompt):
                 loads.pop(rid, None)
                 continue
             try:
@@ -247,6 +249,21 @@ class GatewayService:
         raise Unavailable(
             f"no replica can admit the request: "
             f"{last_err or 'no routable replicas'}")
+
+    def _pre_submit(self, replica, prompt: List[int]) -> bool:
+        """Hook between routing and submission; False drops the replica
+        from this request's candidate set. Subclasses use it for
+        per-replica staging work that must not be wasted on a replica
+        that cannot admit (the disagg gateway probes the queue and then
+        stages KV here)."""
+        return True
+
+    def _reply_extras(self) -> dict:
+        """Extra route metadata merged into every reply — subclasses
+        extend (the disagg gateway adds ``prefilled_by`` /
+        ``kv_transfer_ms``); unknown reply fields are preserved by older
+        clients (proto3 rule)."""
+        return {}
 
     def _note_failover(self) -> None:
         with self._lock:

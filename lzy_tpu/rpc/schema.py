@@ -270,15 +270,26 @@ REQUESTS: Dict[str, Schema] = {
     # gateway-fronted plane (--gateway) the InferGenerate REPLY carries
     # route metadata next to the tokens: "replica" (which engine served
     # it), "routed_by" ("prefix" | "load" | "round_robin"), and
-    # "failovers" (mid-stream resubmissions, 0 on the happy path) —
-    # unknown reply fields are preserved by older clients (proto3 rule)
+    # "failovers" (mid-stream resubmissions, 0 on the happy path). A
+    # disaggregated plane (--disagg) additionally carries "prefilled_by"
+    # (the prefill replica whose KV blocks were STAGED for the serving
+    # attempt — the decode engine folds staged blocks in opportunistically
+    # and a refused import degrades to local re-prefill; null when the
+    # transfer was skipped or fell back), "kv_transfer_ms"
+    # (prefill wait + transport + import-queue latency),
+    # "kv_transfer_skipped" (decode replica already held the prefix) and
+    # "reprefills" (prefill-pool/transfer failures absorbed by local
+    # re-prefill) — unknown reply fields are preserved by older clients
+    # (proto3 rule)
     "InferGenerate": Schema("InferGenerateRequest", {
         "prompt": f(list, required=True),
         "max_new_tokens": f(int),
         "timeout_s": f(float, int),
         "deadline_s": f(float, int), **_TOKEN}),
     "InferStats": Schema("InferStatsRequest", {**_TOKEN}),
-    # gateway-only: per-replica fleet breakdown (serve.py --gateway)
+    # gateway-only: per-replica fleet breakdown (serve.py --gateway). On
+    # a disaggregated plane each row carries "pool" ("prefill"|"decode")
+    # and the reply a "pools" size summary
     "InferFleetStats": Schema("InferFleetStatsRequest", {**_TOKEN}),
     # status surface
     "GetStatus": Schema("GetStatusRequest", {

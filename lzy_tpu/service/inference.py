@@ -204,6 +204,99 @@ def build_gateway_service(
     return service
 
 
+def build_disagg_gateway_service(
+    model: str,
+    *,
+    prefill_replicas: int = 1,
+    decode_replicas: int = 2,
+    slots: int = 4,
+    max_queue: int = 64,
+    eos_token: Optional[int] = None,
+    checkpoint: Optional[str] = None,
+    seed: int = 0,
+    prefill_chunk: int = 64,
+    page_size: int = 16,
+    kv_blocks: Optional[int] = None,
+    routing: str = "prefix",
+    allocator=None,
+    pool_label: str = "cpu-small",
+    autoscale: bool = True,
+    min_replicas: Optional[int] = None,
+    max_replicas: Optional[int] = None,
+    transport=None,
+    start: bool = True,
+):
+    """Construct the disaggregated serving gateway (``serve.py --disagg``):
+    a pool of ``prefill_replicas`` :class:`~lzy_tpu.serving.PrefillEngine`
+    replicas feeding KV blocks over the channels transport to a pool of
+    ``decode_replicas`` :class:`~lzy_tpu.serving.DecodeEngine` replicas
+    behind one ``InferGenerate`` endpoint. Both pools are paged by
+    construction (KV blocks are the transfer unit). Autoscaling applies
+    to the decode pool; the prefill pool is held at its configured size
+    by the tick (dead replicas re-leased).
+    """
+    from lzy_tpu.gateway import (
+        Autoscaler, DisaggGatewayService, PrefixAffinityRouter,
+        ReplicaFleet, RoundRobinRouter)
+    from lzy_tpu.serving import DecodeEngine, PrefillEngine
+
+    if prefill_replicas < 1 or decode_replicas < 1:
+        raise ValueError(
+            f"disagg needs >= 1 replica per pool, got prefill="
+            f"{prefill_replicas} decode={decode_replicas}")
+    if routing not in ("prefix", "rr"):
+        raise ValueError(f"unknown routing {routing!r}; use prefix or rr")
+    cfg, params = _build_engine_parts(model, checkpoint=checkpoint,
+                                      seed=seed)
+    common = dict(slots=slots, max_queue=max_queue,
+                  prefill_chunk=prefill_chunk, seed=seed,
+                  page_size=page_size, kv_blocks=kv_blocks)
+
+    def decode_factory():
+        return DecodeEngine(cfg, params, eos_token=eos_token, **common)
+
+    def prefill_factory():
+        return PrefillEngine(cfg, params, **common)
+
+    decode_fleet = ReplicaFleet(decode_factory, allocator=allocator,
+                                pool_label=pool_label,
+                                session_owner="disagg-decode",
+                                replica_prefix="decode")
+    prefill_fleet = ReplicaFleet(prefill_factory, allocator=allocator,
+                                 pool_label=pool_label,
+                                 session_owner="disagg-prefill",
+                                 replica_prefix="prefill")
+    router_cls = PrefixAffinityRouter if routing == "prefix" \
+        else RoundRobinRouter
+    autoscaler = None
+    if autoscale:
+        autoscaler = Autoscaler(
+            min_replicas=min_replicas or decode_replicas,
+            max_replicas=max_replicas or 2 * decode_replicas)
+    service = DisaggGatewayService(
+        decode_fleet,
+        prefill_fleet,
+        page_size=page_size,
+        router=router_cls(page_size),
+        prefill_router=router_cls(page_size),
+        autoscaler=autoscaler,
+        transport=transport,
+        prefill_replicas=prefill_replicas,
+        model_name=model,
+    )
+    try:
+        for _ in range(decode_replicas):
+            decode_fleet.add_replica()
+        for _ in range(prefill_replicas):
+            prefill_fleet.add_replica()
+    except BaseException:
+        service.close()
+        raise
+    if start:
+        service.start()
+    return service
+
+
 def build_inference_service(
     model: str,
     *,

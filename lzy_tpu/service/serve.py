@@ -103,16 +103,56 @@ def main(argv=None) -> int:
     parser.add_argument("--gateway-pool", default="cpu-small",
                         help="allocator pool the gateway leases replica "
                              "gangs from")
+    parser.add_argument("--disagg", action="store_true",
+                        help="disaggregated prefill/decode serving: a "
+                             "prefill replica pool exports paged KV blocks "
+                             "over the channels data plane to a decode "
+                             "replica pool behind one InferGenerate "
+                             "endpoint (docs/serving.md 'Disaggregated "
+                             "prefill/decode'); implies paged engines and "
+                             "gateway routing/failover semantics")
+    parser.add_argument("--prefill-replicas", type=int, default=1,
+                        help="prefill pool size under --disagg")
+    parser.add_argument("--decode-replicas", type=int, default=2,
+                        help="decode pool size under --disagg "
+                             "(autoscaling may grow it; cap with "
+                             "--max-replicas)")
     args = parser.parse_args(argv)
 
     from lzy_tpu.service import InProcessCluster
 
     if args.gateway and not args.serve_model:
         parser.error("--gateway requires --serve-model")
+    if args.disagg and not args.serve_model:
+        parser.error("--disagg requires --serve-model")
+    if args.disagg and args.gateway:
+        parser.error("--disagg IS a gateway mode; pass one or the other")
 
     inference_service = None
     inference_factory = None
-    if args.serve_model and args.gateway:
+    if args.serve_model and args.disagg:
+        from lzy_tpu.service.inference import build_disagg_gateway_service
+
+        # factory for the same reason as --gateway below: the two pools
+        # lease through the cluster's allocator, which exists only once
+        # the cluster is up
+        def inference_factory(cluster):
+            return build_disagg_gateway_service(
+                args.serve_model,
+                prefill_replicas=args.prefill_replicas,
+                decode_replicas=args.decode_replicas,
+                max_replicas=args.max_replicas,
+                slots=args.serve_slots,
+                max_queue=args.serve_queue,
+                eos_token=args.serve_eos_token,
+                checkpoint=args.model_checkpoint,
+                page_size=args.serve_page_size,
+                kv_blocks=args.serve_kv_blocks,
+                routing=args.gateway_routing,
+                allocator=cluster.allocator,
+                pool_label=args.gateway_pool,
+            )
+    elif args.serve_model and args.gateway:
         from lzy_tpu.service.inference import build_gateway_service
 
         # built via factory so the fleet can lease its replicas through
@@ -179,6 +219,10 @@ def main(argv=None) -> int:
     model = f", model={args.serve_model}" if args.serve_model else ""
     if args.gateway:
         model += (f", gateway={args.replicas}x"
+                  f" ({args.gateway_routing} routing)")
+    if args.disagg:
+        model += (f", disagg={args.prefill_replicas}p/"
+                  f"{args.decode_replicas}d"
                   f" ({args.gateway_routing} routing)")
     print(f"lzy-tpu control plane serving on {server.address} "
           f"(backend={args.backend}, "

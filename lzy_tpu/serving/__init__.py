@@ -26,16 +26,22 @@ from lzy_tpu.serving.engine import (
 from lzy_tpu.serving.kv_cache import (
     BlockPool, KVCacheStats, NoFreeBlocks, RadixCache)
 from lzy_tpu.serving.scheduler import AdmissionError, Request, RequestQueue
+from lzy_tpu.serving.disagg import (
+    DecodeEngine, PrefillEngine, export_kv, import_kv)
 
 __all__ = [
     "AdmissionError",
     "BlockPool",
+    "DecodeEngine",
     "EngineStats",
     "InferenceEngine",
     "KVCacheStats",
     "NoFreeBlocks",
     "PagedInferenceEngine",
+    "PrefillEngine",
     "RadixCache",
     "Request",
     "RequestQueue",
+    "export_kv",
+    "import_kv",
 ]

@@ -1,0 +1,133 @@
+"""KV block export/import between paged engines (disaggregated serving).
+
+Both directions speak :class:`~lzy_tpu.channels.kv_transfer.KVBlockExport`
+— the host-side snapshot the channels data plane moves between replicas.
+The contract that keeps disaggregation bit-identical AND safe:
+
+- **Export** reads the radix tree's blocks for a whole-block token prefix
+  with the blocks *pinned* (``RadixCache.lookup`` increfs them) for the
+  duration of the device→host gather, so a concurrent eviction or
+  allocation on the exporting pool can never free a block mid-read. Block
+  *ids* never leave the pool — only token chunks and K/V bytes travel.
+- **Import** allocates FRESH blocks on the destination pool
+  (``allocate`` evicts LRU unreferenced blocks under pressure — the
+  evict-then-import path — and raises before touching anything if even
+  that cannot cover the payload, in which case the import is simply
+  skipped), scatters the rows in, registers the prefix in the radix tree,
+  then drops its references so the blocks sit cached-unreferenced exactly
+  like a locally-prefilled prefix. Resident requests' blocks are pinned
+  by refcount and therefore untouchable by construction.
+
+A skipped or failed import is never an error upstream: the decode
+engine's own prefix match simply comes up short and the prompt re-prefills
+locally (the disagg gateway counts it as a fallback).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+from lzy_tpu.channels.kv_transfer import KVBlockExport
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+
+def _is_index(path) -> bool:
+    return any(getattr(p, "key", None) == "index" for p in path)
+
+
+def export_kv(engine, tokens: Sequence[int], *,
+              on_pinned: Optional[Callable[[], None]] = None,
+              ) -> Optional[KVBlockExport]:
+    """Snapshot the cached KV blocks covering ``tokens``' whole-block
+    prefix from a paged engine (``PagedInferenceEngine`` or subclass).
+    Returns None when no full block of the prefix is cached (nothing to
+    transfer). ``on_pinned`` is a test hook invoked while the blocks are
+    pinned (between gather and release) so refcount integrity under an
+    in-flight transfer is assertable.
+
+    Call from the engine's scheduling thread (the loop, or the test
+    driver between ``step()`` calls): the gather reads the live cache
+    tree, and a concurrent prefill would donate those buffers.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    page = engine._page
+    n_full = len(tokens) // page
+    if n_full == 0:
+        return None
+    prefix = [int(t) for t in tokens[:n_full * page]]
+    blocks, matched = engine.kv.lookup(prefix)
+    if matched == 0:
+        return None
+    try:
+        prefix = prefix[:matched]
+        ids = jnp.asarray(blocks, jnp.int32)
+        leaves = {}
+        flat, _ = jax.tree_util.tree_flatten_with_path(engine._cache)
+        for path, leaf in flat:
+            if _is_index(path):
+                continue
+            leaves[jax.tree_util.keystr(path)] = np.asarray(leaf[ids])
+        if on_pinned is not None:
+            on_pinned()
+        return KVBlockExport(tokens=prefix, page_size=page, leaves=leaves)
+    finally:
+        engine.kv.release(blocks)
+
+
+def import_kv(engine, export: KVBlockExport) -> int:
+    """Fold a transferred prefix into a paged engine's pool + radix tree;
+    returns the number of blocks imported (0 = skipped: page-size
+    mismatch, prefix already cached, payload malformed, or pool too hot
+    even after evicting everything evictable). Never raises and never
+    touches a block any resident request references — the worst outcome
+    of an import is a local re-prefill.
+
+    Must run between engine steps on the engine's scheduling thread
+    (``DecodeEngine`` drains its import queue at the top of ``step()``).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from lzy_tpu.serving.kv_cache import NoFreeBlocks
+
+    if export.page_size != engine._page:
+        _LOG.warning("kv import skipped: page_size %d != engine %d",
+                     export.page_size, engine._page)
+        return 0
+    tokens = export.tokens
+    n = export.n_blocks
+    if n == 0 or len(tokens) % export.page_size:
+        return 0
+    if engine.kv.match_len(tokens) >= len(tokens):
+        return 0                      # already cached end-to-end: free hit
+    try:
+        blocks = engine.kv.allocate(n)     # evict-then-import
+    except NoFreeBlocks:
+        _LOG.info("kv import skipped: pool too hot for %d blocks", n)
+        return 0
+    ids = jnp.asarray(blocks, jnp.int32)
+    try:
+        def put(path, leaf):
+            if _is_index(path):
+                return leaf
+            data = export.leaves[jax.tree_util.keystr(path)]
+            if data.shape[0] != n or data.shape[1:] != leaf.shape[1:]:
+                raise ValueError(
+                    f"kv leaf shape {data.shape} does not fit pool leaf "
+                    f"{leaf.shape}")
+            return leaf.at[ids].set(jnp.asarray(data, leaf.dtype))
+
+        engine._cache = jax.tree_util.tree_map_with_path(put, engine._cache)
+    except Exception as e:  # noqa: BLE001 — a bad payload must not leak
+        engine.kv.release(blocks)     # refcount 1, outside the tree → freed
+        _LOG.warning("kv import failed (%s: %s); falling back to local "
+                     "prefill", type(e).__name__, e)
+        return 0
+    engine.kv.insert(tokens, blocks)
+    engine.kv.release(blocks)         # stays cached-unreferenced in the tree
+    return n

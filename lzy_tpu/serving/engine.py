@@ -101,6 +101,12 @@ class EngineStats:
     kv_evictions: Optional[int] = None
     prefix_hit_rate: Optional[float] = None
     prefill_tokens_saved: Optional[int] = None
+    # disaggregated serving fields (serving/disagg engines only):
+    # prefill replicas report exports, decode replicas report imports
+    kv_exports: Optional[int] = None
+    kv_export_blocks: Optional[int] = None
+    kv_imports: Optional[int] = None
+    kv_import_blocks: Optional[int] = None
 
     def doc(self) -> dict:
         return {k: v for k, v in dataclasses.asdict(self).items()

@@ -184,6 +184,22 @@ class RadixCache:
         return [tuple(tokens[i:i + page])
                 for i in range(0, len(tokens) - len(tokens) % page, page)]
 
+    def _walk(self, tokens: Sequence[int]) -> List[_Node]:
+        """Prefix descent shared by every lookup flavor: the chain of
+        tree nodes matching ``tokens``' whole-block prefix. The callers
+        layer their own policy (refs, metrics, LRU bumps) on top, so the
+        descent rule itself can never diverge between the admission path
+        and the export path."""
+        node = self._root
+        out: List[_Node] = []
+        for chunk in self._chunks(tokens):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            out.append(child)
+            node = child
+        return out
+
     def match(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
         """Longest cached prefix of ``tokens`` in whole blocks; returns
         ``(block_ids, n_tokens_matched)``. Matched blocks are incref'd —
@@ -192,15 +208,11 @@ class RadixCache:
         suffix token remains for prefill (logits need a real forward
         position)."""
         self._clock += 1
-        node = self._root
+        chain = self._walk(tokens)
         blocks: List[int] = []
-        for chunk in self._chunks(tokens):
-            child = node.children.get(chunk)
-            if child is None:
-                break
+        for child in chain:
             child.last_access = self._clock
             blocks.append(child.block)
-            node = child
         for b in blocks:
             self.pool.incref(b)
         self.hit_tokens += len(blocks) * self.page_size
@@ -210,20 +222,25 @@ class RadixCache:
         self._update_gauges()
         return blocks, len(blocks) * self.page_size
 
+    def lookup(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached whole-block prefix with the blocks PINNED (one
+        reference each — drop them with :meth:`release`) but WITHOUT the
+        hit/lookup accounting or LRU bump of :meth:`match`. This is the
+        KV-export path (disaggregated serving reads blocks out of the
+        tree to ship them to a decode replica): an export must not
+        distort the admission hit-rate stats or the eviction order the
+        serving traffic established."""
+        blocks = [child.block for child in self._walk(tokens)]
+        for b in blocks:
+            self.pool.incref(b)
+        return blocks, len(blocks) * self.page_size
+
     def match_len(self, tokens: Sequence[int]) -> int:
         """Read-only probe of :meth:`match` — no refs taken, no metrics,
         no LRU bump. Safe to call repeatedly (tests and operators peek at
         cache contents with it) without distorting hit-rate stats or
         eviction order."""
-        node = self._root
-        n = 0
-        for chunk in self._chunks(tokens):
-            child = node.children.get(chunk)
-            if child is None:
-                break
-            n += 1
-            node = child
-        return n * self.page_size
+        return len(self._walk(tokens)) * self.page_size
 
     def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> int:
         """Register full-chunk ``blocks`` (one per ``page_size`` chunk of

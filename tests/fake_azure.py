@@ -1,0 +1,224 @@
+"""In-process ``azure.storage.blob`` stand-in for executing ``storage/azure.py``.
+
+Mirror of ``fake_boto3``: the image deliberately ships without the azure
+SDK, so the Azure client used to get only import-gated coverage — its
+object ops, ranged reads, block-blob multipart and retry paths never ran
+(VERDICT component 16, the last "partial"). This module is the missing
+server: an in-memory blob service behind the exact SDK slice
+``AzureStorageClient`` calls, installed into ``sys.modules`` as
+``azure``/``azure.storage``/``azure.storage.blob`` for one test so the
+real code path — lazy import included — executes unchanged.
+
+Fault injection: ``FakeBlobService.fail_next[op]`` holds a countdown of
+calls of ``op`` (e.g. ``"stage_block"``) to fail with a retryable error,
+driving the transfer engine's per-part retry and the
+nothing-committed-on-failure guarantee (Azure has no abort call;
+uncommitted blocks are service-side garbage, so "aborted" means "the
+blob never appeared").
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import types
+from typing import Dict, List, Tuple
+
+
+class FakeAzureError(Exception):
+    """Stands in for azure.core exceptions (the client code does not
+    catch SDK-specific types, so any exception type exercises the same
+    paths)."""
+
+    def __init__(self, op: str):
+        super().__init__(f"fake azure failure in {op}")
+
+
+class _DownloadStream:
+    def __init__(self, data: bytes):
+        self._data = data
+        self.size = len(data)
+
+    def chunks(self):
+        # two chunks exercise the read loop, not just one pass
+        mid = (len(self._data) + 1) // 2
+        for part in (self._data[:mid], self._data[mid:]):
+            if part:
+                yield part
+
+    def readall(self) -> bytes:
+        return self._data
+
+
+class FakeBlobService:
+    """The service-level state every blob/container client shares."""
+
+    def __init__(self):
+        self.account_name = "fakeaccount"
+        self.credential = types.SimpleNamespace(account_key="fake-key")
+        self._blobs: Dict[Tuple[str, str], bytes] = {}
+        # (container, name) -> {block_id: data}; uncommitted staging area
+        self._staged: Dict[Tuple[str, str], Dict[str, bytes]] = {}
+        self._lock = threading.RLock()
+        self.fail_next: Dict[str, int] = {}    # op -> remaining failures
+        self.calls: Dict[str, int] = {}        # op -> total invocations
+
+    def _enter(self, op: str) -> None:
+        with self._lock:
+            self.calls[op] = self.calls.get(op, 0) + 1
+            if self.fail_next.get(op, 0) > 0:
+                self.fail_next[op] -= 1
+                raise FakeAzureError(op)
+
+    def dangling_blocks(self) -> int:
+        """Uncommitted staged blocks across all blobs (the Azure analog
+        of a dangling multipart upload — the service GCs them, but a
+        failed upload must never have committed)."""
+        with self._lock:
+            return sum(len(v) for v in self._staged.values())
+
+
+class FakeBlobClient:
+    def __init__(self, svc: FakeBlobService, container: str, name: str):
+        self._svc = svc
+        self._key = (container, name)
+        self.url = f"https://{svc.account_name}.blob/{container}/{name}"
+
+    # -- plain object ops ----------------------------------------------------
+
+    def upload_blob(self, data, overwrite: bool = False):
+        self._svc._enter("upload_blob")
+        if hasattr(data, "read"):
+            data = data.read()
+        with self._svc._lock:
+            if not overwrite and self._key in self._svc._blobs:
+                raise FakeAzureError("upload_blob: exists")
+            self._svc._blobs[self._key] = bytes(data)
+
+    def download_blob(self, offset=None, length=None) -> _DownloadStream:
+        self._svc._enter("download_blob")
+        data = self._require()
+        if offset is not None:
+            data = data[offset:] if length is None \
+                else data[offset:offset + length]
+        return _DownloadStream(data)
+
+    def exists(self) -> bool:
+        self._svc._enter("exists")
+        with self._svc._lock:
+            return self._key in self._svc._blobs
+
+    def get_blob_properties(self):
+        self._svc._enter("get_blob_properties")
+        return types.SimpleNamespace(size=len(self._require()))
+
+    def delete_blob(self) -> None:
+        self._svc._enter("delete_blob")
+        with self._svc._lock:
+            self._svc._blobs.pop(self._key, None)
+
+    # -- block-blob multipart ------------------------------------------------
+
+    def stage_block(self, block_id: str, data) -> None:
+        self._svc._enter("stage_block")
+        with self._svc._lock:
+            self._svc._staged.setdefault(self._key, {})[block_id] = \
+                bytes(data)
+
+    def commit_block_list(self, blocks: List) -> None:
+        self._svc._enter("commit_block_list")
+        with self._svc._lock:
+            staged = self._svc._staged.pop(self._key, {})
+            ids = [b.id for b in blocks]
+            missing = [bid for bid in ids if bid not in staged]
+            assert not missing, f"committing unstaged blocks: {missing}"
+            self._svc._blobs[self._key] = b"".join(
+                staged[bid] for bid in ids)
+
+    def _require(self) -> bytes:
+        with self._svc._lock:
+            try:
+                return self._svc._blobs[self._key]
+            except KeyError:
+                raise FakeAzureError("blob not found") from None
+
+
+class FakeContainerClient:
+    def __init__(self, svc: FakeBlobService, container: str):
+        self._svc = svc
+        self._container = container
+
+    def list_blobs(self, name_starts_with: str = ""):
+        self._svc._enter("list_blobs")
+        with self._svc._lock:
+            names = sorted(
+                name for (c, name) in self._svc._blobs
+                if c == self._container and name.startswith(name_starts_with))
+        return [types.SimpleNamespace(name=n) for n in names]
+
+
+class FakeBlobServiceClient:
+    """Class surface ``AzureStorageClient`` constructs through."""
+
+    # the one shared service instance per install() (tests reach it via
+    # the return value of install)
+    _service: FakeBlobService = None
+
+    def __init__(self, account_url=None, credential=None):
+        self._svc = type(self)._service
+        self.account_name = self._svc.account_name
+        # SAS-credentialed clients have no account key to sign with
+        self.credential = self._svc.credential if credential is None \
+            else types.SimpleNamespace(sas=credential)
+
+    @classmethod
+    def from_connection_string(cls, conn_str: str):
+        assert conn_str, "connection string must be non-empty"
+        return cls()
+
+    def get_blob_client(self, container: str, blob: str) -> FakeBlobClient:
+        return FakeBlobClient(self._svc, container, blob)
+
+    def get_container_client(self, container: str) -> FakeContainerClient:
+        return FakeContainerClient(self._svc, container)
+
+
+class BlobBlock:
+    def __init__(self, block_id: str):
+        self.id = block_id
+
+
+class BlobSasPermissions:
+    def __init__(self, read: bool = False):
+        self.read = read
+
+
+def generate_blob_sas(*, account_name, container_name, blob_name,
+                      account_key, permission, expiry):
+    assert account_key, "signing needs the account key"
+    return (f"sv=fake&sr=b&sig=deadbeef&sp={'r' if permission.read else ''}"
+            f"&se={expiry.isoformat()}")
+
+
+def install(monkeypatch) -> FakeBlobService:
+    """Register fake ``azure.storage.blob`` modules for one test (undone
+    automatically with the monkeypatch fixture, so the absence contract
+    checked by test_image_contract is untouched elsewhere)."""
+    service = FakeBlobService()
+    FakeBlobServiceClient._service = service
+
+    blob_mod = types.ModuleType("azure.storage.blob")
+    blob_mod.BlobServiceClient = FakeBlobServiceClient
+    blob_mod.BlobBlock = BlobBlock
+    blob_mod.BlobSasPermissions = BlobSasPermissions
+    blob_mod.generate_blob_sas = generate_blob_sas
+
+    storage_mod = types.ModuleType("azure.storage")
+    storage_mod.blob = blob_mod
+    azure_mod = types.ModuleType("azure")
+    azure_mod.storage = storage_mod
+
+    monkeypatch.setitem(sys.modules, "azure", azure_mod)
+    monkeypatch.setitem(sys.modules, "azure.storage", storage_mod)
+    monkeypatch.setitem(sys.modules, "azure.storage.blob", blob_mod)
+    return service

@@ -1,0 +1,199 @@
+"""Executed coverage for ``storage/azure.py`` (VERDICT component 16).
+
+The container has no azure SDK, so these tests install the in-process
+stub from ``fake_azure`` into ``sys.modules`` and run the REAL client
+code — construction through the lazy import (both credential forms),
+every object op, ranged reads through the parallel download engine, and
+the block-blob multipart path with per-part retries and the
+nothing-committed-on-failure guarantee. The gated ImportError contract
+(no SDK → clear error at construction) keeps its own test at the bottom.
+"""
+
+import io
+
+import pytest
+
+from fake_azure import FakeAzureError, install
+
+from lzy_tpu.storage.api import StorageConfig
+from lzy_tpu.storage.transfer import (
+    TransferConfig, download, upload_bytes)
+
+
+@pytest.fixture()
+def az(monkeypatch):
+    """(client, fake service) — a real AzureStorageClient over the
+    in-memory blob service, connection-string credentialed."""
+    fake = install(monkeypatch)
+    from lzy_tpu.storage.registry import client_for
+
+    client = client_for(StorageConfig(
+        uri="azure://container/prefix",
+        connection_string="DefaultEndpointsProtocol=https;AccountName=f"))
+    assert client.scheme == "azure"
+    return client, fake
+
+
+SMALL_CFG = TransferConfig(part_size=64, max_workers=4, retries=3,
+                           backoff_s=0.001)
+
+
+class TestObjectOps:
+    def test_write_read_roundtrip_counts_bytes(self, az):
+        client, _ = az
+        payload = b"x" * 1000
+        n = client.write("azure://container/a/obj", io.BytesIO(payload))
+        assert n == 1000
+        out = io.BytesIO()
+        assert client.read("azure://container/a/obj", out) == 1000
+        assert out.getvalue() == payload
+
+    def test_read_range(self, az):
+        client, _ = az
+        client.write("azure://container/r", io.BytesIO(b"0123456789"))
+        assert client.read_range("azure://container/r", 2, 3) == b"234"
+        assert client.read_range("azure://container/r", 7) == b"789"
+
+    def test_exists_size_delete(self, az):
+        client, _ = az
+        assert not client.exists("azure://container/missing")
+        client.write("azure://container/e", io.BytesIO(b"abc"))
+        assert client.exists("azure://container/e")
+        assert client.size("azure://container/e") == 3
+        client.delete("azure://container/e")
+        assert not client.exists("azure://container/e")
+
+    def test_list_scoped_to_prefix(self, az):
+        client, _ = az
+        keys = [f"azure://container/list/{i:02d}" for i in range(5)]
+        for uri in keys:
+            client.write(uri, io.BytesIO(b"d"))
+        client.write("azure://container/other", io.BytesIO(b"d"))
+        assert list(client.list("azure://container/list/")) == keys
+
+    def test_sign_uri_connection_string_appends_sas(self, az):
+        client, _ = az
+        client.write("azure://container/signed", io.BytesIO(b"d"))
+        url = client.sign_uri("azure://container/signed")
+        assert url.startswith("https://") and "sig=" in url
+
+    def test_sign_uri_sas_client_reuses_its_signature(self, monkeypatch):
+        """A SAS-credentialed client must NOT sign twice — blob.url
+        already carries the signature."""
+        install(monkeypatch)
+        from lzy_tpu.storage.azure import AzureStorageClient
+
+        client = AzureStorageClient(StorageConfig(
+            uri="azure://container/prefix",
+            endpoint="https://fakeaccount.blob",
+            sas_signature="sv=real&sig=abc"))
+        url = client.sign_uri("azure://container/x")
+        assert url.startswith("https://") and "sig=" not in url
+
+    def test_missing_credentials_rejected(self, monkeypatch):
+        install(monkeypatch)
+        from lzy_tpu.storage.azure import AzureStorageClient
+
+        with pytest.raises(ValueError, match="connection_string"):
+            AzureStorageClient(StorageConfig(uri="azure://container/p"))
+
+
+class TestRangedDownload:
+    def test_parallel_ranged_download_via_transfer_engine(self, az,
+                                                          tmp_path):
+        """The generic download path (size + concurrent read_range
+        parts) against the azure client: byte-identical reassembly."""
+        client, fake = az
+        payload = bytes(range(256)) * 3                # 768 B -> 12 parts
+        client.write("azure://container/big", io.BytesIO(payload))
+        dest = tmp_path / "out.bin"
+        n = download(client, "azure://container/big", str(dest),
+                     config=SMALL_CFG)
+        assert n == len(payload)
+        assert dest.read_bytes() == payload
+        assert fake.calls["download_blob"] >= 12       # ranged fan-out
+
+    def test_ranged_read_retries_recover(self, az, tmp_path):
+        client, fake = az
+        payload = b"r" * 300
+        client.write("azure://container/retry", io.BytesIO(payload))
+        fake.fail_next["download_blob"] = 2
+        dest = tmp_path / "retry.bin"
+        assert download(client, "azure://container/retry", str(dest),
+                        config=SMALL_CFG) == 300
+        assert dest.read_bytes() == payload
+
+
+class TestMultipart:
+    def test_small_payload_uses_single_upload(self, az):
+        client, fake = az
+        data = b"s" * SMALL_CFG.part_size              # == part: no blocks
+        n = client.multipart_upload(
+            "azure://container/small", size=len(data),
+            read_span=lambda off, ln: data[off:off + ln],
+            config=SMALL_CFG, advance=lambda n: None)
+        assert n == len(data)
+        assert "stage_block" not in fake.calls
+        out = io.BytesIO()
+        client.read("azure://container/small", out)
+        assert out.getvalue() == data
+
+    def test_blocks_commit_in_offset_order(self, az):
+        client, fake = az
+        data = bytes(range(256)) * 2                   # 512 B -> 8 blocks
+        n = upload_bytes(client, "azure://container/big-up", data,
+                         config=SMALL_CFG)
+        assert n == len(data)
+        assert fake.calls["stage_block"] == 8
+        assert fake.calls["commit_block_list"] == 1
+        out = io.BytesIO()
+        client.read("azure://container/big-up", out)
+        assert out.getvalue() == data
+        assert fake.dangling_blocks() == 0
+
+    def test_per_block_retry_recovers(self, az):
+        client, fake = az
+        fake.fail_next["stage_block"] = 2              # two throttles
+        data = b"r" * 300
+        assert upload_bytes(client, "azure://container/retry-up", data,
+                            config=SMALL_CFG) == 300
+        assert fake.calls["stage_block"] >= 5 + 2      # 5 blocks + retries
+        out = io.BytesIO()
+        client.read("azure://container/retry-up", out)
+        assert out.getvalue() == data
+
+    def test_exhausted_retries_commit_nothing(self, az):
+        """Azure has no abort call — the abort contract is that a failed
+        multipart NEVER commits: the target blob must not appear, and
+        only service-side garbage (uncommitted blocks) remains."""
+        client, fake = az
+        fake.fail_next["stage_block"] = 10 * SMALL_CFG.retries
+        with pytest.raises(Exception):
+            upload_bytes(client, "azure://container/doomed", b"d" * 300,
+                         config=SMALL_CFG)
+        assert "commit_block_list" not in fake.calls
+        assert not client.exists("azure://container/doomed")
+
+    def test_commit_failure_leaves_no_visible_blob(self, az):
+        client, fake = az
+        fake.fail_next["commit_block_list"] = 10 * SMALL_CFG.retries
+        with pytest.raises(Exception):
+            upload_bytes(client, "azure://container/half", b"h" * 300,
+                         config=SMALL_CFG)
+        assert not client.exists("azure://container/half")
+
+
+def test_without_azure_sdk_construction_fails_clearly():
+    """The gated contract on this image (no azure SDK): a clear
+    ImportError at construction, never at first use."""
+    try:
+        import azure.storage.blob  # noqa: F401
+
+        pytest.skip("azure SDK genuinely installed; gate does not apply")
+    except ImportError:
+        pass
+    from lzy_tpu.storage.azure import AzureStorageClient
+
+    with pytest.raises(ImportError, match="azure-storage-blob"):
+        AzureStorageClient(StorageConfig(
+            uri="azure://container/prefix", connection_string="x"))

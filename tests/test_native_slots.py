@@ -118,6 +118,82 @@ def test_p2p_channel_path_in_cluster(tmp_path):
         cluster.shutdown()
 
 
+class TestPeerFailover:
+    """channels/p2p failure path: a peer dying mid-stream leaves a
+    partial file that the NEXT peer resumes from byte offset — the
+    consumer never re-transfers the prefix it already has, and the FNV
+    check still gates what counts as success."""
+
+    def _two_peers(self, tmp_path, payload):
+        from lzy_tpu.channels.p2p import SlotPeer
+
+        roots = []
+        for name in ("a", "b"):
+            root = tmp_path / name
+            root.mkdir()
+            (root / "data.bin").write_bytes(payload)
+            roots.append(root)
+        srv_a = SlotServer(str(roots[0]))
+        srv_b = SlotServer(str(roots[1]))
+        digest = fnv1a_file(str(roots[0] / "data.bin"))
+        peer_a = SlotPeer("127.0.0.1", srv_a.port, "data.bin", digest)
+        peer_b = SlotPeer("127.0.0.1", srv_b.port, "data.bin", digest)
+        return srv_a, srv_b, peer_a, peer_b
+
+    def test_peer_killed_mid_stream_second_peer_resumes(self, tmp_path):
+        import os as _os
+
+        from lzy_tpu.channels.p2p import fetch_via_peers
+
+        payload = _os.urandom(2 * (1 << 20) + 999)
+        srv_a, srv_b, peer_a, peer_b = self._two_peers(tmp_path, payload)
+        dest = tmp_path / "out.bin"
+        try:
+            # the stream from A dies mid-file...
+            n1 = pull("127.0.0.1", srv_a.port, "data.bin", str(dest),
+                      max_bytes=1 << 20)
+            assert 0 < n1 < len(payload)
+            srv_a.stop()                       # ...and A is gone for good
+            # A is tried first (fails fast: connection refused), B resumes
+            # from the partial offset and the FNV check passes
+            assert fetch_via_peers([peer_a, peer_b], str(dest))
+            assert dest.read_bytes() == payload
+            assert fnv1a_file(str(dest)) == peer_b.fnv1a
+        finally:
+            srv_a.stop()
+            srv_b.stop()
+
+    def test_mismatched_resume_is_discarded_by_the_fnv_check(self,
+                                                             tmp_path):
+        """A second peer serving DIFFERENT bytes under the same name must
+        not be able to splice a franken-file past the integrity check:
+        the fetch fails and the corrupt output is deleted."""
+        import dataclasses as _dc
+        import os as _os
+
+        from lzy_tpu.channels.p2p import fetch_via_peers
+
+        payload = _os.urandom(1 << 20)
+        srv_a, srv_b, peer_a, peer_b = self._two_peers(tmp_path, payload)
+        # corrupt B's copy (same size, different tail bytes)
+        evil = payload[: (1 << 19)] + _os.urandom(len(payload) - (1 << 19))
+        (tmp_path / "b" / "data.bin").write_bytes(evil)
+        dest = tmp_path / "out.bin"
+        try:
+            n1 = pull("127.0.0.1", srv_a.port, "data.bin", str(dest),
+                      max_bytes=1 << 19)
+            assert 0 < n1 < len(payload)
+            srv_a.stop()
+            # B resumes from A's partial — the splice fails the FNV gate
+            # (peer_b still advertises the ORIGINAL digest)
+            peer_b = _dc.replace(peer_b, fnv1a=peer_a.fnv1a)
+            assert not fetch_via_peers([peer_a, peer_b], str(dest))
+            assert not dest.exists(), "corrupt splice left behind"
+        finally:
+            srv_a.stop()
+            srv_b.stop()
+
+
 def test_concurrent_pulls(served_file):
     import threading
 

@@ -30,6 +30,18 @@ def registry_metrics():
     import lzy_tpu.service.graph_executor  # noqa: F401
     import lzy_tpu.service.workflow_service  # noqa: F401
     import lzy_tpu.service.worker  # noqa: F401
+    # serving plane: engine + KV cache + request queue panels
+    import lzy_tpu.serving.engine  # noqa: F401
+    import lzy_tpu.serving.kv_cache  # noqa: F401
+    import lzy_tpu.serving.scheduler  # noqa: F401
+    # gateway: routing hit rate, failovers, autoscale, per-replica load
+    import lzy_tpu.gateway.fleet  # noqa: F401
+    import lzy_tpu.gateway.router  # noqa: F401
+    import lzy_tpu.gateway.service  # noqa: F401
+    # disagg: transfer bytes/latency, cache-skips, re-prefill fallbacks
+    import lzy_tpu.gateway.disagg  # noqa: F401
+    import lzy_tpu.serving.disagg.decode  # noqa: F401
+    import lzy_tpu.serving.disagg.prefill  # noqa: F401
     from lzy_tpu.utils.metrics import Counter, Gauge, Histogram, REGISTRY
 
     kinds = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
