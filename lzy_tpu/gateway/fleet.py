@@ -100,7 +100,9 @@ class ReplicaFleet:
         # spikes every time a replica's history vanishes with it)
         self._retired_totals = {
             "requests_finished": 0, "tokens_generated": 0,
-            "prefix_hit_tokens": 0, "prefix_lookup_tokens": 0}
+            "prefix_hit_tokens": 0, "prefix_lookup_tokens": 0,
+            "spec_proposed_tokens": 0, "spec_accepted_tokens": 0,
+            "decode_steps": 0, "decode_rows": 0, "decode_tokens": 0}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -222,6 +224,13 @@ class ReplicaFleet:
                     s.requests_finished
                 self._retired_totals["tokens_generated"] += \
                     s.tokens_generated
+                for key, attr in (("spec_proposed_tokens", "spec_proposed"),
+                                  ("spec_accepted_tokens", "spec_accepted"),
+                                  ("decode_steps", "decode_steps"),
+                                  ("decode_rows", "decode_rows"),
+                                  ("decode_tokens", "decode_tokens")):
+                    self._retired_totals[key] += int(
+                        getattr(replica.engine, attr, 0))
                 kv = getattr(replica.engine, "kv", None)
                 if kv is not None:
                     self._retired_totals["prefix_hit_tokens"] += \
@@ -293,6 +302,12 @@ class ReplicaFleet:
             agg["slots"] += s.slots
             agg["requests_finished"] += s.requests_finished
             agg["tokens_generated"] += s.tokens_generated
+            for key, attr in (("spec_proposed_tokens", "spec_proposed"),
+                              ("spec_accepted_tokens", "spec_accepted"),
+                              ("decode_steps", "decode_steps"),
+                              ("decode_rows", "decode_rows"),
+                              ("decode_tokens", "decode_tokens")):
+                agg[key] += int(getattr(replica.engine, attr, 0))
             kv = getattr(replica.engine, "kv", None)
             if kv is not None:
                 agg["prefix_hit_tokens"] += kv.hit_tokens

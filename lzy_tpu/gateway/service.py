@@ -96,12 +96,16 @@ class GatewayService:
     def generate(self, prompt, *, max_new_tokens: int = 64,
                  token: Optional[str] = None,
                  timeout_s: Optional[float] = None,
-                 deadline_s: Optional[float] = None) -> dict:
+                 deadline_s: Optional[float] = None,
+                 greedy: Optional[bool] = None) -> dict:
         """Blocking generate over the fleet; same contract as the single
         engine's RPC surface plus route metadata (``replica``,
         ``routed_by``, ``failovers``) in the reply. Backpressure is
         fleet-wide: only when EVERY routable replica refuses admission
-        does the caller see ``Unavailable``."""
+        does the caller see ``Unavailable``. ``greedy`` is the
+        per-request sampling override, carried across failover
+        resubmissions (a greedy stream must stay greedy — and therefore
+        deterministic — on the retry replica too)."""
         self._auth(token)
         from lzy_tpu.rpc.core import Unavailable
 
@@ -112,12 +116,14 @@ class GatewayService:
             return self._generate(any_to_tokens(prompt),
                                   int(max_new_tokens),
                                   timeout_s=timeout_s or 120.0,
-                                  deadline_s=deadline_s)
+                                  deadline_s=deadline_s,
+                                  greedy=greedy)
         finally:
             self._waiters.release()
 
     def _generate(self, prompt: List[int], max_new_tokens: int, *,
-                  timeout_s: float, deadline_s: Optional[float]) -> dict:
+                  timeout_s: float, deadline_s: Optional[float],
+                  greedy: Optional[bool] = None) -> dict:
         from lzy_tpu.rpc.core import Unavailable
 
         t0 = time.monotonic()
@@ -135,7 +141,7 @@ class GatewayService:
             replica, routed_by, req = self._submit_routed(
                 effective_prompt, remaining,
                 deadline_s=self._remaining_deadline(t0, deadline_s),
-                exclude=tried_after_failure)
+                exclude=tried_after_failure, greedy=greedy)
             route = (replica.id, routed_by)
             if not req.wait(timeout=max(0.0,
                                         wall_deadline - time.monotonic())):
@@ -220,7 +226,8 @@ class GatewayService:
         return max(0.001, deadline_s - (time.monotonic() - t0))
 
     def _submit_routed(self, prompt: List[int], max_new_tokens: int, *,
-                       deadline_s: Optional[float], exclude: set):
+                       deadline_s: Optional[float], exclude: set,
+                       greedy: Optional[bool] = None):
         """Route + submit with per-replica admission fallback: a replica
         refusing admission (full queue, closed engine) drops out of the
         candidate set and the next-best one is tried; only an empty set
@@ -239,7 +246,7 @@ class GatewayService:
             try:
                 req = replica.engine.submit(
                     prompt, max_new_tokens=max_new_tokens,
-                    deadline_s=deadline_s)
+                    deadline_s=deadline_s, greedy=greedy)
             except AdmissionError as e:
                 last_err = e
                 loads.pop(rid, None)
@@ -373,6 +380,15 @@ class GatewayService:
         hit_rate = 0.0
         if agg["prefix_lookup_tokens"]:
             hit_rate = agg["prefix_hit_tokens"] / agg["prefix_lookup_tokens"]
+        spec_rate = spec_tps = 0.0
+        if agg["spec_proposed_tokens"]:
+            spec_rate = (agg["spec_accepted_tokens"]
+                         / agg["spec_proposed_tokens"])
+            # tokens-per-row-step only once speculation has actually
+            # proposed something: a spec-off fleet reports 0.0, not a
+            # trivially-true 1.0 (the stats comment promises zeros)
+            if agg["decode_rows"]:
+                spec_tps = agg["decode_tokens"] / agg["decode_rows"]
         with self._lock:
             fo, fin = self._failovers, self._finished
             ups, downs = self._scale_ups, self._scale_downs
@@ -393,6 +409,12 @@ class GatewayService:
             "routed_by_prefix": routing["routed_by_prefix"],
             "prefix_route_rate": routing["prefix_route_rate"],
             "fleet_prefix_hit_rate": round(hit_rate, 4),
+            # fleet-wide speculative decoding (zeros when --serve-spec
+            # is off: the counters simply never move)
+            "spec_proposed_tokens": agg["spec_proposed_tokens"],
+            "spec_accepted_tokens": agg["spec_accepted_tokens"],
+            "spec_acceptance_rate": round(spec_rate, 4),
+            "spec_tokens_per_step": round(spec_tps, 4),
         }
 
     def fleet_stats(self, *, token: Optional[str] = None) -> dict:

@@ -60,7 +60,11 @@ class LlamaConfig:
     moe_top_k: int = 2
     # autoregressive decoding with a KV cache (see generate()); the decode
     # step accepts token chunks [B, T>=1], so prefill writes a whole prompt
-    # chunk into the cache per forward pass instead of one position at a time
+    # chunk into the cache per forward pass instead of one position at a
+    # time. The same chunked forward is the speculative VERIFY step
+    # (serving/spec.py): a [B, gamma+1] chunk of proposed tokens scores
+    # every position in one call, and the engine rolls the cache index
+    # back over rejected positions afterwards
     decode: bool = False
     # per-row cache positions: the cache "index" is [B] instead of a scalar,
     # so every batch row decodes at its own sequence position — what the
@@ -255,10 +259,20 @@ class Attention(nn.Module):
     def _decode_step(self, q, k, v, b, page_table=None):
         """Autoregressive step against the KV cache (flax cache collection);
         q/k/v: [B, T, heads|kv, D] pre-RoPE. T=1 is token-by-token decode;
-        T>1 is batched prefill: the whole chunk is written into the cache
-        first, and the mask below keeps each query position causal within
-        it. With ``cfg.decode_slot_index`` the cache index is ``[B]`` and
-        every row reads/writes at its own position (continuous batching).
+        T>1 is a batched chunk — prefill, or the speculative VERIFY
+        forward (``serving/spec.py``): proposed tokens are written and
+        scored in one pass, logits come back for every position, and the
+        caller rewinds the per-row index over rejected positions (the
+        garbage K/V they wrote sits beyond the rewound index, invisible
+        to the causal mask and overwritten before it could surface). With
+        ``cfg.decode_slot_index`` the cache index is ``[B]`` and every
+        row reads/writes at its own position (continuous batching).
+        Caller contract for per-row chunks: ``index + T`` must stay
+        within ``max_seq_len`` for every live row — the dense row write
+        is a ``dynamic_update_slice`` (clamps the start, overwriting real
+        positions) and the paged scatter clamps the page lookup into the
+        row's last block; the serving engines fall back to 1-token steps
+        when any row is that close to the edge.
 
         With ``cfg.decode_paged`` the k/v caches are a SHARED pool of
         ``[kv_pages, kv_page_size, ...]`` blocks and ``page_table``

@@ -345,6 +345,7 @@ class ControlPlaneServer:
                     max_new_tokens=int(p.get("max_new_tokens", 64)),
                     timeout_s=p.get("timeout_s"),
                     deadline_s=p.get("deadline_s"),
+                    greedy=p.get("greedy"),
                     token=p.get("token")),
                 "InferStats": lambda p: _infer_svc().stats(
                     token=p.get("token")),
@@ -758,18 +759,23 @@ class RpcInferenceClient:
 
     def generate(self, prompt, *, max_new_tokens: int = 64,
                  timeout_s: Optional[float] = None,
-                 deadline_s: Optional[float] = None) -> dict:
+                 deadline_s: Optional[float] = None,
+                 greedy: Optional[bool] = None) -> dict:
         """``prompt``: list of token ids. Returns ``{"request_id",
         "tokens", "status", "ttft_ms", "model"}`` (generated ids only, no
         echo). ``deadline_s`` is the engine-side client deadline: past it
         the request is evicted mid-decode and the reply carries
-        ``status: "cancelled"`` with the tokens generated so far."""
+        ``status: "cancelled"`` with the tokens generated so far.
+        ``greedy=True`` forces argmax decoding for this request on a
+        sampling plane (and with it speculative-decoding eligibility
+        under ``--serve-spec``); None follows the server's setting."""
         rpc_timeout = (timeout_s or 120.0) + 30.0   # server waits first
         return self._client.call("InferGenerate", {
             "prompt": list(prompt),
             "max_new_tokens": int(max_new_tokens),
             "timeout_s": timeout_s,
             "deadline_s": deadline_s,
+            "greedy": greedy,
             "token": _token_value(self._token),
         }, timeout_s=rpc_timeout)
 

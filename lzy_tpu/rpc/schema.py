@@ -280,12 +280,16 @@ REQUESTS: Dict[str, Schema] = {
     # "kv_transfer_skipped" (decode replica already held the prefix) and
     # "reprefills" (prefill-pool/transfer failures absorbed by local
     # re-prefill) — unknown reply fields are preserved by older clients
-    # (proto3 rule)
+    # (proto3 rule). "greedy" is the per-request sampling override
+    # (true → argmax decoding for this request even on a sampling
+    # engine, which also makes it eligible for speculative decoding
+    # under serve.py --serve-spec; absent/null → engine default)
     "InferGenerate": Schema("InferGenerateRequest", {
         "prompt": f(list, required=True),
         "max_new_tokens": f(int),
         "timeout_s": f(float, int),
-        "deadline_s": f(float, int), **_TOKEN}),
+        "deadline_s": f(float, int),
+        "greedy": f(bool), **_TOKEN}),
     "InferStats": Schema("InferStatsRequest", {**_TOKEN}),
     # gateway-only: per-replica fleet breakdown (serve.py --gateway). On
     # a disaggregated plane each row carries "pool" ("prefill"|"decode")

@@ -53,7 +53,8 @@ class InferenceService:
     def generate(self, prompt, *, max_new_tokens: int = 64,
                  token: Optional[str] = None,
                  timeout_s: Optional[float] = None,
-                 deadline_s: Optional[float] = None) -> dict:
+                 deadline_s: Optional[float] = None,
+                 greedy: Optional[bool] = None) -> dict:
         """Blocking generate: admit, wait, return generated token ids.
         Backpressure (full queue OR all waiter threads busy) surfaces as
         ``Unavailable`` BEFORE any work happens — safe for the caller to
@@ -62,7 +63,9 @@ class InferenceService:
         decode steps on it. ``deadline_s`` is the engine-side client
         deadline: once it passes, the request is evicted mid-decode and
         the call RETURNS (not raises) with ``status: "cancelled"`` and
-        whatever tokens were generated before the eviction."""
+        whatever tokens were generated before the eviction. ``greedy``
+        is the per-request sampling override (True forces argmax — and
+        speculation eligibility — on a sampling engine)."""
         self._auth(token)
         from lzy_tpu.rpc.core import Unavailable
 
@@ -74,7 +77,8 @@ class InferenceService:
                 req = self.engine.submit(
                     any_to_tokens(prompt),
                     max_new_tokens=int(max_new_tokens),
-                    deadline_s=deadline_s)
+                    deadline_s=deadline_s,
+                    greedy=greedy)
             except AdmissionError as e:
                 raise Unavailable(str(e)) from None
             if not req.wait(timeout=timeout_s or 120.0):
@@ -144,6 +148,8 @@ def build_gateway_service(
     autoscale: bool = True,
     min_replicas: Optional[int] = None,
     max_replicas: Optional[int] = None,
+    spec_tokens: int = 0,
+    warm_start: bool = False,
     start: bool = True,
 ):
     """Construct the serving fleet gateway (``serve.py --gateway``): N
@@ -155,7 +161,10 @@ def build_gateway_service(
     ``routing``: ``"prefix"`` (cache-aware, the default) or ``"rr"``
     (round-robin — the measurable baseline). ``allocator``: an
     ``AllocatorService`` to lease replica gangs through (None runs the
-    fleet unleased, plain threads).
+    fleet unleased, plain threads). ``spec_tokens`` > 0 enables
+    draft-free speculative decoding on every replica (``--serve-spec``);
+    ``warm_start`` AOT-compiles each replica's decode/verify programs at
+    boot instead of on the first request.
     """
     from lzy_tpu.gateway import (
         Autoscaler, GatewayService, PrefixAffinityRouter, ReplicaFleet,
@@ -169,14 +178,19 @@ def build_gateway_service(
     cfg, params = _build_engine_parts(model, checkpoint=checkpoint,
                                       seed=seed)
     common = dict(slots=slots, max_queue=max_queue, eos_token=eos_token,
-                  prefill_chunk=prefill_chunk, seed=seed)
+                  prefill_chunk=prefill_chunk, seed=seed,
+                  spec_tokens=spec_tokens)
 
     def engine_factory():
         if paged:
-            return PagedInferenceEngine(
+            engine = PagedInferenceEngine(
                 cfg, params, page_size=page_size, kv_blocks=kv_blocks,
                 **common)
-        return InferenceEngine(cfg, params, **common)
+        else:
+            engine = InferenceEngine(cfg, params, **common)
+        if warm_start:
+            engine.warmup()
+        return engine
 
     fleet = ReplicaFleet(engine_factory, allocator=allocator,
                          pool_label=pool_label)
@@ -224,6 +238,8 @@ def build_disagg_gateway_service(
     min_replicas: Optional[int] = None,
     max_replicas: Optional[int] = None,
     transport=None,
+    spec_tokens: int = 0,
+    warm_start: bool = False,
     start: bool = True,
 ):
     """Construct the disaggregated serving gateway (``serve.py --disagg``):
@@ -233,7 +249,10 @@ def build_disagg_gateway_service(
     behind one ``InferGenerate`` endpoint. Both pools are paged by
     construction (KV blocks are the transfer unit). Autoscaling applies
     to the decode pool; the prefill pool is held at its configured size
-    by the tick (dead replicas re-leased).
+    by the tick (dead replicas re-leased). ``spec_tokens`` > 0 enables
+    draft-free speculative decoding on the DECODE pool (prefill replicas
+    never decode, so the flag does not reach them); ``warm_start``
+    AOT-compiles decode/verify at replica boot.
     """
     from lzy_tpu.gateway import (
         Autoscaler, DisaggGatewayService, PrefixAffinityRouter,
@@ -253,7 +272,11 @@ def build_disagg_gateway_service(
                   page_size=page_size, kv_blocks=kv_blocks)
 
     def decode_factory():
-        return DecodeEngine(cfg, params, eos_token=eos_token, **common)
+        engine = DecodeEngine(cfg, params, eos_token=eos_token,
+                              spec_tokens=spec_tokens, **common)
+        if warm_start:
+            engine.warmup()
+        return engine
 
     def prefill_factory():
         return PrefillEngine(cfg, params, **common)
@@ -309,6 +332,8 @@ def build_inference_service(
     paged: bool = False,
     page_size: int = 16,
     kv_blocks: Optional[int] = None,
+    spec_tokens: int = 0,
+    warm_start: bool = False,
     start: bool = True,
 ) -> InferenceService:
     """Construct the engine for a named config and wrap it for RPC.
@@ -323,18 +348,29 @@ def build_inference_service(
     ``page_size`` tokens shared by all slots (default: the dense
     equivalent — size it below that to overcommit HBM, above to grow the
     prefix cache; docs/serving.md has the tradeoffs).
+
+    ``spec_tokens`` > 0 enables draft-free speculative decoding
+    (``serving/spec.py``): up to that many prompt-lookup draft tokens
+    verified per decode step. ``warm_start=True`` AOT-compiles the
+    decode (and verify) programs before the first request lands —
+    combined with the persistent XLA compilation cache (``serve.py``
+    enables it) a restarted server answers its first request without
+    paying a fresh compile on TTFT.
     """
     from lzy_tpu.serving import InferenceEngine, PagedInferenceEngine
 
     cfg, params = _build_engine_parts(model, checkpoint=checkpoint,
                                       seed=seed)
     common = dict(slots=slots, max_queue=max_queue, eos_token=eos_token,
-                  prefill_chunk=prefill_chunk, seed=seed)
+                  prefill_chunk=prefill_chunk, seed=seed,
+                  spec_tokens=spec_tokens)
     if paged:
         engine: InferenceEngine = PagedInferenceEngine(
             cfg, params, page_size=page_size, kv_blocks=kv_blocks, **common)
     else:
         engine = InferenceEngine(cfg, params, **common)
+    if warm_start:
+        engine.warmup()
     if start:
         engine.start()
     return InferenceService(engine, model_name=model)

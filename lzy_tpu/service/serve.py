@@ -30,6 +30,27 @@ import sys
 import threading
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache for the serving process (the
+    warm-start half that survives restarts). Until now only the test
+    tier enabled it (tests/conftest.py); a production server re-paid
+    every decode/prefill/verify compile on each boot — directly on the
+    first requests' TTFT. Cache entries are keyed on the HLO +
+    compile-options hash, so executables (and numerics) are unchanged;
+    ``LZY_JAX_CACHE_DIR`` overrides the location. Must run before the
+    first jit compilation, hence before any engine is built."""
+    cache_dir = os.environ.get("LZY_JAX_CACHE_DIR", "/tmp/lzy_jax_cache")
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # the default min-compile-time (1s) would skip most decode-step
+        # programs of small/medium configs — cache everything
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # noqa: BLE001 — older jax without the knobs
+        pass
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m lzy_tpu.service.serve",
@@ -84,6 +105,22 @@ def main(argv=None) -> int:
                              "(default: the dense equivalent; smaller "
                              "overcommits HBM, larger grows the prefix "
                              "cache)")
+    parser.add_argument("--serve-spec", action="store_true",
+                        help="draft-free speculative decoding: n-gram "
+                             "prompt lookup proposes up to --spec-tokens "
+                             "continuation tokens per greedy row, one "
+                             "batched forward verifies them — up to "
+                             "spec-tokens+1 tokens per decode step, "
+                             "bit-identical output (docs/serving.md "
+                             "'Speculative decoding')")
+    parser.add_argument("--spec-tokens", type=int, default=4,
+                        help="max draft tokens per verify step under "
+                             "--serve-spec (gamma)")
+    parser.add_argument("--no-warm-start", action="store_true",
+                        help="skip the AOT warm-up of decode/verify "
+                             "programs at engine boot (first request then "
+                             "pays the compile on its TTFT) and the "
+                             "persistent XLA compilation cache")
     parser.add_argument("--gateway", action="store_true",
                         help="front --serve-model with the serving fleet "
                              "gateway: N engine replicas behind one "
@@ -128,6 +165,11 @@ def main(argv=None) -> int:
     if args.disagg and args.gateway:
         parser.error("--disagg IS a gateway mode; pass one or the other")
 
+    warm_start = bool(args.serve_model) and not args.no_warm_start
+    spec_tokens = args.spec_tokens if args.serve_spec else 0
+    if warm_start:
+        _enable_compile_cache()
+
     inference_service = None
     inference_factory = None
     if args.serve_model and args.disagg:
@@ -151,6 +193,8 @@ def main(argv=None) -> int:
                 routing=args.gateway_routing,
                 allocator=cluster.allocator,
                 pool_label=args.gateway_pool,
+                spec_tokens=spec_tokens,
+                warm_start=warm_start,
             )
     elif args.serve_model and args.gateway:
         from lzy_tpu.service.inference import build_gateway_service
@@ -174,6 +218,8 @@ def main(argv=None) -> int:
                 routing=args.gateway_routing,
                 allocator=cluster.allocator,
                 pool_label=args.gateway_pool,
+                spec_tokens=spec_tokens,
+                warm_start=warm_start,
             )
     elif args.serve_model:
         from lzy_tpu.service.inference import build_inference_service
@@ -187,6 +233,8 @@ def main(argv=None) -> int:
             paged=args.serve_paged,
             page_size=args.serve_page_size,
             kv_blocks=args.serve_kv_blocks,
+            spec_tokens=spec_tokens,
+            warm_start=warm_start,
         )
 
     backend = None

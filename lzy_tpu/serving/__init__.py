@@ -16,6 +16,11 @@ most TPU serving throughput: single-pass prefill and continuous batching).
   behind ``PagedInferenceEngine`` — per-request page tables instead of
   dense per-slot rows, prefill skipped for cached prompt prefixes, LRU
   eviction of unreferenced blocks under memory pressure.
+- ``spec``: draft-free speculative decoding — n-gram prompt-lookup
+  proposals verified by one batched multi-position forward; greedy rows
+  emit up to ``spec_tokens+1`` tokens per decode step, bit-identical to
+  non-speculative decode (acceptance is exact-match against the model's
+  own argmax).
 
 Expose over the control plane with ``lzy_tpu.service.inference`` (the
 ``--serve-model`` flag of ``lzy_tpu.service.serve``).
@@ -26,6 +31,7 @@ from lzy_tpu.serving.engine import (
 from lzy_tpu.serving.kv_cache import (
     BlockPool, KVCacheStats, NoFreeBlocks, RadixCache)
 from lzy_tpu.serving.scheduler import AdmissionError, Request, RequestQueue
+from lzy_tpu.serving.spec import NgramProposer
 from lzy_tpu.serving.disagg import (
     DecodeEngine, PrefillEngine, export_kv, import_kv)
 
@@ -36,6 +42,7 @@ __all__ = [
     "EngineStats",
     "InferenceEngine",
     "KVCacheStats",
+    "NgramProposer",
     "NoFreeBlocks",
     "PagedInferenceEngine",
     "PrefillEngine",

@@ -32,6 +32,20 @@ for a shared paged block pool with radix prefix caching
 (``lzy_tpu/serving/kv_cache.py``): prefill runs only the unmatched prompt
 suffix, admission is budgeted against blocks instead of raw slots, and
 per-request deadlines evict mid-decode with a ``cancelled`` status.
+
+With ``spec_tokens > 0`` both engines run **draft-free speculative
+decoding** (``lzy_tpu/serving/spec.py``): an n-gram prompt-lookup
+proposer drafts up to ``spec_tokens`` continuation tokens per greedy row,
+ONE multi-position verify forward scores all of them (``[slots,
+spec_tokens+1]`` query positions — a fixed width, so exactly one extra
+compiled program), and the longest proposal prefix matching the model's
+own argmax is accepted — up to ``spec_tokens+1`` tokens per decode step,
+bit-identical to non-speculative greedy decode by construction. Rejected
+positions are rolled back: the per-row cache index rewinds, and the
+paged engine additionally returns any wholly-rejected growth block to
+the pool (refcounted/resident blocks are never touched), so a failed
+speculation is invisible to the radix cache. Sampled rows in the same
+batch decode one token per step from the same rng draw order as before.
 """
 
 from __future__ import annotations
@@ -50,6 +64,10 @@ from lzy_tpu.models.generate import (
     sample_token)
 from lzy_tpu.models.llama import Llama, LlamaConfig
 from lzy_tpu.serving.scheduler import AdmissionError, Request, RequestQueue
+from lzy_tpu.serving.spec import (
+    ACCEPT_RATE as _SPEC_RATE, ACCEPTED as _SPEC_ACCEPTED, NgramProposer,
+    PROPOSED as _SPEC_PROPOSED, TOKENS_PER_STEP as _SPEC_TPS,
+    VERIFY_STEPS as _SPEC_STEPS)
 from lzy_tpu.utils.log import get_logger
 from lzy_tpu.utils.metrics import REGISTRY
 
@@ -107,6 +125,13 @@ class EngineStats:
     kv_export_blocks: Optional[int] = None
     kv_imports: Optional[int] = None
     kv_import_blocks: Optional[int] = None
+    # speculative decoding fields (spec_tokens > 0 only; serving/spec.py)
+    spec_tokens: Optional[int] = None
+    spec_proposed_tokens: Optional[int] = None
+    spec_accepted_tokens: Optional[int] = None
+    spec_acceptance_rate: Optional[float] = None
+    spec_verify_steps: Optional[int] = None
+    spec_tokens_per_step: Optional[float] = None
 
     def doc(self) -> dict:
         return {k: v for k, v in dataclasses.asdict(self).items()
@@ -134,10 +159,19 @@ class InferenceEngine:
         eos_token: Optional[int] = None,
         prefill_chunk: int = 64,
         seed: int = 0,
+        spec_tokens: int = 0,
+        spec_ngram: int = 3,
+        proposer=None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if spec_tokens < 0:
+            raise ValueError(f"spec_tokens must be >= 0, got {spec_tokens}")
         base = decode_config(cfg)
+        if spec_tokens + 1 >= base.max_seq_len:
+            raise ValueError(
+                f"spec_tokens ({spec_tokens}) must leave room in "
+                f"max_seq_len ({base.max_seq_len})")
         self.cfg = base
         self.params = params
         self.slots = slots
@@ -146,15 +180,46 @@ class InferenceEngine:
         self._temperature = temperature
         self._top_k, self._top_p = top_k, top_p
         self._rng = jax.random.PRNGKey(seed)
+        # draft-free speculation (serving/spec.py): gamma proposal tokens
+        # per greedy row, verified in one fixed-width forward. ``proposer``
+        # is injectable (tests force full acceptance/rejection with
+        # oracle/adversarial drafts); default is prompt lookup.
+        self.spec_tokens = int(spec_tokens)
+        self._proposer = None
+        if self.spec_tokens > 0:
+            self._proposer = proposer if proposer is not None else \
+                NgramProposer(max_ngram=spec_ngram, gamma=self.spec_tokens)
+        # per-slot incremental lookup state (NgramIndex) — built at a
+        # row's first proposal, extended by the tokens emitted since, so
+        # drafting is O(suffix occurrences), not O(history), per round
+        self._spec_index: List[Optional[Any]] = [None] * slots
 
         self._build_decode_path(base)
 
         self.queue = RequestQueue(max_queue)
         self._active: List[Optional[Request]] = [None] * slots
         self._cur = np.zeros((slots,), np.int32)   # last token per slot
+        # host mirror of each slot's cache index (tokens resident in the
+        # row's KV cache); what speculation rolls back to after rejection
+        self._pos = np.zeros((slots,), np.int64)
         self._finished = 0
         self._cancelled = 0
         self._tokens_out = 0
+        # True while the cache's per-layer index leaves may share ONE
+        # device buffer (a jitted step's outputs can be CSE'd together,
+        # and eager constant paths may intern equal arrays); a donating
+        # call must not see the same buffer twice, so verify rounds
+        # re-materialize the leaves first when set. Conservative: set by
+        # everything that touches the cache, cleared only by the rebuild.
+        self._index_aliased = True
+        # speculation + throughput accounting (public: the gateway fleet
+        # aggregates these across replicas, banking them on retirement)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_steps = 0
+        self.decode_steps = 0     # decode rounds (normal + verify)
+        self.decode_rows = 0      # cumulative active rows over rounds
+        self.decode_tokens = 0    # tokens emitted by decode rounds
         self._stop = threading.Event()
         self._closed = False
         self._thread: Optional[threading.Thread] = None
@@ -179,28 +244,88 @@ class InferenceEngine:
                 jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32))
         )["cache"]
 
-        def decode_step(cache, params, tokens, rng):
+        def decode_step(cache, params, tokens, greedy_mask, rng):
             logits, updated = self._model.apply(
                 {"params": params, "cache": cache}, tokens, mutable=["cache"]
             )
-            nxt, rng = sample_token(
-                logits[:, -1], self._temperature, rng,
-                top_k=self._top_k, top_p=self._top_p)
+            nxt, rng = self._pick_next(logits[:, -1], greedy_mask, rng)
             return updated["cache"], nxt, rng
 
         self._decode_step = jax.jit(decode_step, donate_argnums=(0,))
+
+        def verify_step(cache, params, tokens, greedy_mask, rng):
+            # speculative verify: ``tokens`` is [B, gamma+1] = the last
+            # emitted token plus each row's (padded) proposal. ONE chunked
+            # decode forward writes all positions into the cache and
+            # returns logits for all of them; argmax over every position
+            # is the acceptance reference, while sampled rows draw their
+            # single token from position 0 — the same logits (and the
+            # same one rng split) a 1-token step would have used
+            logits, updated = self._model.apply(
+                {"params": params, "cache": cache}, tokens, mutable=["cache"]
+            )
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt, rng = self._pick_next(logits[:, 0], greedy_mask, rng)
+            return updated["cache"], greedy, nxt, rng
+
+        self._verify_step = jax.jit(verify_step, donate_argnums=(0,))
+
+    # -- sampling helpers --------------------------------------------------
+
+    def _pick_next(self, logits, greedy_mask, rng):
+        """Per-row next token inside a jitted step: sample with the
+        engine-wide params (exactly one rng split — the draw order every
+        bit-identical guarantee leans on), then overwrite rows pinned
+        greedy with argmax. All-greedy engines (temperature<=0) already
+        get argmax from ``sample_token``; the ``where`` is then a no-op."""
+        nxt, rng = sample_token(logits, self._temperature, rng,
+                                top_k=self._top_k, top_p=self._top_p)
+        nxt = jnp.where(
+            greedy_mask, jnp.argmax(logits, axis=-1).astype(jnp.int32), nxt)
+        return nxt, rng
+
+    def _pick_first(self, logits, req: Request):
+        """First-token pick after prefill; same one-split rng discipline
+        as :meth:`_pick_next`, host-side per request."""
+        tok, rng = sample_token(logits, self._temperature, self._rng,
+                                top_k=self._top_k, top_p=self._top_p)
+        if self._row_greedy(req) and self._temperature > 0.0:
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return tok, rng
+
+    def _row_greedy(self, req: Request) -> bool:
+        """Effective sampling mode for a request: its own override, else
+        the engine-wide temperature."""
+        if req.greedy is not None:
+            return bool(req.greedy)
+        return self._temperature <= 0.0
+
+    def _greedy_mask(self) -> np.ndarray:
+        """[slots] bool — True rows take argmax in the jitted step (idle
+        rows are arbitrarily True; their tokens are never read)."""
+        return np.asarray(
+            [self._row_greedy(r) if r is not None else True
+             for r in self._active], bool)
+
+    @staticmethod
+    def _is_index(path) -> bool:
+        return any(getattr(p, "key", None) == "index" for p in path)
 
     # -- request surface ---------------------------------------------------
 
     def submit(self, prompt: Sequence[int], *, max_new_tokens: int = 64,
                request_id: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               greedy: Optional[bool] = None) -> Request:
         """Admit a request (raises ``AdmissionError`` under backpressure,
         ``ValueError`` if it can never fit the cache). Returns the
         :class:`Request`; wait with ``request.result(timeout)``.
         ``deadline_s``: optional client deadline relative to now — once it
         passes the engine evicts the request mid-decode (slot and cache
-        blocks freed) and finishes it with the ``cancelled`` status."""
+        blocks freed) and finishes it with the ``cancelled`` status.
+        ``greedy``: per-request sampling override (True forces argmax —
+        and with it speculation eligibility — on a sampling engine; None
+        follows the engine-wide temperature)."""
         if self._closed:
             # fail fast instead of admitting into a queue no loop will ever
             # drain (shutdown stops the engine before the RPC server, so
@@ -220,7 +345,7 @@ class InferenceEngine:
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         req = Request(prompt, max_new_tokens, request_id=request_id,
-                      deadline_s=deadline_s)
+                      deadline_s=deadline_s, greedy=greedy)
         return self.queue.submit(req)
 
     # -- engine loop -------------------------------------------------------
@@ -313,9 +438,7 @@ class InferenceEngine:
             self._prefill_model, cache, self.params, prompt,
             chunk=self.prefill_chunk, max_seq_len=self.cfg.max_seq_len,
             prefill_step=self._prefill_step)
-        first, self._rng = sample_token(
-            last_logits, self._temperature, self._rng,
-            top_k=self._top_k, top_p=self._top_p)
+        first, self._rng = self._pick_first(last_logits, req)
 
         # splice the prefilled batch-1 cache into the slot's rows; the
         # scalar index leaves land in the [slots] index at this row
@@ -333,6 +456,10 @@ class InferenceEngine:
         now = time.monotonic()
         req.first_token_at = now
         _TTFT.observe(now - req.submitted_at)
+        # the prompt is now cache-resident; the first generated token is
+        # not (the next decode step writes it at this position)
+        self._pos[slot] = len(req.prompt)
+        self._index_aliased = True      # splice touched the index leaves
         self._emit(slot, req, first, active=False)
         if req.done:
             self._free(slot)      # one-token request: slot never activates
@@ -345,21 +472,190 @@ class InferenceEngine:
             return False
         if not self._pre_decode():
             return False
+        plan = self._spec_plan()
+        if plan is not None:
+            return self._decode_verify(plan)
         t0 = time.monotonic()
         tokens = jnp.asarray(self._cur[:, None])
-        self._cache, nxt, self._rng = self._run_decode_step(tokens)
+        mask = jnp.asarray(self._greedy_mask())
+        self._cache, nxt, self._rng = self._run_decode_step(tokens, mask)
+        self._index_aliased = True
         nxt = np.asarray(nxt)        # one host transfer for the whole batch
         dt = time.monotonic() - t0
         _STEP.observe(dt)
-        n_active = sum(r is not None for r in self._active)
-        _TPS.set(n_active / dt if dt > 0 else 0.0)
         self._post_decode_step()
+        emitted = rows = 0
         for slot, req in enumerate(self._active):
             if req is None:
                 continue
             self._emit(slot, req, int(nxt[slot]), active=True)
+            emitted += 1
+            rows += 1
+        self._note_decode_round(emitted, rows, dt)
         _BUSY.set(float(sum(r is not None for r in self._active)))
         return True
+
+    # -- speculative decode (serving/spec.py) ------------------------------
+
+    def _spec_plan(self) -> Optional[dict]:
+        """Per-slot proposals for this round, or None for a normal
+        1-token step. None whenever speculation is off, no greedy row has
+        a usable draft, or any ACTIVE row sits too close to the cache
+        edge (the fixed-width ``[B, gamma+1]`` write would clamp/wrap
+        past ``max_seq_len`` and corrupt real positions — those rows are
+        about to finish anyway, so the whole batch takes plain steps)."""
+        if self._proposer is None:
+            return None
+        width = self.spec_tokens + 1
+        plan: dict = {}
+        for slot, req in enumerate(self._active):
+            if req is None:
+                continue
+            if int(self._pos[slot]) + width > self.cfg.max_seq_len:
+                return None
+            if not self._row_greedy(req):
+                continue
+            remaining = req.max_new_tokens - len(req.tokens)
+            if remaining <= 1:
+                continue   # one more token finishes it: nothing to gain
+            p = self._propose_for(slot, req)
+            p = p[:min(self.spec_tokens, remaining - 1)]
+            if p:
+                plan[slot] = [int(t) for t in p]
+        return plan or None
+
+    def _propose_for(self, slot: int, req: Request) -> List[int]:
+        """Draft for one row, through its per-slot incremental index
+        when the proposer offers one (injected test proposers may not —
+        they get the plain stateless call)."""
+        hist = req.prompt + req.tokens
+        index_fn = getattr(self._proposer, "index", None)
+        if index_fn is None:
+            return self._proposer.propose(hist)
+        idx = self._spec_index[slot]
+        if idx is None or len(idx) > len(hist):
+            idx = self._spec_index[slot] = index_fn(hist)
+        elif len(idx) < len(hist):
+            idx.extend(hist[len(idx):])
+        return idx.propose()
+
+    def _decode_verify(self, plan: dict) -> bool:
+        """One speculative round: a single ``[slots, gamma+1]`` verify
+        forward (last emitted token + each row's padded proposal), accept
+        per row the longest proposal prefix equal to the model's own
+        argmax plus the bonus token after it, then roll the cache back
+        over the rejected tail. Greedy rows emit 1..gamma+1 tokens;
+        sampled/no-draft rows emit exactly one, drawn from the same
+        position-0 logits (and the same single rng split) a plain step
+        would have produced."""
+        t0 = time.monotonic()
+        width = self.spec_tokens + 1
+        toks = np.zeros((self.slots, width), np.int32)
+        toks[:, 0] = self._cur
+        for slot, p in plan.items():
+            toks[slot, 1:1 + len(p)] = p
+        # re-materialize the index leaves before donating if a previous
+        # step's executable may have CSE'd the per-layer index outputs
+        # into ONE buffer — donating an aliased buffer twice into a
+        # different executable is rejected. Values are unchanged for
+        # active rows (_pos mirrors the device index); idle rows reset
+        # to 0, which stops their harmless drift.
+        if self._index_aliased:
+            self._rollback_indices()
+        mask = jnp.asarray(self._greedy_mask())
+        self._cache, greedy_all, nxt, self._rng = self._run_verify_step(
+            jnp.asarray(toks), mask)
+        self._index_aliased = True
+        greedy_all, nxt = jax.device_get((greedy_all, nxt))
+        dt = time.monotonic() - t0
+        _STEP.observe(dt)
+
+        emit: dict = {}
+        for slot, req in enumerate(self._active):
+            if req is None:
+                continue
+            p = plan.get(slot)
+            if p and self._row_greedy(req):
+                m = 0
+                while m < len(p) and p[m] == int(greedy_all[slot, m]):
+                    m += 1
+                # accepted proposals plus the model's own next token
+                # after them (the "bonus": with m == 0 this is exactly
+                # the token a plain step would have emitted)
+                emit[slot] = list(p[:m]) + [int(greedy_all[slot, m])]
+                self.spec_proposed += len(p)
+                self.spec_accepted += m
+                _SPEC_PROPOSED.inc(len(p))
+                _SPEC_ACCEPTED.inc(m)
+            else:
+                emit[slot] = [int(nxt[slot])]
+
+        # roll back BEFORE emitting: the jitted step advanced every row's
+        # cache index by the full width; the true index is the old one
+        # plus the tokens actually entering the cache (accepted + the
+        # last-emitted token the step wrote at position 0). _free (via
+        # _emit on EOS/limit) then resets freed rows on top of this.
+        # A round where EVERY active row fully accepted needs no rewind
+        # (the device index already equals _pos; idle-row drift is
+        # harmless) — the common case on high-acceptance streams.
+        for slot in emit:
+            self._pos[slot] += len(emit[slot])
+        if any(len(emit[slot]) != width for slot in emit):
+            self._rollback_indices()
+        self._post_verify_rollback()
+
+        emitted = rows = 0
+        for slot, req in enumerate(self._active):
+            if req is None:
+                continue
+            rows += 1
+            for tok in emit[slot]:
+                # EOS (or the length limit) inside the accepted window:
+                # _emit finished the request; the rest is discarded
+                if req.done:
+                    break
+                self._emit(slot, req, int(tok), active=True)
+                emitted += 1
+        self.spec_steps += 1
+        _SPEC_STEPS.inc()
+        self._note_decode_round(emitted, rows, dt)
+        _BUSY.set(float(sum(r is not None for r in self._active)))
+        return True
+
+    def _rollback_indices(self) -> None:
+        """Write the host-side per-row positions back into every cache
+        ``index`` leaf (host→device of a few ``[slots]`` int32 arrays —
+        noise next to the forward). K/V written at rejected positions
+        stays in place as garbage: it sits beyond the rewound index, so
+        no mask ever exposes it and later writes overwrite it before it
+        could become visible."""
+        vals = np.asarray(self._pos, np.int32)
+        # one fresh device buffer PER leaf — and an explicit COPY:
+        # ``jnp.asarray`` zero-copies the SAME numpy memory into every
+        # conversion (identical buffer pointers), and a donating step
+        # handed the same buffer twice corrupts memory or dies with
+        # "donate the same buffer twice" depending on timing
+        self._cache = jax.tree_util.tree_map_with_path(
+            lambda path, leaf: jnp.array(vals)
+            if self._is_index(path) else leaf,
+            self._cache)
+        self._index_aliased = False
+
+    def _post_verify_rollback(self) -> None:
+        """Hook after the index rewind; the paged engine releases growth
+        blocks that became wholly rejected."""
+
+    def _note_decode_round(self, emitted: int, rows: int, dt: float) -> None:
+        self.decode_steps += 1
+        self.decode_rows += rows
+        self.decode_tokens += emitted
+        _TPS.set(emitted / dt if dt > 0 else 0.0)
+        if self.spec_tokens:
+            if self.spec_proposed:
+                _SPEC_RATE.set(self.spec_accepted / self.spec_proposed)
+            # per ROW-step: 1.0 = every row advanced one token (no win);
+            # the ceiling is spec_tokens + 1
+            _SPEC_TPS.set(self.decode_tokens / self.decode_rows)
 
     # decode-loop hooks (ONE loop body serves both engines — the paged
     # subclass plugs in block growth, the page-table jit argument, and
@@ -369,11 +665,20 @@ class InferenceEngine:
         """Pre-step resource work; False aborts the round (nothing left)."""
         return True
 
-    def _run_decode_step(self, tokens):
-        return self._decode_step(self._cache, self.params, tokens, self._rng)
+    def _run_decode_step(self, tokens, greedy_mask):
+        return self._decode_step(self._cache, self.params, tokens,
+                                 greedy_mask, self._rng)
+
+    def _run_verify_step(self, tokens, greedy_mask):
+        return self._verify_step(self._cache, self.params, tokens,
+                                 greedy_mask, self._rng)
 
     def _post_decode_step(self) -> None:
-        """Bookkeeping between the device step and token emission."""
+        """Bookkeeping between the device step and token emission: the
+        1-token step put one more token into every active row's cache."""
+        for slot, req in enumerate(self._active):
+            if req is not None:
+                self._pos[slot] += 1
 
     def _emit(self, slot: int, req: Request, token: int, *,
               active: bool) -> None:
@@ -398,14 +703,44 @@ class InferenceEngine:
     def _free(self, slot: int) -> None:
         self._active[slot] = None
         self._cur[slot] = 0
+        self._pos[slot] = 0
+        self._spec_index[slot] = None
         # rewind the freed row's position: an idle slot must not keep
         # attending over (or writing past) a dead request's cache, and the
         # next insertion overwrites the rows wholesale anyway
         self._cache = jax.tree_util.tree_map(
             lambda leaf: leaf.at[slot].set(0) if leaf.ndim == 1 else leaf,
             self._cache)
+        self._index_aliased = True
 
     # -- lifecycle ---------------------------------------------------------
+
+    def warmup(self) -> None:
+        """AOT-compile the decode (and, with speculation on, verify)
+        programs before the first request: jit compiles lazily, so
+        without this the first client pays the whole compile on its TTFT.
+        Compiled via ``.lower(...).compile()`` from ABSTRACT cache avals
+        — no scratch cache is ever materialized, so warming an engine
+        whose KV pool is sized to fill HBM cannot OOM the boot. The
+        in-process HLO-keyed compilation cache (and the persistent one
+        serve.py enables) then makes the first real call's "compile" a
+        lookup."""
+        sds = jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype),
+            self._cache)
+        mask = jax.ShapeDtypeStruct((self.slots,), jnp.bool_)
+        rng = jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype)
+        self._warm_compile(
+            self._decode_step, sds,
+            jax.ShapeDtypeStruct((self.slots, 1), jnp.int32), mask, rng)
+        if self.spec_tokens > 0:
+            self._warm_compile(
+                self._verify_step, sds,
+                jax.ShapeDtypeStruct((self.slots, self.spec_tokens + 1),
+                                     jnp.int32), mask, rng)
+
+    def _warm_compile(self, step, cache, tokens, mask, rng):
+        step.lower(cache, self.params, tokens, mask, rng).compile()
 
     @property
     def closed(self) -> bool:
@@ -472,7 +807,7 @@ class InferenceEngine:
         _BUSY.set(0.0)
 
     def stats(self) -> EngineStats:
-        return EngineStats(
+        s = EngineStats(
             slots=self.slots,
             busy=sum(r is not None for r in self._active),
             queue_depth=self.queue.depth(),
@@ -480,6 +815,21 @@ class InferenceEngine:
             tokens_generated=self._tokens_out,
             requests_cancelled=self._cancelled,
         )
+        if self.spec_tokens > 0:
+            rate = (self.spec_accepted / self.spec_proposed
+                    if self.spec_proposed else 0.0)
+            tps = (self.decode_tokens / self.decode_rows
+                   if self.decode_rows else 0.0)
+            s = dataclasses.replace(
+                s,
+                spec_tokens=self.spec_tokens,
+                spec_proposed_tokens=self.spec_proposed,
+                spec_accepted_tokens=self.spec_accepted,
+                spec_acceptance_rate=round(rate, 4),
+                spec_verify_steps=self.spec_steps,
+                spec_tokens_per_step=round(tps, 4),
+            )
+        return s
 
 
 class PagedInferenceEngine(InferenceEngine):
@@ -542,7 +892,7 @@ class PagedInferenceEngine(InferenceEngine):
         # _slot_blocks mirrors the allocated prefix of each row in python
         self._tables = np.zeros((slots, self._pages_per_seq), np.int32)
         self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
-        self._lens = np.zeros((slots,), np.int64)      # cached tokens/slot
+        # per-row cached-token counts live in the base engine's _pos
         self._admit_seq = np.zeros((slots,), np.int64)  # admission order
         self._admissions = 0
         super().__init__(cfg, params, slots=slots, **kwargs)
@@ -576,22 +926,32 @@ class PagedInferenceEngine(InferenceEngine):
 
         self._prefill_step = prefill_step
 
-        def decode_step(cache, params, tokens, page_table, rng):
+        def decode_step(cache, params, tokens, page_table, greedy_mask, rng):
             logits, updated = self._model.apply(
                 {"params": params, "cache": cache}, tokens,
                 page_table=page_table, mutable=["cache"])
-            nxt, rng = sample_token(
-                logits[:, -1], self._temperature, rng,
-                top_k=self._top_k, top_p=self._top_p)
+            nxt, rng = self._pick_next(logits[:, -1], greedy_mask, rng)
             return updated["cache"], nxt, rng
 
         self._decode_step = jax.jit(decode_step, donate_argnums=(0,))
 
-    # -- cache-tree plumbing -------------------------------------------------
+        def verify_step(cache, params, tokens, page_table, greedy_mask,
+                        rng):
+            # paged twin of the dense verify: the [B, gamma+1] chunk
+            # scatters through the page table (positions past a row's
+            # allocated blocks land on the scratch page — garbage nobody
+            # can accept) and the gather-back keeps the score/mask path
+            # literally the dense one, so acceptance is bit-identical
+            logits, updated = self._model.apply(
+                {"params": params, "cache": cache}, tokens,
+                page_table=page_table, mutable=["cache"])
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt, rng = self._pick_next(logits[:, 0], greedy_mask, rng)
+            return updated["cache"], greedy, nxt, rng
 
-    @staticmethod
-    def _is_index(path) -> bool:
-        return any(getattr(p, "key", None) == "index" for p in path)
+        self._verify_step = jax.jit(verify_step, donate_argnums=(0,))
+
+    # -- cache-tree plumbing -------------------------------------------------
 
     def _pool_to_prefill(self, start: int):
         """The decode cache tree re-skinned for a batch-1 prefill: pool
@@ -683,9 +1043,7 @@ class PagedInferenceEngine(InferenceEngine):
                 cache, last = self._prefill_step(
                     cache, self.params, tokens, pt,
                     jnp.asarray(take - 1, jnp.int32))
-            first, self._rng = sample_token(
-                last, self._temperature, self._rng,
-                top_k=self._top_k, top_p=self._top_p)
+            first, self._rng = self._pick_first(last, req)
             self._merge_prefill(cache, slot, t0)
         except Exception as e:  # noqa: BLE001 — see PoolCorruption
             raise PoolCorruption(
@@ -699,7 +1057,6 @@ class PagedInferenceEngine(InferenceEngine):
         if n_full:
             self.kv.insert(prompt[:n_full * page], table[:n_full])
         self._slot_blocks[slot] = table
-        self._lens[slot] = t0
         self._admissions += 1
         self._admit_seq[slot] = self._admissions
         self._finish_prefill(slot, req, int(first[0]))
@@ -716,7 +1073,7 @@ class PagedInferenceEngine(InferenceEngine):
         for slot, req in enumerate(self._active):
             if req is None:
                 continue
-            pidx = int(self._lens[slot]) // self._page
+            pidx = int(self._pos[slot]) // self._page
             while pidx >= len(self._slot_blocks[slot]):
                 try:
                     block = self.kv.allocate(1)[0]
@@ -747,22 +1104,95 @@ class PagedInferenceEngine(InferenceEngine):
         # False when the squeeze preempted everyone
         return any(r is not None for r in self._active)
 
-    def _run_decode_step(self, tokens):
+    def _run_decode_step(self, tokens, greedy_mask):
         pt = jnp.asarray(self._tables)
         return self._decode_step(self._cache, self.params, tokens, pt,
-                                 self._rng)
+                                 greedy_mask, self._rng)
 
-    def _post_decode_step(self) -> None:
+    def _run_verify_step(self, tokens, greedy_mask):
+        pt = jnp.asarray(self._tables)
+        return self._verify_step(self._cache, self.params, tokens, pt,
+                                 greedy_mask, self._rng)
+
+    def _warm_compile(self, step, cache, tokens, mask, rng):
+        pt = jax.ShapeDtypeStruct((self.slots, self._pages_per_seq),
+                                  jnp.int32)
+        step.lower(cache, self.params, tokens, pt, mask, rng).compile()
+
+    # -- speculative decode over the block pool -------------------------------
+
+    def _spec_plan(self) -> Optional[dict]:
+        """Base plan, then make the speculated positions block-backed: a
+        proposal may only run as far as this row's allocated pages reach
+        (writes past them land on the scratch block and could never be
+        accepted). Growth here is best-effort — NoFreeBlocks truncates
+        the draft instead of preempting anyone; speculation is an
+        optimization and must never cost a live request its blocks."""
+        plan = super()._spec_plan()
+        if not plan:
+            return plan
+        for slot in list(plan):
+            covered = self._grow_for_spec(slot, len(plan[slot]))
+            plan[slot] = plan[slot][:covered]
+            if not plan[slot]:
+                del plan[slot]
+        return plan or None
+
+    def _grow_for_spec(self, slot: int, want: int) -> int:
+        """Allocate blocks so positions ``pos .. pos+want`` are real
+        (``pos`` itself is already covered by ``_grow_for_decode``);
+        returns how many proposal tokens are actually coverable. Only
+        FREE-LIST blocks back a draft — ``allocate`` under a dry free
+        list would evict LRU cached prefix blocks, and a draft that gets
+        rejected would have flushed the prefix cache for nothing (and
+        re-flushed it every verify round on low-acceptance traffic);
+        truncating the draft instead costs at most the speculation win."""
+        from lzy_tpu.serving.kv_cache import NoFreeBlocks
+
+        page, pos = self._page, int(self._pos[slot])
+        last = (pos + want) // page
+        while len(self._slot_blocks[slot]) <= last:
+            if self.kv.pool.free_count() == 0:
+                break      # never evict cached blocks for a draft
+            try:
+                block = self.kv.allocate(1)[0]
+            except NoFreeBlocks:
+                break
+            self._slot_blocks[slot].append(block)
+            self._tables[slot, len(self._slot_blocks[slot]) - 1] = block
+        covered = len(self._slot_blocks[slot]) * page
+        return min(want, max(0, covered - pos - 1))
+
+    def _post_verify_rollback(self) -> None:
+        """Return growth blocks that became WHOLLY rejected to the pool.
+        Only blocks past the rewound length can qualify, and those are
+        always decode-growth allocations private to this slot (prompt
+        blocks — including radix-shared, refcounted ones — all sit below
+        ``_pos``, which never rewinds into the prompt), so resident
+        prefix blocks and the radix tree are untouched by construction:
+        a rejected speculation is invisible to future prefix matches.
+        ``_pos + 1``, not ``_pos``: the block covering the NEXT write
+        position stays — releasing it on a page boundary would only make
+        ``_grow_for_decode`` re-allocate it next round, possibly evicting
+        a cached block for nothing."""
+        from lzy_tpu.serving.kv_cache import blocks_for
+
         for slot, req in enumerate(self._active):
-            if req is not None:
-                self._lens[slot] += 1     # the step wrote at the old length
+            if req is None:
+                continue
+            keep = blocks_for(int(self._pos[slot]) + 1, self._page)
+            blocks = self._slot_blocks[slot]
+            if len(blocks) > keep:
+                tail = blocks[keep:]
+                del blocks[keep:]
+                self._tables[slot, keep:] = 0
+                self.kv.release(tail)
 
     def _free(self, slot: int) -> None:
         super()._free(slot)
         blocks = self._slot_blocks[slot]
         self._slot_blocks[slot] = []
         self._tables[slot, :] = 0
-        self._lens[slot] = 0
         self._admit_seq[slot] = 0
         self.kv.release(blocks)
 

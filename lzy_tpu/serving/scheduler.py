@@ -42,14 +42,24 @@ class Request:
     passes, the engine evicts the request mid-decode (slot and KV-cache
     blocks freed) and finishes it with the ``cancelled`` terminal status —
     partial tokens stay readable on ``tokens``, and the RPC surface
-    returns them with ``status: "cancelled"`` instead of raising."""
+    returns them with ``status: "cancelled"`` instead of raising.
+
+    ``greedy`` is a per-request sampling override: ``True`` forces argmax
+    decoding for this row even on an engine configured with
+    ``temperature>0`` (the row becomes eligible for speculative decoding
+    — ``serving/spec.py``); ``False`` forces sampling with the engine's
+    temperature/top_k/top_p; ``None`` (default) follows the engine-wide
+    setting. Sampled rows sharing a batch with greedy rows keep the exact
+    rng draw order they had before the override existed."""
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
                  request_id: Optional[str] = None,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 greedy: Optional[bool] = None):
         self.id = request_id or f"req-{next(_ids)}"
         self.prompt: List[int] = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
+        self.greedy = greedy
         self.tokens: List[int] = []
         self.error: Optional[str] = None
         self.status: Optional[str] = None     # "ok" | "cancelled" | "error"

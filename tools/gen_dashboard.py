@@ -34,6 +34,8 @@ def registry_metrics():
     import lzy_tpu.serving.engine  # noqa: F401
     import lzy_tpu.serving.kv_cache  # noqa: F401
     import lzy_tpu.serving.scheduler  # noqa: F401
+    # speculative decoding: proposed/accepted, acceptance rate, tok/step
+    import lzy_tpu.serving.spec  # noqa: F401
     # gateway: routing hit rate, failovers, autoscale, per-replica load
     import lzy_tpu.gateway.fleet  # noqa: F401
     import lzy_tpu.gateway.router  # noqa: F401
