@@ -34,6 +34,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from lzy_tpu.chaos.faults import CHAOS
 from lzy_tpu.utils.log import get_logger
 
 _LOG = get_logger(__name__)
@@ -172,6 +173,17 @@ class KVTransferError(RuntimeError):
     payload discarded); the consumer must fall back to re-prefill."""
 
 
+# chaos boundaries: both degrade to decode-side re-prefill — the
+# transfer is advisory by contract, so an injected death costs FLOPs,
+# never a failed request (the invariant the chaos soak asserts)
+_FP_SEND = CHAOS.register(
+    "kv.publish", error=KVTransferError,
+    doc="KV export leaving the producer (send side of the transfer)")
+_FP_RECV = CHAOS.register(
+    "kv.fetch", error=KVTransferError,
+    doc="KV export arriving at the consumer (recv side of the transfer)")
+
+
 class InMemoryKVTransport:
     """Direct producer→consumer path for in-process pools (the
     ``SlotPeer`` analog: while the producer is alive the payload streams
@@ -191,12 +203,14 @@ class InMemoryKVTransport:
         self.fetched = 0
 
     def publish(self, key: str, export: KVBlockExport) -> str:
+        CHAOS.hit("kv.publish")
         with self._lock:
             self._payloads[key] = export
             self.published += 1
         return key
 
     def fetch(self, ref: str) -> KVBlockExport:
+        CHAOS.hit("kv.fetch")
         with self._lock:
             if self.fail_next_fetch > 0:
                 self.fail_next_fetch -= 1
@@ -228,12 +242,14 @@ class StorageKVTransport:
     def publish(self, key: str, export: KVBlockExport) -> str:
         from lzy_tpu.storage.api import join_uri
 
+        CHAOS.hit("kv.publish")
         uri = spill_kv_export(self._storage, join_uri(self._base, key),
                               export)
         self.published += 1
         return uri
 
     def fetch(self, ref: str) -> KVBlockExport:
+        CHAOS.hit("kv.fetch")
         try:
             export = fetch_kv_export(self._storage, ref)
         except Exception as e:  # noqa: BLE001 — consumer falls back

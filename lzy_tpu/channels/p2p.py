@@ -13,9 +13,22 @@ import dataclasses
 import os
 from typing import Optional, Sequence
 
+from lzy_tpu.chaos.faults import CHAOS
+from lzy_tpu.utils.backoff import RetryPolicy
 from lzy_tpu.utils.log import get_logger
 
 _LOG = get_logger(__name__)
+
+# chaos boundary: any failure here already degrades to the next peer
+# (offset-resumed) and finally to the storage fallback
+_FP_FETCH = CHAOS.register(
+    "p2p.fetch", error=OSError,
+    doc="peer slot pull (degrades to next peer, then storage)")
+
+
+class PeerUnavailable(RuntimeError):
+    """No peer in the set could serve the value this round (internal to
+    the retry loop; callers see the boolean contract)."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -31,6 +44,7 @@ def fetch_via_peer(peer: SlotPeer, dest_path: str) -> bool:
     try:
         from lzy_tpu.native import fnv1a_file, pull_with_resume
 
+        CHAOS.hit("p2p.fetch")
         pull_with_resume(peer.host, peer.port, peer.name, dest_path)
         if peer.fnv1a is not None and fnv1a_file(dest_path) != peer.fnv1a:
             _LOG.warning("peer transfer of %s failed integrity check", peer.name)
@@ -43,7 +57,8 @@ def fetch_via_peer(peer: SlotPeer, dest_path: str) -> bool:
         return False
 
 
-def fetch_via_peers(peers: Sequence[SlotPeer], dest_path: str) -> bool:
+def fetch_via_peers(peers: Sequence[SlotPeer], dest_path: str, *,
+                    policy: Optional[RetryPolicy] = None) -> bool:
     """Pull from the first peer that can serve the value, RESUMING across
     peers: a pull that died mid-stream leaves a partial ``dest_path``, and
     the next peer's ``pull_with_resume`` continues from its byte offset
@@ -51,9 +66,28 @@ def fetch_via_peers(peers: Sequence[SlotPeer], dest_path: str) -> bool:
     spill files — are served by every member, so the consumer survives any
     single producer's death without re-transferring the prefix it already
     has). The FNV check still gates success, so a resume that spliced
-    mismatched bytes is discarded, not returned. False only when every
-    peer failed — the caller's storage fallback."""
-    for peer in peers:
-        if fetch_via_peer(peer, dest_path):
-            return True
-    return False
+    mismatched bytes is discarded, not returned.
+
+    ``policy`` (default: one pass) retries the WHOLE peer sweep under the
+    platform backoff law — exponential + full jitter, capped — for
+    callers whose peers may be rebooting rather than gone; partial bytes
+    survive between rounds, so every retry still offset-resumes. False
+    only when every peer failed in every round — the caller's storage
+    fallback."""
+    if not peers:
+        # the fixed peer set cannot gain members between rounds:
+        # backing off over an empty sweep only delays the fallback
+        return False
+    policy = policy or RetryPolicy(attempts=1)
+
+    def sweep() -> bool:
+        for peer in peers:
+            if fetch_via_peer(peer, dest_path):
+                return True
+        raise PeerUnavailable(f"no peer could serve {dest_path}")
+
+    try:
+        return policy.call(sweep, what=f"peer sweep for {dest_path}",
+                           retry_if=lambda e: isinstance(e, PeerUnavailable))
+    except PeerUnavailable:
+        return False

@@ -1,0 +1,282 @@
+"""Seed-deterministic fault injection at named serving-stack points.
+
+``durable.failures.InjectedFailures`` arms ONE hook with ONE crash; this
+module generalizes it along both axes for the serving stack:
+
+- **points are registered, not ad hoc**: each boundary module declares
+  its fault points at import time (name, the typed error its callers
+  degrade from, whether a hard crash is survivable there), so a chaos
+  test can enumerate and arm *every* boundary instead of the one it
+  remembered to patch;
+- **faults come from a seeded plan, not a hand count**: a
+  :class:`FaultPlan` derives one RNG per point from ``(seed, point)``
+  and decides fire/mode on that point's n-th hit. Decisions therefore
+  depend only on the seed and the point's own hit ordinal — never on
+  how threads interleaved ACROSS points — which is what makes a soak
+  failure replayable from its printed seed.
+
+Modes:
+
+- ``error``  — raise the point's registered exception type (the one its
+  callers' degradation path catches: ``AdmissionError`` at admission,
+  ``KVTransferError`` at the transport, ...);
+- ``crash``  — raise ``durable.failures.InjectedCrash`` (a
+  ``BaseException``): the simulated process death; only points that
+  declared ``crash_ok`` (their failure domain is a loop/process with a
+  death handler) are eligible;
+- ``delay``  — sleep a fixed small delay and continue (transient stall);
+- ``slow``   — a longer sleep (degraded-but-alive dependency; what a
+  circuit breaker must catch before timeouts do).
+
+The production cost is one attribute check per ``hit()`` when no plan is
+armed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Type
+
+from lzy_tpu.durable.failures import InjectedCrash
+from lzy_tpu.utils.log import get_logger
+from lzy_tpu.utils.metrics import REGISTRY
+
+_LOG = get_logger(__name__)
+
+_INJECTED = REGISTRY.counter(
+    "lzy_chaos_faults_injected_total",
+    "chaos faults injected, by fault point and mode")
+_ARMED = REGISTRY.gauge(
+    "lzy_chaos_armed", "1 while a chaos fault plan is armed")
+
+CRASH = "crash"
+DELAY = "delay"
+ERROR = "error"
+SLOW = "slow"
+MODES = (CRASH, DELAY, ERROR, SLOW)
+
+
+class InjectedFault(RuntimeError):
+    """Default error-mode exception for points without a more specific
+    degradation type."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPoint:
+    """One named boundary faults can be injected at."""
+
+    name: str
+    #: exception type ``error`` mode raises — the type this boundary's
+    #: callers already catch on their degradation path
+    error: Type[BaseException] = InjectedFault
+    #: whether ``crash`` (an InjectedCrash BaseException) is survivable
+    #: here — i.e. the failure domain is a loop/process whose death
+    #: handler the platform already has
+    crash_ok: bool = False
+    #: modes this point accepts (defense against e.g. crashing a
+    #: boundary whose callers cannot contain a BaseException)
+    modes: Tuple[str, ...] = (ERROR, DELAY, SLOW)
+    doc: str = ""
+
+    def allowed(self, mode: str) -> bool:
+        if mode == CRASH:
+            return self.crash_ok
+        return mode in self.modes
+
+
+class FaultPlan:
+    """Seeded schedule of (point, hit ordinal) -> mode decisions.
+
+    Per point, an RNG seeded with ``(seed, point)`` draws one decision
+    per hit: fire with probability ``rate`` and pick a mode among the
+    plan's modes the point allows. ``max_faults`` bounds how many faults
+    each POINT may fire (so a bounded run always has a quiet tail to
+    finish in) — deliberately per-point, not global: a global budget
+    would make which fault claims the last slot depend on how threads
+    interleaved ACROSS points, and the whole replay guarantee is that a
+    point's decisions are a pure function of the seed and its own hit
+    ordinal. ``delay_s``/``slow_s`` size the sleep modes. The plan is
+    immutable once armed; ``schedule`` records what actually fired for
+    the replay printout.
+    """
+
+    def __init__(self, seed: int, *, rate: float = 0.05,
+                 modes: Sequence[str] = (ERROR, DELAY, CRASH),
+                 delay_s: float = 0.002, slow_s: float = 0.05,
+                 max_faults: Optional[int] = None,
+                 points: Optional[Sequence[str]] = None):
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"rate must be in [0, 1], got {rate}")
+        bad = sorted(set(modes) - set(MODES))
+        if bad:
+            raise ValueError(f"unknown fault modes {bad}; known: {MODES}")
+        self.seed = int(seed)
+        self.rate = rate
+        self.modes = tuple(modes)
+        self.delay_s = delay_s
+        self.slow_s = slow_s
+        self.max_faults = max_faults
+        #: None = every registered point; else an explicit allow-list
+        self.points = None if points is None else frozenset(points)
+        self.fired = 0
+        self.schedule: List[dict] = []
+        self._rngs: Dict[str, random.Random] = {}
+        self._hits: Dict[str, int] = {}
+        self._fired_at: Dict[str, int] = {}     # per-point fired count
+        self._lock = threading.Lock()
+
+    def _rng(self, point: str) -> random.Random:
+        rng = self._rngs.get(point)
+        if rng is None:
+            rng = self._rngs[point] = random.Random(f"{self.seed}:{point}")
+        return rng
+
+    def decide(self, point: FaultPoint) -> Optional[Tuple[str, int]]:
+        """The mode to inject at this hit of ``point`` (with the hit
+        ordinal, for the schedule log), or None. Thread-safe; one RNG
+        draw sequence per point regardless of caller thread."""
+        with self._lock:
+            if self.points is not None and point.name not in self.points:
+                return None
+            hit_no = self._hits.get(point.name, 0) + 1
+            self._hits[point.name] = hit_no
+            rng = self._rng(point.name)
+            # ALWAYS draw both numbers so the decision stream for this
+            # point is a pure function of (seed, hit ordinal) — even
+            # once max_faults silenced the point
+            fire = rng.random() < self.rate
+            mode = self.modes[rng.randrange(len(self.modes))]
+            if not fire or not point.allowed(mode):
+                return None
+            if self.max_faults is not None and \
+                    self._fired_at.get(point.name, 0) >= self.max_faults:
+                return None
+            self._fired_at[point.name] = \
+                self._fired_at.get(point.name, 0) + 1
+            self.fired += 1
+            self.schedule.append(
+                {"point": point.name, "hit": hit_no, "mode": mode})
+            return mode, hit_no
+
+    def describe(self) -> str:
+        """Replay instructions + everything that fired so far."""
+        head = (f"FaultPlan(seed={self.seed}, rate={self.rate}, "
+                f"modes={list(self.modes)}, max_faults={self.max_faults})")
+        with self._lock:
+            lines = [f"  #{i + 1} {d['point']} hit={d['hit']} -> {d['mode']}"
+                     for i, d in enumerate(self.schedule)]
+        return "\n".join([head, f"fired {len(lines)} fault(s):"] + lines)
+
+
+class ChaosInjector:
+    """Process-global fault-point registry + the armed plan.
+
+    Boundary modules ``register()`` their points at import and call
+    ``hit(name)`` at the boundary; tests ``arm()`` a :class:`FaultPlan`
+    (always through a try/finally ``disarm()``).
+    """
+
+    def __init__(self):
+        self._points: Dict[str, FaultPoint] = {}
+        self._plan: Optional[FaultPlan] = None
+        self._lock = threading.Lock()
+
+    # -- registry ------------------------------------------------------------
+
+    def register(self, name: str, *, error: Type[BaseException] = InjectedFault,
+                 crash_ok: bool = False,
+                 modes: Tuple[str, ...] = (ERROR, DELAY, SLOW),
+                 doc: str = "") -> FaultPoint:
+        """Idempotent (modules may be re-imported); re-registration with
+        different properties is a programming error."""
+        point = FaultPoint(name=name, error=error, crash_ok=crash_ok,
+                           modes=modes, doc=doc)
+        with self._lock:
+            existing = self._points.get(name)
+            if existing is not None:
+                if existing != point:
+                    raise ValueError(
+                        f"fault point {name!r} re-registered with different "
+                        f"properties")
+                return existing
+            self._points[name] = point
+        return point
+
+    def points(self) -> List[str]:
+        with self._lock:
+            return sorted(self._points)
+
+    def point(self, name: str) -> FaultPoint:
+        with self._lock:
+            return self._points[name]
+
+    # -- arming --------------------------------------------------------------
+
+    def arm(self, plan: FaultPlan) -> FaultPlan:
+        with self._lock:
+            if self._plan is not None:
+                raise RuntimeError("a fault plan is already armed")
+            if plan.points is not None:
+                unknown = plan.points - set(self._points)
+                if unknown:
+                    raise KeyError(
+                        f"unknown fault points {sorted(unknown)}; "
+                        f"registered: {sorted(self._points)}")
+            self._plan = plan
+        _ARMED.set(1.0)
+        _LOG.warning("chaos: armed %s", plan.describe().splitlines()[0])
+        return plan
+
+    def disarm(self) -> Optional[FaultPlan]:
+        with self._lock:
+            plan, self._plan = self._plan, None
+        _ARMED.set(0.0)
+        return plan
+
+    @property
+    def armed(self) -> Optional[FaultPlan]:
+        return self._plan
+
+    def describe(self) -> str:
+        plan = self._plan
+        return "no fault plan armed" if plan is None else plan.describe()
+
+    # -- the boundary call ---------------------------------------------------
+
+    def hit(self, name: str) -> None:
+        """Called at a fault point; no-op unless a plan is armed (the
+        fast path is one attribute load). Unregistered names raise even
+        unarmed-with-a-plan — a typo'd point must not silently never
+        fire."""
+        plan = self._plan
+        if plan is None:
+            return
+        point = self._points.get(name)
+        if point is None:
+            raise KeyError(f"hit of unregistered fault point {name!r}")
+        decision = plan.decide(point)
+        if decision is None:
+            return
+        mode, hit_no = decision
+        _INJECTED.inc(point=name, mode=mode)
+        _LOG.warning("chaos: injecting %s at %s (hit %d, seed %d)",
+                     mode, name, hit_no, plan.seed)
+        if mode == DELAY:
+            time.sleep(plan.delay_s)
+        elif mode == SLOW:
+            time.sleep(plan.slow_s)
+        elif mode == ERROR:
+            raise point.error(
+                f"injected fault at {name} (hit {hit_no}, "
+                f"seed {plan.seed})")
+        elif mode == CRASH:
+            raise InjectedCrash(
+                f"injected crash at {name} (hit {hit_no}, "
+                f"seed {plan.seed})")
+
+
+#: the process-global injector every boundary threads through
+CHAOS = ChaosInjector()

@@ -1,0 +1,257 @@
+"""Runtime invariant auditors for the serving stack.
+
+Chaos tests assert these after injected faults (and soak tests between
+requests): each auditor walks live data structures and raises
+:class:`InvariantViolation` with the precise accounting that broke, so a
+seeded replay lands on the first corrupt state instead of a downstream
+symptom. Auditors are READ-ONLY and take no locks beyond what the
+audited object's python attributes imply — call them from the test
+thread between requests, not concurrently with a mutating hot loop.
+
+The invariants:
+
+- **block-pool conservation** (:func:`audit_pool`): every pool block is
+  exactly one of {scratch, free-list, referenced, cached-in-tree};
+  a block that is none of them has LEAKED, a block that is two of them
+  is double-owned.
+- **radix-tree consistency** (:func:`audit_radix`): parent/child links
+  mirror each other, chunk keys are page-size, the block->node map is
+  exactly the set of tree nodes, tree blocks are never on the free list.
+- **engine/slot consistency** (:func:`audit_engine`): an active slot's
+  page table mirrors its block list, its position fits its allocated
+  pages, and every held block is actually referenced.
+- **fleet lease accounting** (:func:`audit_fleet_leases`): no VM is
+  leased to two replicas; with an allocator wired, every live replica's
+  VMs exist and are RUNNING.
+- **fenced-token monotonicity** (:class:`FenceAuditor`): across gateway
+  failovers a request's emitted stream only ever extends — the final
+  reply starts with every snapshot fenced at a failover, and the retry
+  prompt carried exactly prompt+fenced.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+
+class InvariantViolation(AssertionError):
+    """An auditor found corrupted accounting; the message carries the
+    exact blocks/ids that broke."""
+
+
+# -- KV block pool ----------------------------------------------------------
+
+def audit_pool(kv) -> None:
+    """``kv`` is a ``serving.kv_cache.RadixCache``."""
+    pool = kv.pool
+    free = list(pool._free)
+    free_set = set(free)
+    if len(free) != len(free_set):
+        raise InvariantViolation(f"free list has duplicates: {free}")
+    for b in free:
+        if not 0 < b < pool.n_blocks:
+            raise InvariantViolation(f"free list holds invalid block {b}")
+        if pool._ref[b] != 0:
+            raise InvariantViolation(
+                f"block {b} is on the free list with refcount "
+                f"{pool._ref[b]}")
+    if 0 in free_set or 0 in kv._node_of:
+        raise InvariantViolation("scratch block 0 left the reserved state")
+    if pool._ref[0] != 0:
+        raise InvariantViolation(
+            f"scratch block 0 has refcount {pool._ref[0]}")
+    leaked, negative = [], []
+    for b in range(1, pool.n_blocks):
+        if pool._ref[b] < 0:
+            negative.append(b)
+        if pool._ref[b] == 0 and b not in free_set and b not in kv._node_of:
+            leaked.append(b)
+    if negative:
+        raise InvariantViolation(f"negative refcounts on blocks {negative}")
+    if leaked:
+        raise InvariantViolation(
+            f"leaked blocks (unreferenced, not free, not cached): {leaked}")
+
+
+def audit_radix(kv) -> None:
+    """Structural consistency of the radix tree over ``kv``'s pool."""
+    free_set = set(kv.pool._free)
+    seen: Dict[int, object] = {}
+
+    def walk(node, depth: int) -> None:
+        for chunk, child in node.children.items():
+            if child.parent is not node:
+                raise InvariantViolation(
+                    f"node for block {child.block}: parent link broken")
+            if child.chunk != chunk:
+                raise InvariantViolation(
+                    f"node for block {child.block}: edge key != node chunk")
+            if len(chunk) != kv.page_size:
+                raise InvariantViolation(
+                    f"node for block {child.block}: chunk of {len(chunk)} "
+                    f"tokens (page_size {kv.page_size})")
+            if child.block in seen:
+                raise InvariantViolation(
+                    f"block {child.block} appears at two tree nodes")
+            if child.block in free_set:
+                raise InvariantViolation(
+                    f"tree block {child.block} is on the free list")
+            seen[child.block] = child
+            walk(child, depth + 1)
+
+    walk(kv._root, 0)
+    if set(seen) != set(kv._node_of):
+        raise InvariantViolation(
+            f"block->node map out of sync with the tree: map has "
+            f"{sorted(set(kv._node_of) - set(seen))} extra, tree has "
+            f"{sorted(set(seen) - set(kv._node_of))} unmapped")
+    for b, node in kv._node_of.items():
+        if node is not seen[b]:
+            raise InvariantViolation(
+                f"block {b}: map points at a detached node")
+
+
+def audit_engine(engine) -> None:
+    """Slot/table/pool consistency of one inference engine. Paged
+    engines get the full block audit; dense engines the position
+    bounds."""
+    active = engine._active
+    for slot, req in enumerate(active):
+        pos = int(engine._pos[slot])
+        if req is None:
+            continue
+        if pos > engine.cfg.max_seq_len:
+            raise InvariantViolation(
+                f"slot {slot}: position {pos} beyond max_seq_len")
+    kv = getattr(engine, "kv", None)
+    if kv is None:
+        return
+    audit_pool(kv)
+    audit_radix(kv)
+    page = engine._page
+    held: Dict[int, int] = {}
+    for slot, req in enumerate(active):
+        blocks = engine._slot_blocks[slot]
+        if req is None:
+            if blocks:
+                raise InvariantViolation(
+                    f"idle slot {slot} still holds blocks {blocks}")
+            continue
+        pos = int(engine._pos[slot])
+        if pos > len(blocks) * page:
+            raise InvariantViolation(
+                f"slot {slot}: position {pos} beyond its {len(blocks)} "
+                f"allocated page(s)")
+        for b in blocks:
+            if kv.pool._ref[b] < 1:
+                raise InvariantViolation(
+                    f"slot {slot} holds unreferenced block {b}")
+            held[b] = held.get(b, 0) + 1
+        table = list(engine._tables[slot][:len(blocks)])
+        if table != blocks:
+            raise InvariantViolation(
+                f"slot {slot}: page table {table} != block list {blocks}")
+        if any(engine._tables[slot][len(blocks):]):
+            raise InvariantViolation(
+                f"slot {slot}: page table rows past the allocated prefix "
+                f"are not scratch")
+    for b, holders in held.items():
+        if kv.pool._ref[b] < holders:
+            raise InvariantViolation(
+                f"block {b}: {holders} slot holder(s) but refcount "
+                f"{kv.pool._ref[b]}")
+
+
+# -- fleet ------------------------------------------------------------------
+
+def audit_fleet_leases(fleet, allocator=None) -> None:
+    """Lease accounting over a ``gateway.fleet.ReplicaFleet``."""
+    from lzy_tpu.gateway.fleet import DRAINING, READY
+
+    with fleet._lock:
+        replicas = list(fleet._replicas.values())
+    owner: Dict[str, str] = {}
+    for replica in replicas:
+        if replica.state not in (READY, DRAINING):
+            raise InvariantViolation(
+                f"replica {replica.id} held in state {replica.state}")
+        for vm_id in replica.vm_ids:
+            if vm_id in owner:
+                raise InvariantViolation(
+                    f"vm {vm_id} leased to both {owner[vm_id]} and "
+                    f"{replica.id}")
+            owner[vm_id] = replica.id
+        if allocator is not None:
+            from lzy_tpu.service.allocator import RUNNING
+
+            for vm_id in replica.vm_ids:
+                try:
+                    vm = allocator.vm(vm_id)
+                except KeyError:
+                    raise InvariantViolation(
+                        f"replica {replica.id} leases vanished vm {vm_id}")
+                if vm.status != RUNNING:
+                    raise InvariantViolation(
+                        f"replica {replica.id} leases vm {vm_id} in "
+                        f"status {vm.status}")
+
+
+# -- fenced tokens ----------------------------------------------------------
+
+class FenceAuditor:
+    """Asserts the gateway's fenced-token contract per request.
+
+    Install on a ``GatewayService`` (``gw.fence_auditor = FenceAuditor()``);
+    the gateway opens one :class:`FenceSession` per request and reports
+    every failover fence and the completion through it. The contract:
+    each fence snapshot extends the previous one (tokens are never
+    dropped or reordered by a failover), the retry prompt is exactly
+    ``prompt + fenced``, and the final reply starts with the last fence.
+    Sessions are per-call objects, so abandoned requests (shed, timed
+    out) can never leak state into a later request's audit.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.failovers_seen = 0
+        self.completions_seen = 0
+
+    def session(self, prompt: Sequence[int]) -> "FenceSession":
+        return FenceSession(self, prompt)
+
+    def _note(self, what: str) -> None:
+        with self._lock:
+            if what == "failover":
+                self.failovers_seen += 1
+            else:
+                self.completions_seen += 1
+
+
+class FenceSession:
+    """One request's fence history (see :class:`FenceAuditor`)."""
+
+    def __init__(self, auditor: FenceAuditor, prompt: Sequence[int]):
+        self._auditor = auditor
+        self._prompt = list(prompt)
+        self._fence: List[int] = []
+
+    def on_failover(self, emitted: Sequence[int],
+                    retry_prompt: Sequence[int]) -> None:
+        snap = list(emitted)
+        if snap[:len(self._fence)] != self._fence:
+            raise InvariantViolation(
+                f"fence shrank or reordered across a failover: "
+                f"{self._fence} -> {snap}")
+        if list(retry_prompt) != self._prompt + snap:
+            raise InvariantViolation(
+                "retry prompt is not prompt + fenced tokens")
+        self._fence = snap
+        self._auditor._note("failover")
+
+    def on_complete(self, tokens: Sequence[int]) -> None:
+        if list(tokens[:len(self._fence)]) != self._fence:
+            raise InvariantViolation(
+                f"final reply does not start with the fenced tokens: "
+                f"fence {self._fence}, reply {list(tokens)}")
+        self._auditor._note("complete")

@@ -37,6 +37,7 @@ import time
 from typing import List, Optional
 
 from lzy_tpu.channels.kv_transfer import InMemoryKVTransport
+from lzy_tpu.chaos.faults import CHAOS, InjectedFault
 from lzy_tpu.gateway.fleet import ReplicaFleet
 from lzy_tpu.gateway.router import PrefixAffinityRouter
 from lzy_tpu.gateway.service import GatewayService
@@ -45,6 +46,13 @@ from lzy_tpu.utils.log import get_logger
 from lzy_tpu.utils.metrics import REGISTRY
 
 _LOG = get_logger(__name__)
+
+# chaos boundary: staging is best-effort BY CONTRACT — an injected
+# failure here must surface as one more re-prefill fallback, never as a
+# failed request
+_FP_STAGE = CHAOS.register(
+    "disagg.stage", error=InjectedFault,
+    doc="prefill-pool KV staging for a routed decode replica")
 
 _TRANSFERS = REGISTRY.counter(
     "lzy_disagg_transfers_total",
@@ -129,23 +137,28 @@ class DisaggGatewayService(GatewayService):
             "reprefills": int(meta.get("reprefills", 0)),
         }
 
-    def _pre_submit(self, replica, prompt: List[int]) -> bool:
+    def _pre_submit(self, replica, prompt: List[int],
+                    deadline_s: Optional[float] = None) -> bool:
         """Parent routing loop's staging hook: probe the decode replica's
         admission gate FIRST — staging KV for a replica that cannot admit
         would waste a whole prefill + transfer and park imported blocks on
         a replica no routed request will match — then stage. Staged
         before submit so the import is queued (and therefore applied)
-        before any scheduling round can admit the request."""
+        before any scheduling round can admit the request.
+        ``deadline_s`` is the request's REMAINING client deadline (a
+        failover re-stages with what is left, not a fresh window): it
+        caps the prefill wait and rides on the prefill-pool submit."""
         engine = replica.engine
         if getattr(engine, "closed", False) or \
                 engine.queue.depth() >= engine.queue.max_depth:
             return False
-        self._stage_kv(replica, prompt)
+        self._stage_kv(replica, prompt, deadline_s=deadline_s)
         return True
 
     # -- KV staging ----------------------------------------------------------
 
-    def _stage_kv(self, replica, prompt: List[int]) -> None:
+    def _stage_kv(self, replica, prompt: List[int], *,
+                  deadline_s: Optional[float] = None) -> None:
         """Best-effort: land the prompt's whole-block KV prefix on the
         chosen decode replica. Never raises — every failure path means
         the decode engine re-prefills locally."""
@@ -169,7 +182,11 @@ class DisaggGatewayService(GatewayService):
             _SKIPPED_CACHE.inc()
             return
         t0 = time.monotonic()
-        staged = self._prefill_remote(prompt)
+        try:
+            CHAOS.hit("disagg.stage")
+            staged = self._prefill_remote(prompt, deadline_s=deadline_s)
+        except InjectedFault:
+            staged = None        # chaos: staging died -> fallback path
         if staged is None:
             meta["reprefills"] = meta.get("reprefills", 0) + 1
             self._count("fallback")
@@ -187,32 +204,68 @@ class DisaggGatewayService(GatewayService):
         meta["prefilled_by"] = prefilled_by
         meta["kv_transfer_ms"] = round(1000 * dt, 3)
 
-    def _prefill_remote(self, prompt: List[int]):
+    def _prefill_remote(self, prompt: List[int], *,
+                        deadline_s: Optional[float] = None):
         """Run the prompt through a prefill replica and pull the export
         over the transport. Returns ``(prefill_replica_id, export)`` or
         None (→ re-prefill fallback). A prefill replica that fails
         mid-flight accrues toward its health verdict and the next
         candidate is tried; transport failures after a successful
-        prefill fall straight back (the payload is gone)."""
+        prefill fall straight back (the payload is gone).
+        ``deadline_s`` (the request's remaining client deadline) caps
+        both the prefill wait and the prefill request itself: a request
+        with 2s left must not park behind a 120s prefill window — past
+        the cap it degrades to local re-prefill, whose own deadline
+        handling does the final accounting."""
+        if deadline_s is not None and deadline_s <= 0:
+            return None
+        # the client budget is ANCHORED here and re-resolved per
+        # candidate: one candidate's near-full wait must come off the
+        # next one's, or N candidates could stage N× past the deadline
+        deadline_at = (None if deadline_s is None
+                       else time.monotonic() + deadline_s)
         loads = dict(self.prefill_fleet.loads())
         while loads:
+            left = None
+            if deadline_at is not None:
+                left = deadline_at - time.monotonic()
+                if left <= 0:
+                    return None
+            wait_s = (self._prefill_timeout_s if left is None
+                      else min(self._prefill_timeout_s, left))
             rid, _ = self.prefill_router.choose(prompt, loads)
             replica = self.prefill_fleet.get(rid)
-            if replica is None:
+            if replica is None or \
+                    not self.prefill_fleet.health.try_route(rid):
                 loads.pop(rid, None)
                 continue
             try:
-                req = replica.engine.submit(prompt)
+                req = replica.engine.submit(prompt, deadline_s=left)
             except AdmissionError:
+                # claimed-but-undispatched probe must not block the
+                # replica for another open_s
+                self.prefill_fleet.health.release_probe(rid)
                 loads.pop(rid, None)
                 continue
             except ValueError:
-                return None       # request-scoped (prompt > pool): no pool
+                # request-scoped (prompt > pool) — nothing was
+                # dispatched, so the probe claim is released too
+                self.prefill_fleet.health.release_probe(rid)
+                return None
             self.prefill_router.observe(rid, prompt)
-            if not req.wait(timeout=self._prefill_timeout_s):
+            if not req.wait(timeout=wait_s):
                 req.cancel()
                 _LOG.warning("disagg: prefill of %s on %s timed out",
                              req.id, rid)
+                # no outcome recorded for this dispatch: free the probe
+                # claim so a half-open replica is not starved for open_s
+                self.prefill_fleet.health.release_probe(rid)
+                return None
+            if req.status == "cancelled":
+                # the REQUEST's deadline died, not the replica: no
+                # health accrual — the decode side finishes the
+                # cancelled-with-partials contract
+                self.prefill_fleet.health.release_probe(rid)
                 return None
             if req.error:
                 _LOG.warning("disagg: prefill replica %s failed (%s); "

@@ -279,14 +279,28 @@ class ReplicaFleet:
             return len(self._replicas)
 
     def loads(self) -> Dict[str, int]:
-        """Routable replicas -> load (queue depth + busy slots)."""
+        """Routable replicas -> load (queue depth + busy slots). A
+        replica behind an OPEN circuit breaker (``health.routable``) is
+        withheld from routing without being retired: flapping hosts stop
+        eating failovers while their lease — and their warm cache — get
+        ``open_s`` to recover."""
         out = {}
         for replica in self.replicas():
             s = replica.engine.stats()
-            out[replica.id] = s.queue_depth + s.busy
             _R_QUEUE.set(float(s.queue_depth), replica=replica.id)
             _R_BUSY.set(float(s.busy), replica=replica.id)
+            if not self.health.routable(replica.id):
+                continue
+            out[replica.id] = s.queue_depth + s.busy
         return out
+
+    def breaker_retry_after_s(self) -> Optional[float]:
+        """When every replica is breaker-blocked, the soonest half-open
+        among them — the shed hint for a fully-tripped fleet."""
+        waits = [self.health.breaker.retry_after_s(r.id)
+                 for r in self.replicas()]
+        waits = [w for w in waits if w is not None]
+        return min(waits) if waits else None
 
     def aggregate(self) -> dict:
         """Fleet-level sums over READY+DRAINING engines (the numbers the

@@ -28,10 +28,12 @@ import threading
 import time
 from typing import List, Optional
 
+from lzy_tpu.chaos.faults import CHAOS
 from lzy_tpu.gateway.autoscale import DOWN, UP, Autoscaler
 from lzy_tpu.gateway.fleet import ReplicaFleet
 from lzy_tpu.gateway.router import PrefixAffinityRouter
-from lzy_tpu.serving.scheduler import AdmissionError, any_to_tokens
+from lzy_tpu.serving.scheduler import (
+    AdmissionError, any_to_tokens, shed_error)
 from lzy_tpu.utils.log import get_logger
 from lzy_tpu.utils.metrics import REGISTRY
 
@@ -44,6 +46,13 @@ _SCALE = REGISTRY.counter(
     "lzy_gateway_scale_events_total", "autoscale decisions by direction")
 _REQUESTS = REGISTRY.counter(
     "lzy_gateway_requests_total", "gateway requests by outcome")
+
+# chaos boundary: error mode refuses one candidate replica exactly like
+# an AdmissionError from its engine — the routing loop tries the next
+# one, and only an empty candidate set sheds to the client
+_FP_DISPATCH = CHAOS.register(
+    "gateway.dispatch", error=AdmissionError,
+    doc="routed submit to one replica (degrades to the next candidate)")
 
 #: engine-side failure prefixes that indicate the REPLICA failed, not the
 #: request — safe (and required) to resubmit elsewhere with fenced tokens
@@ -81,11 +90,18 @@ class GatewayService:
         self._waiters = threading.BoundedSemaphore(max_waiters)
         self._failovers = 0
         self._finished = 0
+        self._shed = 0
+        self._inflight = 0
         self._scale_ups = 0
         self._scale_downs = 0
+        self._draining = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        #: chaos hook (``chaos.invariants.FenceAuditor``): when set, every
+        #: failover fence and completion is reported for the monotonicity
+        #: audit; None (production) costs one attribute check
+        self.fence_auditor = None
 
     # -- request surface -----------------------------------------------------
 
@@ -109,9 +125,17 @@ class GatewayService:
         self._auth(token)
         from lzy_tpu.rpc.core import Unavailable
 
+        if self._draining:
+            raise self._shed_error(
+                Unavailable, "gateway is draining; retry another endpoint",
+                reason="draining", retry_after_s=None)
         if not self._waiters.acquire(blocking=False):
-            raise Unavailable(
-                "all gateway waiter threads are busy; retry later")
+            raise self._shed_error(
+                Unavailable,
+                "all gateway waiter threads are busy; retry later",
+                reason="waiters_busy", retry_after_s=0.25)
+        with self._lock:
+            self._inflight += 1
         try:
             return self._generate(any_to_tokens(prompt),
                                   int(max_new_tokens),
@@ -119,7 +143,18 @@ class GatewayService:
                                   deadline_s=deadline_s,
                                   greedy=greedy)
         finally:
+            with self._lock:
+                self._inflight -= 1
             self._waiters.release()
+
+    def _shed_error(self, exc_type, msg: str, *, reason: str,
+                    retry_after_s: Optional[float]):
+        """Gateway-side shed: the per-service counter plus the shared
+        wire format (``scheduler.shed_error`` owns the hint contract)."""
+        with self._lock:
+            self._shed += 1
+        return shed_error(exc_type, msg, reason=reason,
+                          retry_after_s=retry_after_s)
 
     def _generate(self, prompt: List[int], max_new_tokens: int, *,
                   timeout_s: float, deadline_s: Optional[float],
@@ -128,6 +163,8 @@ class GatewayService:
 
         t0 = time.monotonic()
         wall_deadline = t0 + timeout_s
+        fence = (self.fence_auditor.session(prompt)
+                 if self.fence_auditor is not None else None)
         emitted: List[int] = []          # fenced: already streamed tokens
         failovers = 0
         tried_after_failure: set = set()
@@ -137,15 +174,36 @@ class GatewayService:
             remaining = max_new_tokens - len(emitted)
             if remaining <= 0:
                 break
+            deadline_left = self._remaining_deadline(t0, deadline_s)
+            if deadline_left is not None and deadline_left <= 0:
+                # the client deadline ran out between attempts: finish
+                # with the engine's own cancelled contract (partial
+                # tokens readable) instead of resubmitting a request the
+                # retry replica would only cancel anyway
+                if fence is not None:
+                    fence.on_complete(emitted)
+                _REQUESTS.inc(status="cancelled")
+                with self._lock:
+                    self._finished += 1
+                return {
+                    "request_id": None, "tokens": emitted,
+                    "status": "cancelled", "ttft_ms": first_ttft_ms,
+                    "model": self.model_name,
+                    "replica": route[0] if route else None,
+                    "routed_by": route[1] if route else None,
+                    "failovers": failovers, **self._reply_extras()}
             effective_prompt = prompt + emitted
             replica, routed_by, req = self._submit_routed(
                 effective_prompt, remaining,
-                deadline_s=self._remaining_deadline(t0, deadline_s),
+                t0=t0, deadline_s=deadline_s,
                 exclude=tried_after_failure, greedy=greedy)
             route = (replica.id, routed_by)
             if not req.wait(timeout=max(0.0,
                                         wall_deadline - time.monotonic())):
                 req.cancel()
+                # no outcome will ever be recorded for this dispatch:
+                # a half-open probe claim must not outlive it
+                self.fleet.health.release_probe(replica.id)
                 raise TimeoutError(
                     f"request {req.id} not finished within {timeout_s}s")
             if first_ttft_ms is None and req.first_token_at is not None:
@@ -154,6 +212,8 @@ class GatewayService:
             if req.error and req.status != "cancelled":
                 if not req.error.startswith(_FAILOVER_ERRORS):
                     # request-scoped failure: identical on every replica
+                    # (the replica itself worked — free its probe claim)
+                    self.fleet.health.release_probe(replica.id)
                     _REQUESTS.inc(status="error")
                     raise RuntimeError(
                         f"request {req.id} failed: {req.error}")
@@ -162,6 +222,8 @@ class GatewayService:
                 # toward the health verdict — a KV-pressure preemption is
                 # the engine working as designed, not a sick host
                 emitted.extend(req.tokens)
+                if fence is not None:
+                    fence.on_failover(emitted, prompt + emitted)
                 if not req.error.startswith(_CAPACITY_ERRORS):
                     self.fleet.health.record_failure(replica.id)
                     self.router.forget(replica.id)
@@ -172,6 +234,13 @@ class GatewayService:
                     # waits for blocks), which on a single-replica fleet
                     # is the only way the request can ever finish
                     tried_after_failure.add(replica.id)
+                else:
+                    # a capacity preemption proves the replica WORKS:
+                    # free any half-open probe claim, or "stays
+                    # eligible" would be a lie — routable() would hide
+                    # the replica behind its own live claim and a
+                    # single-replica fleet could never finish
+                    self.fleet.health.release_probe(replica.id)
                 failovers += 1
                 self._note_failover()
                 if failovers > self._max_failovers:
@@ -187,6 +256,8 @@ class GatewayService:
             # terminal: ok or cancelled-with-partials
             self.fleet.health.record_success(replica.id)
             emitted.extend(req.tokens)
+            if fence is not None:
+                fence.on_complete(emitted)
             status = req.status or "ok"
             with self._lock:
                 self._finished += 1
@@ -206,6 +277,8 @@ class GatewayService:
             }
         # emitted already covers max_new_tokens (failover landed exactly
         # on the boundary): the stream is complete
+        if fence is not None:
+            fence.on_complete(emitted)
         with self._lock:
             self._finished += 1
         _REQUESTS.inc(status="ok")
@@ -219,19 +292,26 @@ class GatewayService:
     @staticmethod
     def _remaining_deadline(t0: float,
                             deadline_s: Optional[float]) -> Optional[float]:
-        """The client deadline is absolute from first submission; a
-        failover resubmits with whatever is left of it."""
+        """The client deadline is absolute from first submission
+        (anchored at ``t0``); a failover resubmits with whatever is left
+        of it — never a reset ``deadline_s``. Can return <= 0: the
+        caller short-circuits to the cancelled status instead of
+        submitting an already-dead request."""
         if deadline_s is None:
             return None
-        return max(0.001, deadline_s - (time.monotonic() - t0))
+        return deadline_s - (time.monotonic() - t0)
 
     def _submit_routed(self, prompt: List[int], max_new_tokens: int, *,
-                       deadline_s: Optional[float], exclude: set,
-                       greedy: Optional[bool] = None):
+                       t0: float, deadline_s: Optional[float],
+                       exclude: set, greedy: Optional[bool] = None):
         """Route + submit with per-replica admission fallback: a replica
         refusing admission (full queue, closed engine) drops out of the
         candidate set and the next-best one is tried; only an empty set
-        is fleet-wide backpressure."""
+        is fleet-wide backpressure. The client deadline is carried as
+        ``(t0, deadline_s)`` and re-resolved at every use: staging work
+        in ``_pre_submit`` (a disagg remote prefill can legitimately
+        take seconds) must come OFF the budget, not be granted back by
+        anchoring the engine-side deadline after it."""
         from lzy_tpu.rpc.core import Unavailable
 
         loads = {rid: load for rid, load in self.fleet.loads().items()
@@ -240,29 +320,64 @@ class GatewayService:
         while loads:
             rid, reason = self.router.choose(prompt, loads)
             replica = self.fleet.get(rid)
-            if replica is None or not self._pre_submit(replica, prompt):
+            # try_route CLAIMS a half-open breaker's single probe — at
+            # dispatch, not during enumeration, so listing passes that
+            # route elsewhere never burn a recovered replica's probe
+            if replica is None or not self.fleet.health.try_route(rid):
                 loads.pop(rid, None)
                 continue
+            if not self._pre_submit(
+                    replica, prompt,
+                    deadline_s=self._remaining_deadline(t0, deadline_s)):
+                # claimed but never dispatched: release, or the replica
+                # would sit probe-blocked for another open_s
+                self.fleet.health.release_probe(rid)
+                loads.pop(rid, None)
+                continue
+            # re-resolve AFTER staging; an expiry inside the staging
+            # window submits with the floor and the engine cancels it
+            # promptly under its own contract
+            engine_deadline = self._remaining_deadline(t0, deadline_s)
+            if engine_deadline is not None:
+                engine_deadline = max(0.001, engine_deadline)
             try:
+                CHAOS.hit("gateway.dispatch")
                 req = replica.engine.submit(
                     prompt, max_new_tokens=max_new_tokens,
-                    deadline_s=deadline_s, greedy=greedy)
+                    deadline_s=engine_deadline, greedy=greedy)
             except AdmissionError as e:
                 last_err = e
+                self.fleet.health.release_probe(rid)
                 loads.pop(rid, None)
                 continue
+            except BaseException:
+                # request-scoped failures (over-long prompt) propagate
+                # to the client, but nothing was dispatched — the probe
+                # claim must not outlive the attempt
+                self.fleet.health.release_probe(rid)
+                raise
             self.router.observe(rid, prompt)
             return replica, reason, req
-        raise Unavailable(
+        # fleet-wide refusal: shed with the most informative hint we
+        # have — an engine's own queue estimate, else the soonest
+        # breaker half-open (a fully-tripped fleet recovers on the
+        # breaker's clock, not the client's)
+        retry_after = getattr(last_err, "retry_after_s", None)
+        if retry_after is None:
+            retry_after = self.fleet.breaker_retry_after_s()
+        raise self._shed_error(
+            Unavailable,
             f"no replica can admit the request: "
-            f"{last_err or 'no routable replicas'}")
+            f"{last_err or 'no routable replicas'}",
+            reason="no_replica", retry_after_s=retry_after)
 
-    def _pre_submit(self, replica, prompt: List[int]) -> bool:
+    def _pre_submit(self, replica, prompt: List[int],
+                    deadline_s: Optional[float] = None) -> bool:
         """Hook between routing and submission; False drops the replica
         from this request's candidate set. Subclasses use it for
         per-replica staging work that must not be wasted on a replica
         that cannot admit (the disagg gateway probes the queue and then
-        stages KV here)."""
+        stages KV here — bounded by the request's REMAINING deadline)."""
         return True
 
     def _reply_extras(self) -> dict:
@@ -362,6 +477,29 @@ class GatewayService:
         self._thread.start()
         return self
 
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown: stop admitting (new calls shed with
+        ``draining``), let every in-flight request finish its stream,
+        then close — which retires the fleet and releases every lease.
+        Returns True if all in-flight work finished inside the budget
+        (False: close() failed the stragglers with the usual shutdown
+        error)."""
+        self._draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.02)
+        with self._lock:
+            drained = self._inflight == 0
+        if not drained:
+            _LOG.warning("gateway drain: %d request(s) still in flight "
+                         "after %.1fs; closing anyway", self._inflight,
+                         timeout_s)
+        self.close()
+        return drained
+
     def close(self) -> None:
         self._stop.set()
         if self._thread is not None:
@@ -392,6 +530,7 @@ class GatewayService:
         with self._lock:
             fo, fin = self._failovers, self._finished
             ups, downs = self._scale_ups, self._scale_downs
+            shed = self._shed
         return {
             "model": self.model_name,
             "gateway": True,
@@ -402,6 +541,7 @@ class GatewayService:
             "queue_depth": agg["queue_depth"],
             "requests_finished": fin,
             "tokens_generated": agg["tokens_generated"],
+            "requests_shed": shed,
             "failovers": fo,
             "scale_ups": ups,
             "scale_downs": downs,

@@ -12,7 +12,6 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
-import time
 from typing import Optional
 
 from lzy_tpu.native.build import NativeUnavailable, load_native_lib
@@ -105,10 +104,20 @@ def remote_size(host: str, port: int, remote_name: str) -> int:
 def pull_with_resume(host: str, port: int, remote_name: str, dest_path: str,
                      *, max_retries: int = 5, retry_delay_s: float = 0.2) -> int:
     """Pull to completion, resuming from the local size after interruptions —
-    the reference's offset-resume + retry contract (SURVEY.md §3.4)."""
+    the reference's offset-resume + retry contract (SURVEY.md §3.4).
+    Retry pacing rides the platform backoff policy (exponential + full
+    jitter from ``retry_delay_s``, capped) so a gang of consumers
+    re-pulling from one rebooted producer does not stampede it."""
+    from lzy_tpu.utils.backoff import RetryPolicy
+
+    policy = RetryPolicy(attempts=max_retries + 1, base_s=retry_delay_s,
+                         cap_s=max(retry_delay_s, 5.0))
     total = remote_size(host, port, remote_name)
-    attempt = 0
-    while True:
+
+    class _Stalled(OSError):
+        pass
+
+    def one():
         local = os.path.getsize(dest_path) if os.path.exists(dest_path) else 0
         if local >= total:
             return local
@@ -118,12 +127,15 @@ def pull_with_resume(host: str, port: int, remote_name: str, dest_path: str,
             local = -1
         if local >= total:
             return local
-        attempt += 1
-        if attempt > max_retries:
-            raise TimeoutError(
-                f"transfer of {remote_name} stalled after {max_retries} retries"
-            )
-        time.sleep(retry_delay_s)
+        raise _Stalled(f"partial pull of {remote_name}")
+
+    try:
+        return policy.call(one, what=f"pull {remote_name}",
+                           retry_if=lambda e: isinstance(e, _Stalled))
+    except _Stalled:
+        raise TimeoutError(
+            f"transfer of {remote_name} stalled after {max_retries} retries"
+        ) from None
 
 
 def fnv1a_file(path: str) -> int:

@@ -15,12 +15,12 @@ re-raise the matching Python exception.
 from __future__ import annotations
 
 import json
-import time
 from concurrent import futures
 from typing import Any, Callable, Dict, Optional
 
 import grpc
 
+from lzy_tpu.utils.backoff import RetryPolicy
 from lzy_tpu.utils.log import get_logger
 
 _LOG = get_logger(__name__)
@@ -121,9 +121,13 @@ class JsonRpcClient:
         self._channel = grpc.insecure_channel(address)
         self._timeout_s = timeout_s
         self._address = address
-        self._max_attempts = max_attempts
-        self._backoff_base_s = backoff_base_s
-        self._backoff_cap_s = backoff_cap_s
+        # both policies are fixed at construction; building them per
+        # call would sit on the RPC hot path for nothing
+        self._retry_policy = RetryPolicy(
+            attempts=max_attempts, base_s=backoff_base_s,
+            cap_s=backoff_cap_s)
+        self._once_policy = RetryPolicy(
+            attempts=1, base_s=backoff_base_s, cap_s=backoff_cap_s)
 
     def call(self, method: str, payload: Optional[dict] = None,
              timeout_s: Optional[float] = None, *, retry: bool = False,
@@ -144,22 +148,19 @@ class JsonRpcClient:
             response_deserializer=None,
         )
         request = json.dumps(payload).encode("utf-8")
-        attempts = self._max_attempts if retry else 1
-        delay = self._backoff_base_s
-        for attempt in range(1, attempts + 1):
-            try:
-                raw = fn(request, timeout=timeout_s or self._timeout_s)
-                return json.loads(raw.decode("utf-8")) if raw else {}
-            except grpc.RpcError as e:
-                if attempt < attempts and e.code() in _TRANSIENT:
-                    _LOG.info("rpc %s transient %s (attempt %d/%d); retrying "
-                              "in %.2fs", method, e.code().name, attempt,
-                              attempts, delay)
-                    time.sleep(delay)
-                    delay = min(delay * 2, self._backoff_cap_s)
-                    continue
-                raise _to_exception(e) from None
-        raise AssertionError("unreachable")
+        policy = self._retry_policy if retry else self._once_policy
+
+        def one():
+            raw = fn(request, timeout=timeout_s or self._timeout_s)
+            return json.loads(raw.decode("utf-8")) if raw else {}
+
+        try:
+            return policy.call(
+                one, what=f"rpc {method}",
+                retry_if=lambda e: (isinstance(e, grpc.RpcError)
+                                    and e.code() in _TRANSIENT))
+        except grpc.RpcError as e:
+            raise _to_exception(e) from None
 
     def close(self) -> None:
         self._channel.close()

@@ -31,12 +31,23 @@ from lzy_tpu.durable import (
     OperationStore,
     StepResult,
 )
+from lzy_tpu.chaos.faults import CHAOS
 from lzy_tpu.types import PoolSpec, TpuPoolSpec, VmSpec
 from lzy_tpu.utils.ids import gen_id
 from lzy_tpu.utils.log import get_logger
 from lzy_tpu.utils.metrics import REGISTRY
 
 _LOG = get_logger(__name__)
+
+# chaos boundaries (lzy_tpu/chaos): a refused lease is retried by the
+# gateway's next tick; a failed heartbeat stales the VM toward the GC /
+# health verdict — both already-existing degradation paths
+_FP_LEASE = CHAOS.register(
+    "allocator.lease", error=RuntimeError,
+    doc="blocking gang lease for a serving replica")
+_FP_HEARTBEAT = CHAOS.register(
+    "allocator.heartbeat", error=KeyError,
+    doc="worker agent heartbeat (failure stales heartbeat_ts)")
 
 # AllocatorMetrics parity (`allocator/.../alloc/AllocatorMetrics.java:21-63`)
 _M_ALLOCS = REGISTRY.counter(
@@ -210,6 +221,7 @@ class AllocatorService:
         the warm gang to the session cache) when done."""
         from lzy_tpu.durable.store import FAILED
 
+        CHAOS.hit("allocator.lease")
         # the op's expiry is pinned to OUR patience: if we stop waiting,
         # the op expires too and its rollback destroys the gang instead of
         # leaking it (see the TimeoutError path below for the tail race)
@@ -295,6 +307,7 @@ class AllocatorService:
         """Raises KeyError for unknown VMs and for VMs with no registered
         agent — the worker must then re-register (e.g. after a control-plane
         restart rebuilt the VM registry without live endpoints) or exit."""
+        CHAOS.hit("allocator.heartbeat")
         with self._lock:
             vm = self._vms.get(vm_id)
             if vm is None:

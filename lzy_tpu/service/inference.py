@@ -80,7 +80,14 @@ class InferenceService:
                     deadline_s=deadline_s,
                     greedy=greedy)
             except AdmissionError as e:
-                raise Unavailable(str(e)) from None
+                # client-facing shed (single-engine plane: no other
+                # replica to try); shed_error owns the hint's wire format
+                from lzy_tpu.serving.scheduler import shed_error
+
+                raise shed_error(
+                    Unavailable, str(e), reason="admission",
+                    retry_after_s=getattr(e, "retry_after_s", None),
+                ) from None
             if not req.wait(timeout=timeout_s or 120.0):
                 req.cancel()
                 raise TimeoutError(
@@ -101,6 +108,12 @@ class InferenceService:
     def stats(self, *, token: Optional[str] = None) -> dict:
         self._auth(token)
         return {"model": self.model_name, **self.engine.stats().doc()}
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown: stop admitting, finish in-flight rows,
+        then close (``serve.py`` calls this on SIGTERM before tearing
+        the cluster down)."""
+        return self.engine.drain(timeout_s)
 
     def close(self) -> None:
         self.engine.close()

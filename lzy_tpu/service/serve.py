@@ -116,6 +116,12 @@ def main(argv=None) -> int:
     parser.add_argument("--spec-tokens", type=int, default=4,
                         help="max draft tokens per verify step under "
                              "--serve-spec (gamma)")
+    parser.add_argument("--drain-timeout-s", type=float, default=30.0,
+                        help="graceful-shutdown budget on SIGTERM/SIGINT: "
+                             "the serving plane stops admitting, finishes "
+                             "in-flight requests for up to this long, and "
+                             "releases replica leases before the process "
+                             "exits (0 skips the drain and closes hard)")
     parser.add_argument("--no-warm-start", action="store_true",
                         help="skip the AOT warm-up of decode/verify "
                              "programs at engine boot (first request then "
@@ -286,8 +292,17 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, handle)
     signal.signal(signal.SIGINT, handle)
     stop.wait()
-    if inference_service is not None:
-        inference_service.close()
+    # graceful drain: stop admission, finish in-flight rows, release
+    # leases — THEN tear the cluster down. cluster.inference_service
+    # also covers the factory-built gateway/disagg services.
+    serving = cluster.inference_service or inference_service
+    if serving is not None:
+        if args.drain_timeout_s > 0 and hasattr(serving, "drain"):
+            print(f"draining serving plane (up to "
+                  f"{args.drain_timeout_s:g}s)", flush=True)
+            serving.drain(args.drain_timeout_s)
+        else:
+            serving.close()
     cluster.shutdown()
     return 0
 

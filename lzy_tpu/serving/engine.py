@@ -59,6 +59,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lzy_tpu.chaos.faults import CHAOS, CRASH, DELAY, ERROR, SLOW
 from lzy_tpu.models.generate import (
     batched_prefill, decode_config, init_cache, make_prefill_step,
     sample_token)
@@ -100,6 +101,17 @@ _SLOTS = REGISTRY.gauge(
 _TPS = REGISTRY.gauge(
     "lzy_inference_tokens_per_s",
     "instantaneous decode throughput (active slots / last step wall time)")
+
+# chaos boundaries (lzy_tpu/chaos): both run inside the engine loop,
+# whose death handler fails outstanding requests and flips ``closed`` —
+# the exact failure domain the gateway's fenced-token failover covers —
+# so a hard crash is survivable fleet-wide, not just an error
+_FP_STEP = CHAOS.register(
+    "engine.step", crash_ok=True, modes=(ERROR, DELAY, SLOW, CRASH),
+    doc="one engine scheduling round (loop death -> gateway failover)")
+_FP_PREFILL = CHAOS.register(
+    "engine.prefill", crash_ok=True, modes=(ERROR, DELAY, SLOW, CRASH),
+    doc="paged prefill device section (pool donated -> engine-fatal)")
 
 
 @dataclasses.dataclass
@@ -222,6 +234,13 @@ class InferenceEngine:
         self.decode_tokens = 0    # tokens emitted by decode rounds
         self._stop = threading.Event()
         self._closed = False
+        self._draining = False
+        # every admitted, not-yet-terminal request — what drain() waits
+        # on. Queue depth + busy slots is NOT enough: between the pop
+        # and slot activation a request is mid-prefill and visible in
+        # neither, and drain closing in that window would kill it.
+        self._outstanding: set = set()
+        self._outstanding_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         _SLOTS.set(float(slots))
         _BUSY.set(0.0)
@@ -326,11 +345,13 @@ class InferenceEngine:
         ``greedy``: per-request sampling override (True forces argmax —
         and with it speculation eligibility — on a sampling engine; None
         follows the engine-wide temperature)."""
-        if self._closed:
+        if self._closed or self._draining:
             # fail fast instead of admitting into a queue no loop will ever
             # drain (shutdown stops the engine before the RPC server, so
             # this window is reachable over the wire; the front maps it to
-            # the same retryable Unavailable a full queue produces)
+            # the same retryable Unavailable a full queue produces). A
+            # DRAINING engine still finishes its in-flight rows but must
+            # not take on new ones — the graceful-shutdown contract.
             raise AdmissionError("inference engine is shut down")
         prompt = list(prompt)
         if not prompt:
@@ -346,7 +367,20 @@ class InferenceEngine:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         req = Request(prompt, max_new_tokens, request_id=request_id,
                       deadline_s=deadline_s, greedy=greedy)
-        return self.queue.submit(req)
+        self.queue.submit(req)
+        with self._outstanding_lock:
+            self._outstanding = {r for r in self._outstanding
+                                 if not r.done}
+            self._outstanding.add(req)
+        if self._closed:
+            # raced a concurrent close(): its shutdown sweeps may have
+            # already run, and nothing will ever pop this queue — fail
+            # fast instead of stranding the waiter for its full timeout
+            req.cancel()
+            if not req.done:
+                req.finish(error="engine shutting down")
+            raise AdmissionError("inference engine is shut down")
+        return req
 
     # -- engine loop -------------------------------------------------------
 
@@ -355,6 +389,14 @@ class InferenceEngine:
         requests into free slots (prefill on arrival), then advance every
         active slot by one jitted decode step. Returns False when there
         was nothing to do."""
+        if CHAOS.armed is not None and (
+                self.queue.depth()
+                or any(r is not None for r in self._active)):
+            # chaos boundary, hit only on rounds with real work so a
+            # parked loop's idle spins don't consume the fault schedule.
+            # The armed check comes FIRST: disarmed (production) rounds
+            # must not pay the queue-lock probe in the hottest loop
+            CHAOS.hit("engine.step")
         self._reap_cancelled()
         admitted = self._admit()
         stepped = self._decode()
@@ -782,12 +824,44 @@ class InferenceEngine:
                         _REQUESTS.inc(status="error")
                         req.finish(error="engine loop died")
                         self._active[slot] = None
+                # a request popped from the queue but still mid-prefill
+                # when the loop died is in NEITHER structure — without
+                # this sweep its waiter would burn its whole timeout
+                # (found by the chaos soak, seed 23)
+                for req in self._fail_untracked():
+                    _REQUESTS.inc(status="error")
+                    req.finish(error="engine loop died")
                 _BUSY.set(0.0)
 
         self._thread = threading.Thread(
             target=loop, name="inference-engine", daemon=True)
         self._thread.start()
         return self
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown, phase one: stop admitting, let in-flight
+        rows finish, then close. Returns True if everything finished
+        inside ``timeout_s`` (False: close() failed the stragglers with
+        the usual shutdown error). Safe on a synchronous engine only if
+        something else still calls step(); the serving-front mode (loop
+        thread) drains itself."""
+        self._draining = True
+        self.queue.work_available.set()     # wake a parked loop
+        deadline = time.monotonic() + timeout_s
+        drained = False
+        while time.monotonic() < deadline:
+            if self._closed:
+                break           # the loop died; close() cleans up
+            with self._outstanding_lock:
+                self._outstanding = {r for r in self._outstanding
+                                     if not r.done}
+                busy = bool(self._outstanding)
+            if not busy:
+                drained = True
+                break
+            time.sleep(0.01)
+        self.close()
+        return drained
 
     def close(self, timeout: float = 10.0) -> None:
         self._closed = True      # refuse admissions before the loop stops
@@ -804,7 +878,20 @@ class InferenceEngine:
                 _REQUESTS.inc(status="shed")
                 req.finish(error="engine shutting down")
                 self._active[slot] = None
+        for req in self._fail_untracked():
+            _REQUESTS.inc(status="shed")
+            req.finish(error="engine shutting down")
         _BUSY.set(0.0)
+
+    def _fail_untracked(self) -> List[Request]:
+        """Outstanding requests still unfinished after the queue and the
+        slots were swept — the mid-prefill window (popped, not yet
+        slot-resident). Only callable once the loop is stopped/dead:
+        nothing else can finish them concurrently."""
+        with self._outstanding_lock:
+            leftovers = [r for r in self._outstanding if not r.done]
+            self._outstanding.clear()
+        return leftovers
 
     def stats(self) -> EngineStats:
         s = EngineStats(
@@ -1033,6 +1120,9 @@ class PagedInferenceEngine(InferenceEngine):
         # everything device-side below donates the SHARED pool: a failure
         # here poisons every request, not just this one
         try:
+            # chaos boundary: an injected error here is exactly a device
+            # call dying mid-prefill — engine-fatal by construction
+            CHAOS.hit("engine.prefill")
             cache = self._pool_to_prefill(matched)
             suffix_arr = jnp.asarray([suffix], jnp.int32)
             last = None

@@ -16,16 +16,48 @@ import time
 from collections import deque
 from typing import Any, List, Optional, Sequence
 
+from lzy_tpu.chaos.faults import CHAOS
 from lzy_tpu.utils.metrics import REGISTRY
 
 _QUEUE_DEPTH = REGISTRY.gauge(
     "lzy_inference_queue_depth", "requests admitted but not yet prefilled")
 _REJECTED = REGISTRY.counter(
     "lzy_inference_rejected_total", "requests refused at admission")
+#: shared shedding counter (the gateway imports this rather than
+#: re-declaring, so the metric has exactly one owner)
+SHED_REQUESTS = REGISTRY.counter(
+    "lzy_shed_requests_total",
+    "requests shed with a retry-after hint instead of queued, by reason")
 
 
 class AdmissionError(RuntimeError):
-    """The request queue is full; retry later (backpressure, not failure)."""
+    """The request queue is full; retry later (backpressure, not failure).
+
+    ``retry_after_s`` is the load-shedding hint: how long the shedding
+    layer estimates the caller should back off before the resource it
+    was refused (queue space, waiter threads, a routable replica) is
+    likely to exist again. The RPC front folds it into the
+    ``Unavailable`` reply so well-behaved clients retry on the stack's
+    schedule instead of hammering a saturated plane."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+def shed_error(exc_type, msg: str, *, reason: str,
+               retry_after_s: Optional[float] = None):
+    """Build (and count) a load-shedding rejection: the retry-after
+    hint rides both the exception attribute (in-process callers) and
+    the message suffix (it must survive RPC serialization). ONE owner
+    for the wire format — the gateway and the single-engine front both
+    build their rejections here."""
+    SHED_REQUESTS.inc(reason=reason)
+    if retry_after_s is not None:
+        msg = f"{msg} (retry_after_s={retry_after_s:.2f})"
+    err = exc_type(msg)
+    err.retry_after_s = retry_after_s
+    return err
 
 
 _ids = itertools.count(1)
@@ -110,23 +142,55 @@ class Request:
         return list(self.tokens)
 
 
+#: the admission boundary: error mode refuses with the same retryable
+#: AdmissionError a full queue produces (callers shed / try elsewhere)
+_FP_ADMIT = CHAOS.register(
+    "engine.admit", error=AdmissionError,
+    doc="request admission into the engine queue")
+
+
 class RequestQueue:
-    """Bounded FIFO; thread-safe; wakes the engine loop on submit."""
+    """Bounded FIFO; thread-safe; wakes the engine loop on submit.
+
+    The bound is the load-shedding line: past it, ``submit`` rejects
+    with a ``retry_after_s`` hint sized to the queue's recent drain rate
+    instead of growing without bound (overload must surface as fast,
+    cheap rejections — not as unbounded latency for everyone queued)."""
 
     def __init__(self, max_depth: int = 64):
         self.max_depth = max_depth
         self._q: deque = deque()
         self._lock = threading.Lock()
+        # drain-rate estimate for the retry-after hint: EWMA of the
+        # interval between pops (i.e. seconds per admitted request)
+        self._last_pop: Optional[float] = None
+        self._pop_interval_s = 0.05
         #: signalled on submit so an idle engine loop wakes immediately
         self.work_available = threading.Event()
 
+    def _retry_after_locked(self) -> float:
+        """Estimated time until queue space exists — the time to drain
+        half the queue at the recent pop rate, clamped to [0.05s, 10s].
+        Caller holds ``self._lock``."""
+        est = self._pop_interval_s * max(1.0, len(self._q) / 2.0)
+        return min(10.0, max(0.05, est))
+
+    def retry_after_s(self) -> float:
+        with self._lock:
+            return self._retry_after_locked()
+
     def submit(self, request: Request) -> Request:
+        CHAOS.hit("engine.admit")
         with self._lock:
             if len(self._q) >= self.max_depth:
+                # counted as a REJECTION here, as a SHED only where the
+                # refusal is client-facing (the gateway retries other
+                # replicas first — a probe refusal is not a shed request)
                 _REJECTED.inc()
                 raise AdmissionError(
                     f"inference queue full ({self.max_depth} waiting); "
-                    f"retry later")
+                    f"retry later",
+                    retry_after_s=self._retry_after_locked())
             self._q.append(request)
             _QUEUE_DEPTH.set(float(len(self._q)))
         self.work_available.set()
@@ -135,6 +199,16 @@ class RequestQueue:
     def pop(self) -> Optional[Request]:
         with self._lock:
             req = self._q.popleft() if self._q else None
+            if req is not None:
+                now = time.monotonic()
+                if self._last_pop is not None:
+                    dt = now - self._last_pop
+                    self._pop_interval_s += 0.2 * (dt - self._pop_interval_s)
+                # a pop that EMPTIES the queue ends the busy window: the
+                # gap to the next pop would measure idleness, not drain
+                # rate, and one 60s-idle sample would poison the
+                # retry-after hint for the next ~dozen rejections
+                self._last_pop = now if self._q else None
             _QUEUE_DEPTH.set(float(len(self._q)))
             return req
 

@@ -103,12 +103,14 @@ class S3StorageClient(StorageClient):
         progress are the transfer engine's, not botocore defaults.
         ``read_span(offset, length)`` abstracts the source (file or
         in-memory slice)."""
+        from lzy_tpu.chaos.faults import CHAOS
         from lzy_tpu.storage.transfer import _with_retries
 
         bucket, key = self._split(uri)
         total = size
         if total <= config.part_size:
             def put():
+                CHAOS.hit("storage.put")
                 self._s3.put_object(Bucket=bucket, Key=key,
                                     Body=bytes(read_span(0, total)))
                 return total
@@ -128,6 +130,7 @@ class S3StorageClient(StorageClient):
 
             def upload_part(part_no: int, offset: int, length: int) -> dict:
                 def one():
+                    CHAOS.hit("storage.put")
                     resp = self._s3.upload_part(
                         Bucket=bucket, Key=key, UploadId=upload_id,
                         PartNumber=part_no,

@@ -25,12 +25,24 @@ import time
 from concurrent import futures
 from typing import Callable, Optional
 
+from lzy_tpu.chaos.faults import CHAOS
 from lzy_tpu.storage.api import StorageClient
+from lzy_tpu.utils.backoff import RetryPolicy
 from lzy_tpu.utils.log import get_logger
 
 _LOG = get_logger(__name__)
 
 Progress = Callable[[int, int], None]      # (bytes_done, bytes_total)
+
+# chaos boundaries: every retried storage op funnels through
+# _with_retries, so faults injected here exercise the SAME backoff law
+# production failures ride
+_FP_PUT = CHAOS.register(
+    "storage.put", error=IOError,
+    doc="one retried storage write part (multipart part / streaming put)")
+_FP_GET = CHAOS.register(
+    "storage.get", error=IOError,
+    doc="one retried storage read part (ranged get / size probe)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,11 +50,17 @@ class TransferConfig:
     part_size: int = 32 * 1024 * 1024
     max_workers: int = 8
     retries: int = 3                        # attempts per part
-    backoff_s: float = 0.25                 # doubles per retry
+    backoff_s: float = 0.25                 # base window, doubles per retry
+    backoff_cap_s: float = 10.0             # window cap
 
     def __post_init__(self):
         if self.part_size <= 0 or self.max_workers <= 0 or self.retries <= 0:
             raise ValueError("part_size, max_workers, retries must be > 0")
+
+    @property
+    def retry_policy(self) -> RetryPolicy:
+        return RetryPolicy(attempts=self.retries, base_s=self.backoff_s,
+                           cap_s=self.backoff_cap_s)
 
 
 DEFAULT = TransferConfig()
@@ -53,20 +71,15 @@ class TransferError(RuntimeError):
 
 
 def _with_retries(fn, config: TransferConfig, what: str):
-    delay = config.backoff_s
-    last: Optional[BaseException] = None
-    for attempt in range(1, config.retries + 1):
-        try:
-            return fn()
-        except Exception as e:  # noqa: BLE001 — retried, then surfaced
-            last = e
-            if attempt < config.retries:
-                _LOG.warning("%s failed (attempt %d/%d): %r; retrying in "
-                             "%.2fs", what, attempt, config.retries, e, delay)
-                time.sleep(delay)
-                delay *= 2
-    raise TransferError(f"{what} failed after {config.retries} attempts: "
-                        f"{last!r}") from last
+    """Per-part retry under the platform backoff policy (exponential +
+    full jitter, capped — ``utils/backoff.py``); the per-part attempt
+    count stays ``config.retries``. The terminal failure keeps this
+    module's :class:`TransferError` contract."""
+    try:
+        return config.retry_policy.call(fn, what=what)
+    except Exception as e:  # noqa: BLE001 — wrapped, chained
+        raise TransferError(f"{what} failed after {config.retries} "
+                            f"attempts: {e!r}") from e
 
 
 class _ProgressMeter:
@@ -129,6 +142,7 @@ def download(client: StorageClient, uri: str, dest_path: str, *,
 
         def fetch(offset: int, length: int) -> None:
             def one():
+                CHAOS.hit("storage.get")
                 data = client.read_range(uri, offset, length)
                 if len(data) != length:
                     raise TransferError(
@@ -192,6 +206,7 @@ def upload(client: StorageClient, uri: str, src_path: str, *,
             os.close(src_fd)
 
     def stream():
+        CHAOS.hit("storage.put")
         with open(src_path, "rb") as f:
             n = client.write(uri, f)
         meter.advance(total)
@@ -218,6 +233,7 @@ def upload_bytes(client: StorageClient, uri: str, data: bytes, *,
     meter = _ProgressMeter(len(data), progress)
 
     def put():
+        CHAOS.hit("storage.put")
         n = client.write_bytes(uri, data)
         meter.advance(len(data))
         return n

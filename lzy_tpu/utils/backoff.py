@@ -1,0 +1,94 @@
+"""One retry/backoff policy for every degradation path.
+
+Before this module the platform had ~6 hand-rolled retry loops (storage
+transfer parts, the RPC client, native slot pulls, peer sweeps, ...),
+each with its own delay law — some doubling without a cap, none
+jittered. Under correlated failure (a storage blip hitting every part
+of a multipart upload at once) unjittered exponential backoff
+synchronizes the retries into waves that re-overload the recovering
+dependency; the standard fix is **full jitter**: sleep a uniform draw
+from ``[0, min(cap, base * 2^attempt))`` (AWS architecture blog's
+"Exponential Backoff And Jitter"). :class:`RetryPolicy` is that law as
+one frozen object; every retry loop in the tree now delegates to it, so
+chaos tests can assert the degradation behavior of the whole stack by
+testing ONE policy.
+
+Time and randomness are injectable (``sleep=``, ``rng=``) so tests — and
+the chaos harness's seeded replays — are deterministic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable, Optional
+
+from lzy_tpu.utils.log import get_logger
+
+_LOG = get_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with full jitter, capped.
+
+    ``attempts`` counts TOTAL tries (1 = no retry); ``base_s`` is the
+    first window's width, doubling per attempt up to ``cap_s``. With
+    ``jitter=False`` the delay is the window's full width (the legacy
+    deterministic law — kept for callers whose tests pin exact sleeps).
+    """
+
+    attempts: int = 3
+    base_s: float = 0.25
+    cap_s: float = 10.0
+    jitter: bool = True
+
+    def __post_init__(self):
+        if self.attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {self.attempts}")
+        if self.base_s < 0 or self.cap_s < 0:
+            raise ValueError("base_s and cap_s must be >= 0")
+
+    def delay_s(self, attempt: int,
+                rng: Optional[random.Random] = None) -> float:
+        """Sleep before retry number ``attempt`` (1-based: the delay
+        between try N and try N+1)."""
+        window = min(self.cap_s, self.base_s * (2 ** (attempt - 1)))
+        if not self.jitter:
+            return window
+        return (rng or random).uniform(0.0, window)
+
+    def call(self, fn: Callable, *, what: str = "call",
+             retry_if: Optional[Callable[[BaseException], bool]] = None,
+             on_retry: Optional[Callable[[int, BaseException], None]] = None,
+             rng: Optional[random.Random] = None,
+             sleep: Callable[[float], None] = time.sleep):
+        """Run ``fn`` under the policy. ``retry_if(exc)`` gates which
+        failures are retryable (default: any ``Exception``; a
+        ``BaseException`` — injected crash, KeyboardInterrupt — always
+        surfaces immediately). The LAST failure is re-raised unwrapped so
+        callers keep their exception contracts; wrap at the call site if
+        a different terminal type is wanted."""
+        last: Optional[BaseException] = None
+        for attempt in range(1, self.attempts + 1):
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — retried, then surfaced
+                last = e
+                if attempt >= self.attempts or \
+                        (retry_if is not None and not retry_if(e)):
+                    raise
+                delay = self.delay_s(attempt, rng)
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                _LOG.warning("%s failed (attempt %d/%d): %r; retrying in "
+                             "%.2fs", what, attempt, self.attempts, e, delay)
+                if delay > 0:
+                    sleep(delay)
+        raise AssertionError(f"unreachable: {last!r}")
+
+
+#: platform default — what a boundary should use when it has no reason
+#: to pick its own numbers
+DEFAULT = RetryPolicy()

@@ -44,6 +44,10 @@ def registry_metrics():
     import lzy_tpu.gateway.disagg  # noqa: F401
     import lzy_tpu.serving.disagg.decode  # noqa: F401
     import lzy_tpu.serving.disagg.prefill  # noqa: F401
+    # robustness: chaos faults injected, circuit breaker state, shed
+    # requests (lzy_chaos_* / lzy_breaker_* / lzy_shed_*)
+    import lzy_tpu.chaos.faults  # noqa: F401
+    import lzy_tpu.gateway.health  # noqa: F401
     from lzy_tpu.utils.metrics import Counter, Gauge, Histogram, REGISTRY
 
     kinds = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
