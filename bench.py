@@ -452,6 +452,17 @@ def run() -> None:
     if extra:
         detail.update(extra)
         emit()
+    extra = slo_measurement(
+        jax, cfg, params,
+        slots=4 if is_tpu else 2,
+        page_size=64 if is_tpu else 16,
+        long_prompt_len=512 if is_tpu else 96,
+        new_tokens=16 if is_tpu else 6,
+        n_victim=32 if is_tpu else 20,
+        prefill_budget=256 if is_tpu else 32)
+    if extra:
+        detail.update(extra)
+        emit()
     if platform in ("tpu", "axon"):
         # each extra pass builds a whole second model+optimizer: evict the
         # previous one (buffers AND compiled executables) first or OOM
@@ -1071,6 +1082,119 @@ def disagg_measurement(jax, cfg, params, *, decode_replicas: int,
                     stats["reprefill_fallbacks"]}
     except Exception as e:  # noqa: BLE001 — diagnostics only
         _log(f"disagg skipped: {type(e).__name__}: {e}")
+        return {}
+
+
+def _percentile(values, q: float):
+    if not values:
+        return None
+    xs = sorted(values)
+    idx = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[idx]
+
+
+def slo_measurement(jax, cfg, params, *, slots: int, page_size: int,
+                    long_prompt_len: int, new_tokens: int,
+                    n_victim: int, prefill_budget: int):
+    """Multi-tenant SLO isolation point: victim TTFT p99 under a
+    long-prompt aggressor, with the SLO layer (rate limits + KV quota +
+    WFQ + chunked prefill) ON vs OFF on the same paged gateway shape.
+    The bursty two-tenant workload is the ISSUE-7 scenario: aggressor
+    threads hammer 100+-token prompts as fast as admission lets them
+    while the victim issues short interactive prompts; the ON/OFF delta
+    is the number the layer exists for. Wrapped so a hiccup never loses
+    the headline metric."""
+    try:
+        import threading as _threading
+
+        from lzy_tpu.gateway import (
+            GatewayService, PrefixAffinityRouter, ReplicaFleet)
+        from lzy_tpu.serving import (
+            PagedInferenceEngine, QuotaExceeded, SloLimiter, TenantPolicy,
+            TenantTable)
+
+        long_p = max(page_size, long_prompt_len - long_prompt_len
+                     % page_size)
+
+        def run_side(slo_on: bool):
+            table = None
+            if slo_on:
+                table = TenantTable(default=TenantPolicy())
+                table.set_policy(TenantPolicy(
+                    tenant="agg", priority=2, requests_per_s=20.0,
+                    burst_s=0.5, max_queued=2,
+                    kv_block_quota=3 * (long_p // page_size)))
+                table.set_policy(TenantPolicy(tenant="vic", priority=0))
+            fleet = ReplicaFleet(lambda: PagedInferenceEngine(
+                cfg, params, slots=slots, page_size=page_size,
+                max_queue=64, tenants=table,
+                prefill_budget=prefill_budget if slo_on else None,
+            ).start())
+            gw = GatewayService(
+                fleet, router=PrefixAffinityRouter(page_size),
+                model_name="bench", max_waiters=2 * slots + 4,
+                slo=SloLimiter(table) if table is not None else None)
+            rejections = 0
+            try:
+                fleet.add_replica()
+                # warm both shapes (prefill buckets + decode) off-clock
+                gw.generate(list(range(1, long_p + 1)),
+                            max_new_tokens=2, timeout_s=600)
+                gw.generate([2, 3], max_new_tokens=2, timeout_s=600)
+                stop = _threading.Event()
+
+                def aggress(tid):
+                    nonlocal rejections
+                    i = 0
+                    while not stop.is_set():
+                        prompt = [(tid * 31 + 5 * i + j) % 50 + 1
+                                  for j in range(long_p)]
+                        try:
+                            gw.generate(prompt, max_new_tokens=new_tokens,
+                                        timeout_s=600, tenant="agg")
+                        except QuotaExceeded as e:
+                            rejections += 1
+                            time.sleep(min(e.retry_after_s or 0.01, 0.05))
+                        except Exception:  # noqa: BLE001 — keep hammering
+                            time.sleep(0.01)
+                        i += 1
+
+                threads = [_threading.Thread(target=aggress, args=(t,),
+                                             daemon=True)
+                           for t in range(3)]
+                for t in threads:
+                    t.start()
+                time.sleep(0.3)       # let the burst build
+                ttfts = []
+                for i in range(n_victim):
+                    res = gw.generate([7, i % 40 + 2, 9],
+                                      max_new_tokens=new_tokens,
+                                      timeout_s=600, tenant="vic")
+                    if res.get("ttft_ms") is not None:
+                        ttfts.append(res["ttft_ms"])
+                    time.sleep(0.01)  # bursty-interactive cadence
+                stop.set()
+                for t in threads:
+                    t.join(timeout=60)
+            finally:
+                gw.close()
+            return ttfts, rejections
+
+        _log(f"slo: two-tenant burst, long prompt {long_p}, "
+             f"{n_victim} victim probes, budget {prefill_budget}...")
+        on_ttfts, on_rejections = run_side(slo_on=True)
+        off_ttfts, _ = run_side(slo_on=False)
+        p99_on = _percentile(on_ttfts, 0.99)
+        p99_off = _percentile(off_ttfts, 0.99)
+        _log(f"slo: victim TTFT p99 {p99_on} ms (SLO on) vs {p99_off} ms "
+             f"(off); aggressor rejections {on_rejections}")
+        return {"slo_ttft_p99_ms": p99_on,
+                "slo_ttft_p99_ms_unprotected": p99_off,
+                "slo_victim_ttft_p50_ms": _percentile(on_ttfts, 0.5),
+                "slo_aggressor_rejections": on_rejections,
+                "slo_prefill_budget": prefill_budget}
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        _log(f"slo skipped: {type(e).__name__}: {e}")
         return {}
 
 

@@ -161,6 +161,29 @@ def audit_engine(engine) -> None:
             raise InvariantViolation(
                 f"block {b}: {holders} slot holder(s) but refcount "
                 f"{kv.pool._ref[b]}")
+    # staged (mid-prefill) jobs: their blocks are pinned but not yet
+    # slot-resident, their reserved slot must still read as idle (its
+    # page-table row stays scratch until activation — decode rounds
+    # interleaved with the prefill write garbage only to block 0)
+    free_set = set(kv.pool._free)
+    for job in getattr(engine, "_prefill_jobs", ()):
+        if engine._active[job.slot] is not None:
+            raise InvariantViolation(
+                f"prefill job for {job.req.id} reserves slot {job.slot} "
+                f"which is also active")
+        if engine._slot_blocks[job.slot] or any(engine._tables[job.slot]):
+            raise InvariantViolation(
+                f"slot {job.slot} exposes blocks while its prefill job "
+                f"is still staging")
+        for b in job.table:
+            if kv.pool._ref[b] < 1:
+                raise InvariantViolation(
+                    f"prefill job for {job.req.id} holds unreferenced "
+                    f"block {b}")
+            if b in free_set:
+                raise InvariantViolation(
+                    f"prefill job for {job.req.id} holds free-list "
+                    f"block {b}")
 
 
 # -- fleet ------------------------------------------------------------------

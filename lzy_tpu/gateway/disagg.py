@@ -138,7 +138,8 @@ class DisaggGatewayService(GatewayService):
         }
 
     def _pre_submit(self, replica, prompt: List[int],
-                    deadline_s: Optional[float] = None) -> bool:
+                    deadline_s: Optional[float] = None,
+                    tenant: str = "default") -> bool:
         """Parent routing loop's staging hook: probe the decode replica's
         admission gate FIRST — staging KV for a replica that cannot admit
         would waste a whole prefill + transfer and park imported blocks on
@@ -152,13 +153,15 @@ class DisaggGatewayService(GatewayService):
         if getattr(engine, "closed", False) or \
                 engine.queue.depth() >= engine.queue.max_depth:
             return False
-        self._stage_kv(replica, prompt, deadline_s=deadline_s)
+        self._stage_kv(replica, prompt, deadline_s=deadline_s,
+                       tenant=tenant)
         return True
 
     # -- KV staging ----------------------------------------------------------
 
     def _stage_kv(self, replica, prompt: List[int], *,
-                  deadline_s: Optional[float] = None) -> None:
+                  deadline_s: Optional[float] = None,
+                  tenant: str = "default") -> None:
         """Best-effort: land the prompt's whole-block KV prefix on the
         chosen decode replica. Never raises — every failure path means
         the decode engine re-prefills locally."""
@@ -184,7 +187,8 @@ class DisaggGatewayService(GatewayService):
         t0 = time.monotonic()
         try:
             CHAOS.hit("disagg.stage")
-            staged = self._prefill_remote(prompt, deadline_s=deadline_s)
+            staged = self._prefill_remote(prompt, deadline_s=deadline_s,
+                                          tenant=tenant)
         except InjectedFault:
             staged = None        # chaos: staging died -> fallback path
         if staged is None:
@@ -205,7 +209,8 @@ class DisaggGatewayService(GatewayService):
         meta["kv_transfer_ms"] = round(1000 * dt, 3)
 
     def _prefill_remote(self, prompt: List[int], *,
-                        deadline_s: Optional[float] = None):
+                        deadline_s: Optional[float] = None,
+                        tenant: str = "default"):
         """Run the prompt through a prefill replica and pull the export
         over the transport. Returns ``(prefill_replica_id, export)`` or
         None (→ re-prefill fallback). A prefill replica that fails
@@ -240,7 +245,8 @@ class DisaggGatewayService(GatewayService):
                 loads.pop(rid, None)
                 continue
             try:
-                req = replica.engine.submit(prompt, deadline_s=left)
+                req = replica.engine.submit(prompt, deadline_s=left,
+                                            tenant=tenant)
             except AdmissionError:
                 # claimed-but-undispatched probe must not block the
                 # replica for another open_s

@@ -47,6 +47,13 @@ READY = "READY"
 DRAINING = "DRAINING"
 DEAD = "DEAD"
 
+#: per-tenant TERMINAL counters banked on replica retirement and summed
+#: fleet-wide (live fields — queue_depth, kv_blocks — are summed over
+#: live replicas only; they die with the replica)
+_TENANT_COUNTERS = ("requests_finished", "tokens_generated",
+                    "requests_cancelled", "requests_preempted",
+                    "requests_error")
+
 
 @dataclasses.dataclass
 class Replica:
@@ -103,6 +110,9 @@ class ReplicaFleet:
             "prefix_hit_tokens": 0, "prefix_lookup_tokens": 0,
             "spec_proposed_tokens": 0, "spec_accepted_tokens": 0,
             "decode_steps": 0, "decode_rows": 0, "decode_tokens": 0}
+        # per-tenant twin of the banked totals (terminal counters only —
+        # live gauges like queue depth die with the replica)
+        self._retired_tenants: Dict[str, Dict[str, int]] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -237,6 +247,14 @@ class ReplicaFleet:
                         kv.hit_tokens
                     self._retired_totals["prefix_lookup_tokens"] += \
                         kv.lookup_tokens
+                by_tenant = getattr(replica.engine, "stats_by_tenant",
+                                    None)
+                if by_tenant is not None:
+                    for tenant, row in by_tenant().items():
+                        bank = self._retired_tenants.setdefault(
+                            tenant, {k: 0 for k in _TENANT_COUNTERS})
+                        for key in _TENANT_COUNTERS:
+                            bank[key] += int(row.get(key, 0))
         except Exception:  # noqa: BLE001 — stats from a dying engine
             pass
         try:
@@ -327,6 +345,23 @@ class ReplicaFleet:
                 agg["prefix_hit_tokens"] += kv.hit_tokens
                 agg["prefix_lookup_tokens"] += kv.lookup_tokens
         return agg
+
+    def aggregate_tenants(self) -> Dict[str, Dict[str, int]]:
+        """Fleet-level per-tenant sums (terminal counters stay MONOTONIC
+        across retirements via the banked totals; queue depth and KV
+        blocks are live sums over READY+DRAINING replicas)."""
+        with self._lock:
+            out = {t: dict(row) for t, row in self._retired_tenants.items()}
+        for replica in self.replicas() + self.replicas(state=DRAINING):
+            by_tenant = getattr(replica.engine, "stats_by_tenant", None)
+            if by_tenant is None:
+                continue
+            for tenant, row in by_tenant().items():
+                agg = out.setdefault(
+                    tenant, {k: 0 for k in _TENANT_COUNTERS})
+                for key, value in row.items():
+                    agg[key] = agg.get(key, 0) + int(value)
+        return out
 
     def _update_gauges(self) -> None:
         with self._lock:

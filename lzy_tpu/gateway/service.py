@@ -33,7 +33,8 @@ from lzy_tpu.gateway.autoscale import DOWN, UP, Autoscaler
 from lzy_tpu.gateway.fleet import ReplicaFleet
 from lzy_tpu.gateway.router import PrefixAffinityRouter
 from lzy_tpu.serving.scheduler import (
-    AdmissionError, any_to_tokens, shed_error)
+    AdmissionError, DEFAULT_TENANT, PromptTooLong, QuotaExceeded,
+    any_to_tokens, quota_error, shed_error)
 from lzy_tpu.utils.log import get_logger
 from lzy_tpu.utils.metrics import REGISTRY
 
@@ -78,6 +79,7 @@ class GatewayService:
         max_waiters: int = 16,
         max_failovers: int = 3,
         tick_period_s: float = 1.0,
+        slo=None,
     ):
         self.fleet = fleet
         self.router = router if router is not None else PrefixAffinityRouter(
@@ -85,6 +87,11 @@ class GatewayService:
         self.autoscaler = autoscaler
         self.model_name = model_name
         self.iam = iam                 # harness wires the cluster's IAM in
+        #: tenant SLO enforcement (serving.tenancy.SloLimiter): token-
+        #: bucket rate limits charged HERE — once per client request, at
+        #: the fleet front — while WFQ/quotas live in the engines (per
+        #: replica). None = unlimited (the single-tenant default).
+        self.slo = slo
         self._max_failovers = max_failovers
         self._tick_period_s = tick_period_s
         self._waiters = threading.BoundedSemaphore(max_waiters)
@@ -105,15 +112,75 @@ class GatewayService:
 
     # -- request surface -----------------------------------------------------
 
-    def _auth(self, token: Optional[str]) -> None:
+    def _auth(self, token: Optional[str]):
+        """Authenticate and return the Subject (None when no IAM is
+        wired — the single-tenant operator plane)."""
         if self.iam is not None:
-            self.iam.authenticate(token)
+            return self.iam.authenticate(token)
+        return None
+
+    def _resolve_tenant(self, subject, tenant: Optional[str]) -> str:
+        """Tenant identity: the authenticated subject id when IAM is on
+        (the wire field may only restate it — or be used by the
+        operator's INTERNAL role to act on a tenant's behalf); the wire
+        field, else the default tenant, on an IAM-less plane."""
+        if subject is None:
+            return tenant or DEFAULT_TENANT
+        if tenant and tenant != subject.id:
+            from lzy_tpu.iam import INTERNAL, AuthError
+
+            if subject.role != INTERNAL:
+                raise AuthError(
+                    f"subject {subject.id} may not submit as tenant "
+                    f"{tenant!r}")
+            return tenant
+        return subject.id
+
+    def _slo_admit(self, tenant: str, prompt: List[int]):
+        """Charge the tenant's rate buckets (and resolve its priority
+        floor); QuotaExceeded propagates with the per-tenant retry hint
+        — counted as a shed, since no replica was ever tried."""
+        if self.slo is None:
+            return None
+        try:
+            return self.slo.admit(tenant, len(prompt))
+        except QuotaExceeded:
+            with self._lock:
+                self._shed += 1
+            raise
+
+    def _max_seq_len(self) -> Optional[int]:
+        """The fleet's model window, read off any live replica (replicas
+        are homogeneous); None while the fleet is empty — the engine's
+        own admission check then covers it."""
+        for state in ("READY", "DRAINING"):
+            for replica in self.fleet.replicas(state=state):
+                cfg = getattr(replica.engine, "cfg", None)
+                if cfg is not None:
+                    return int(cfg.max_seq_len)
+        return None
+
+    def _check_prompt_len(self, prompt: List[int],
+                          max_new_tokens: int) -> None:
+        """Admission-time rejection of prompts no replica can ever serve
+        — BEFORE routing, so the request costs no replica an admission
+        probe, no disagg plane a staged prefill, and no health tracker a
+        bogus failure."""
+        msl = self._max_seq_len()
+        if msl is not None and len(prompt) + max_new_tokens > msl:
+            raise PromptTooLong(
+                f"prompt ({len(prompt)} tokens) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len ({msl}); the "
+                f"prompt can never be served — shorten it or reduce "
+                f"max_new_tokens")
 
     def generate(self, prompt, *, max_new_tokens: int = 64,
                  token: Optional[str] = None,
                  timeout_s: Optional[float] = None,
                  deadline_s: Optional[float] = None,
-                 greedy: Optional[bool] = None) -> dict:
+                 greedy: Optional[bool] = None,
+                 tenant: Optional[str] = None,
+                 priority: Optional[int] = None) -> dict:
         """Blocking generate over the fleet; same contract as the single
         engine's RPC surface plus route metadata (``replica``,
         ``routed_by``, ``failovers``) in the reply. Backpressure is
@@ -121,10 +188,19 @@ class GatewayService:
         does the caller see ``Unavailable``. ``greedy`` is the
         per-request sampling override, carried across failover
         resubmissions (a greedy stream must stay greedy — and therefore
-        deterministic — on the retry replica too)."""
-        self._auth(token)
+        deterministic — on the retry replica too). ``tenant``/``priority``
+        are the SLO identity (docstring of :meth:`_resolve_tenant`);
+        tenant-scoped refusals raise ``QuotaExceeded`` with a per-tenant
+        ``retry_after_s``."""
+        subject = self._auth(token)
         from lzy_tpu.rpc.core import Unavailable
 
+        tenant = self._resolve_tenant(subject, tenant)
+        prompt = any_to_tokens(prompt)
+        self._check_prompt_len(prompt, int(max_new_tokens))
+        policy = self._slo_admit(tenant, prompt)
+        if policy is not None:
+            priority = policy.effective_priority(priority)
         if self._draining:
             raise self._shed_error(
                 Unavailable, "gateway is draining; retry another endpoint",
@@ -137,11 +213,13 @@ class GatewayService:
         with self._lock:
             self._inflight += 1
         try:
-            return self._generate(any_to_tokens(prompt),
+            return self._generate(prompt,
                                   int(max_new_tokens),
                                   timeout_s=timeout_s or 120.0,
                                   deadline_s=deadline_s,
-                                  greedy=greedy)
+                                  greedy=greedy,
+                                  tenant=tenant,
+                                  priority=priority)
         finally:
             with self._lock:
                 self._inflight -= 1
@@ -158,7 +236,9 @@ class GatewayService:
 
     def _generate(self, prompt: List[int], max_new_tokens: int, *,
                   timeout_s: float, deadline_s: Optional[float],
-                  greedy: Optional[bool] = None) -> dict:
+                  greedy: Optional[bool] = None,
+                  tenant: str = DEFAULT_TENANT,
+                  priority: Optional[int] = None) -> dict:
         from lzy_tpu.rpc.core import Unavailable
 
         t0 = time.monotonic()
@@ -196,7 +276,8 @@ class GatewayService:
             replica, routed_by, req = self._submit_routed(
                 effective_prompt, remaining,
                 t0=t0, deadline_s=deadline_s,
-                exclude=tried_after_failure, greedy=greedy)
+                exclude=tried_after_failure, greedy=greedy,
+                tenant=tenant, priority=priority)
             route = (replica.id, routed_by)
             if not req.wait(timeout=max(0.0,
                                         wall_deadline - time.monotonic())):
@@ -303,7 +384,9 @@ class GatewayService:
 
     def _submit_routed(self, prompt: List[int], max_new_tokens: int, *,
                        t0: float, deadline_s: Optional[float],
-                       exclude: set, greedy: Optional[bool] = None):
+                       exclude: set, greedy: Optional[bool] = None,
+                       tenant: str = DEFAULT_TENANT,
+                       priority: Optional[int] = None):
         """Route + submit with per-replica admission fallback: a replica
         refusing admission (full queue, closed engine) drops out of the
         candidate set and the next-best one is tried; only an empty set
@@ -328,7 +411,8 @@ class GatewayService:
                 continue
             if not self._pre_submit(
                     replica, prompt,
-                    deadline_s=self._remaining_deadline(t0, deadline_s)):
+                    deadline_s=self._remaining_deadline(t0, deadline_s),
+                    tenant=tenant):
                 # claimed but never dispatched: release, or the replica
                 # would sit probe-blocked for another open_s
                 self.fleet.health.release_probe(rid)
@@ -344,15 +428,21 @@ class GatewayService:
                 CHAOS.hit("gateway.dispatch")
                 req = replica.engine.submit(
                     prompt, max_new_tokens=max_new_tokens,
-                    deadline_s=engine_deadline, greedy=greedy)
+                    deadline_s=engine_deadline, greedy=greedy,
+                    tenant=tenant, priority=priority)
+            except PromptTooLong:
+                # permanent, request-scoped: it would fail identically
+                # on every replica — no fallback, no health damage
+                self.fleet.health.release_probe(rid)
+                raise
             except AdmissionError as e:
                 last_err = e
                 self.fleet.health.release_probe(rid)
                 loads.pop(rid, None)
                 continue
             except BaseException:
-                # request-scoped failures (over-long prompt) propagate
-                # to the client, but nothing was dispatched — the probe
+                # request-scoped failures (invalid args) propagate to
+                # the client, but nothing was dispatched — the probe
                 # claim must not outlive the attempt
                 self.fleet.health.release_probe(rid)
                 raise
@@ -365,6 +455,18 @@ class GatewayService:
         retry_after = getattr(last_err, "retry_after_s", None)
         if retry_after is None:
             retry_after = self.fleet.breaker_retry_after_s()
+        if isinstance(last_err, QuotaExceeded):
+            # every replica refused on a TENANT limit (per-tenant queue
+            # caps): surface the quota-exceeded status, not a generic
+            # Unavailable, so the client backs off on its own clock
+            with self._lock:
+                self._shed += 1
+            raise quota_error(
+                f"tenant {last_err.tenant!r} over its queue cap on every "
+                f"replica: {last_err}",
+                tenant=last_err.tenant or tenant,
+                reason=last_err.reason or "max_queued",
+                retry_after_s=retry_after)
         raise self._shed_error(
             Unavailable,
             f"no replica can admit the request: "
@@ -372,12 +474,14 @@ class GatewayService:
             reason="no_replica", retry_after_s=retry_after)
 
     def _pre_submit(self, replica, prompt: List[int],
-                    deadline_s: Optional[float] = None) -> bool:
+                    deadline_s: Optional[float] = None,
+                    tenant: str = DEFAULT_TENANT) -> bool:
         """Hook between routing and submission; False drops the replica
         from this request's candidate set. Subclasses use it for
         per-replica staging work that must not be wasted on a replica
         that cannot admit (the disagg gateway probes the queue and then
-        stages KV here — bounded by the request's REMAINING deadline)."""
+        stages KV here — bounded by the request's REMAINING deadline,
+        queued under the request's tenant)."""
         return True
 
     def _reply_extras(self) -> dict:
@@ -509,10 +613,36 @@ class GatewayService:
 
     # -- observability -------------------------------------------------------
 
+    def _operator_view(self, subject) -> bool:
+        """Stats scoping: no IAM (operator tool) and the INTERNAL role
+        see the fleet; every other subject sees only its own tenant."""
+        if subject is None:
+            return True
+        from lzy_tpu.iam import INTERNAL
+
+        return subject.role == INTERNAL
+
+    def _tenant_scoped_stats(self, tenant: str) -> dict:
+        """One tenant's own counters — what a non-operator subject gets
+        from ``InferStats`` (fleet internals are the operator's; a
+        tenant's numbers are its own)."""
+        rows = self.fleet.aggregate_tenants()
+        row = rows.get(tenant, {
+            "requests_finished": 0, "tokens_generated": 0,
+            "requests_cancelled": 0, "requests_preempted": 0,
+            "requests_error": 0, "queue_depth": 0})
+        return {"model": self.model_name, "gateway": True,
+                "tenant": tenant, **row}
+
     def stats(self, *, token: Optional[str] = None) -> dict:
         """Fleet-level ``InferStats`` doc: aggregates + routing + scaling
-        counters. Per-replica breakdown lives in :meth:`fleet_stats`."""
-        self._auth(token)
+        counters plus the per-tenant breakdown — for the operator (no
+        IAM, or the INTERNAL role). Any other authenticated subject gets
+        only its own tenant's counters (:meth:`_tenant_scoped_stats`).
+        Per-replica breakdown lives in :meth:`fleet_stats`."""
+        subject = self._auth(token)
+        if not self._operator_view(subject):
+            return self._tenant_scoped_stats(subject.id)
         agg = self.fleet.aggregate()
         routing = self.router.stats()
         hit_rate = 0.0
@@ -555,11 +685,21 @@ class GatewayService:
             "spec_accepted_tokens": agg["spec_accepted_tokens"],
             "spec_acceptance_rate": round(spec_rate, 4),
             "spec_tokens_per_step": round(spec_tps, 4),
+            # per-tenant breakdown (operator view only — this branch)
+            "tenants": self.fleet.aggregate_tenants(),
         }
 
     def fleet_stats(self, *, token: Optional[str] = None) -> dict:
-        """Per-replica breakdown (engine stats + lease + health)."""
-        self._auth(token)
+        """Per-replica breakdown (engine stats + lease + health);
+        operator-only under IAM — replica internals are not tenant
+        data."""
+        subject = self._auth(token)
+        if not self._operator_view(subject):
+            from lzy_tpu.iam import AuthError
+
+            raise AuthError(
+                "fleet stats are operator-only (INTERNAL role); tenants "
+                "read their own counters from InferStats")
         rows = []
         for state in ("READY", "DRAINING"):
             for replica in self.fleet.replicas(state=state):

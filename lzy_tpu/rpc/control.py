@@ -346,6 +346,8 @@ class ControlPlaneServer:
                     timeout_s=p.get("timeout_s"),
                     deadline_s=p.get("deadline_s"),
                     greedy=p.get("greedy"),
+                    tenant=p.get("tenant"),
+                    priority=p.get("priority"),
                     token=p.get("token")),
                 "InferStats": lambda p: _infer_svc().stats(
                     token=p.get("token")),
@@ -760,7 +762,9 @@ class RpcInferenceClient:
     def generate(self, prompt, *, max_new_tokens: int = 64,
                  timeout_s: Optional[float] = None,
                  deadline_s: Optional[float] = None,
-                 greedy: Optional[bool] = None) -> dict:
+                 greedy: Optional[bool] = None,
+                 tenant: Optional[str] = None,
+                 priority: Optional[int] = None) -> dict:
         """``prompt``: list of token ids. Returns ``{"request_id",
         "tokens", "status", "ttft_ms", "model"}`` (generated ids only, no
         echo). ``deadline_s`` is the engine-side client deadline: past it
@@ -768,7 +772,11 @@ class RpcInferenceClient:
         ``status: "cancelled"`` with the tokens generated so far.
         ``greedy=True`` forces argmax decoding for this request on a
         sampling plane (and with it speculative-decoding eligibility
-        under ``--serve-spec``); None follows the server's setting."""
+        under ``--serve-spec``); None follows the server's setting.
+        ``tenant``/``priority``: SLO identity (see the wire-schema note —
+        under IAM the tenant is the bearer token's subject, and the
+        field may only restate it). Tenant-scoped refusals raise
+        ``serving.scheduler.QuotaExceeded`` with ``retry_after_s``."""
         rpc_timeout = (timeout_s or 120.0) + 30.0   # server waits first
         return self._client.call("InferGenerate", {
             "prompt": list(prompt),
@@ -776,6 +784,8 @@ class RpcInferenceClient:
             "timeout_s": timeout_s,
             "deadline_s": deadline_s,
             "greedy": greedy,
+            "tenant": tenant,
+            "priority": priority,
             "token": _token_value(self._token),
         }, timeout_s=rpc_timeout)
 

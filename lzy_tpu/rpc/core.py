@@ -37,9 +37,17 @@ class Unavailable(ConnectionError):
 
 def _codes(e: BaseException) -> grpc.StatusCode:
     from lzy_tpu.iam import AuthError
+    from lzy_tpu.serving.scheduler import QuotaExceeded
 
     if isinstance(e, AuthError):
         return grpc.StatusCode.PERMISSION_DENIED
+    if isinstance(e, QuotaExceeded):
+        # tenant-scoped SLO refusal (rate limit / queue cap / KV quota):
+        # RESOURCE_EXHAUSTED, not UNAVAILABLE — the *plane* has capacity,
+        # the *tenant* is over its share; the retry_after_s hint rides
+        # the message (checked before Unavailable/ValueError: the
+        # related admission types must not shadow the quota status)
+        return grpc.StatusCode.RESOURCE_EXHAUSTED
     if isinstance(e, Unavailable):
         return grpc.StatusCode.UNAVAILABLE
     if isinstance(e, KeyError):
@@ -173,6 +181,16 @@ def _to_exception(e: grpc.RpcError) -> BaseException:
         from lzy_tpu.iam import AuthError
 
         return AuthError(detail)
+    if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+        import re as _re
+
+        from lzy_tpu.serving.scheduler import QuotaExceeded
+
+        # the per-tenant retry hint survives serialization in the
+        # message suffix; re-hydrate the attribute for typed callers
+        m = _re.search(r"retry_after_s=([0-9.]+)", detail)
+        return QuotaExceeded(
+            detail, retry_after_s=float(m.group(1)) if m else None)
     if code == grpc.StatusCode.UNAVAILABLE:
         return Unavailable(detail)
     if code == grpc.StatusCode.NOT_FOUND:

@@ -283,13 +283,24 @@ REQUESTS: Dict[str, Schema] = {
     # (proto3 rule). "greedy" is the per-request sampling override
     # (true → argmax decoding for this request even on a sampling
     # engine, which also makes it eligible for speculative decoding
-    # under serve.py --serve-spec; absent/null → engine default)
+    # under serve.py --serve-spec; absent/null → engine default).
+    # "tenant"/"priority" are the multi-tenant SLO identity: with IAM on,
+    # the tenant IS the authenticated subject (the field may only restate
+    # it, except for the operator's INTERNAL role acting on a tenant's
+    # behalf); without IAM the field is trusted. "priority" may only
+    # DOWNGRADE below the tenant's policy tier. Tenant-scoped refusals
+    # (rate limit, queue cap, KV quota) come back as RESOURCE_EXHAUSTED
+    # with a per-tenant retry_after_s in the message; prompts that can
+    # never be served (prompt + max_new_tokens > max_seq_len) as
+    # INVALID_ARGUMENT at admission.
     "InferGenerate": Schema("InferGenerateRequest", {
         "prompt": f(list, required=True),
         "max_new_tokens": f(int),
         "timeout_s": f(float, int),
         "deadline_s": f(float, int),
-        "greedy": f(bool), **_TOKEN}),
+        "greedy": f(bool),
+        "tenant": f(str),
+        "priority": f(int), **_TOKEN}),
     "InferStats": Schema("InferStatsRequest", {**_TOKEN}),
     # gateway-only: per-replica fleet breakdown (serve.py --gateway). On
     # a disaggregated plane each row carries "pool" ("prefill"|"decode")

@@ -17,7 +17,9 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
-from lzy_tpu.serving.scheduler import AdmissionError, any_to_tokens
+from lzy_tpu.serving.scheduler import (
+    AdmissionError, DEFAULT_TENANT, PromptTooLong, QuotaExceeded,
+    any_to_tokens)
 from lzy_tpu.utils.log import get_logger
 
 _LOG = get_logger(__name__)
@@ -35,26 +37,47 @@ class InferenceService:
     workflow surface (16 threads), and without a cap a burst of generate
     calls parked in ``req.result()`` would starve worker heartbeats and
     task RPCs on the same port. Beyond the cap, callers get the same
-    ``Unavailable`` backpressure a full queue produces."""
+    ``Unavailable`` backpressure a full queue produces.
+
+    ``slo`` (``serving.tenancy.SloLimiter``) enforces per-tenant rate
+    limits at this front; the tenant itself is the authenticated IAM
+    subject (or the wire-supplied tenant on an IAM-less plane)."""
 
     def __init__(self, engine, model_name: str = "custom", iam=None,
-                 max_waiters: int = 8):
+                 max_waiters: int = 8, slo=None):
         import threading
 
         self.engine = engine
         self.model_name = model_name
         self.iam = iam        # harness wires the cluster's IAM in here
+        self.slo = slo
         self._waiters = threading.BoundedSemaphore(max_waiters)
 
-    def _auth(self, token: Optional[str]) -> None:
+    def _auth(self, token: Optional[str]):
         if self.iam is not None:
-            self.iam.authenticate(token)
+            return self.iam.authenticate(token)
+        return None
+
+    def _resolve_tenant(self, subject, tenant: Optional[str]) -> str:
+        if subject is None:
+            return tenant or DEFAULT_TENANT
+        if tenant and tenant != subject.id:
+            from lzy_tpu.iam import INTERNAL, AuthError
+
+            if subject.role != INTERNAL:
+                raise AuthError(
+                    f"subject {subject.id} may not submit as tenant "
+                    f"{tenant!r}")
+            return tenant
+        return subject.id
 
     def generate(self, prompt, *, max_new_tokens: int = 64,
                  token: Optional[str] = None,
                  timeout_s: Optional[float] = None,
                  deadline_s: Optional[float] = None,
-                 greedy: Optional[bool] = None) -> dict:
+                 greedy: Optional[bool] = None,
+                 tenant: Optional[str] = None,
+                 priority: Optional[int] = None) -> dict:
         """Blocking generate: admit, wait, return generated token ids.
         Backpressure (full queue OR all waiter threads busy) surfaces as
         ``Unavailable`` BEFORE any work happens — safe for the caller to
@@ -65,20 +88,46 @@ class InferenceService:
         the call RETURNS (not raises) with ``status: "cancelled"`` and
         whatever tokens were generated before the eviction. ``greedy``
         is the per-request sampling override (True forces argmax — and
-        speculation eligibility — on a sampling engine)."""
-        self._auth(token)
+        speculation eligibility — on a sampling engine).
+        ``tenant``/``priority``: the SLO identity (IAM subject id wins
+        when IAM is wired); tenant-scoped refusals raise
+        ``QuotaExceeded`` (RESOURCE_EXHAUSTED on the wire) with a
+        per-tenant ``retry_after_s``; over-long prompts raise
+        ``PromptTooLong`` (INVALID_ARGUMENT) at admission."""
+        subject = self._auth(token)
         from lzy_tpu.rpc.core import Unavailable
 
+        tenant = self._resolve_tenant(subject, tenant)
+        prompt = any_to_tokens(prompt)
+        if self.slo is not None:
+            policy = self.slo.admit(tenant, len(prompt))
+            priority = policy.effective_priority(priority)
         if not self._waiters.acquire(blocking=False):
             raise Unavailable(
                 "all inference waiter threads are busy; retry later")
         try:
             try:
                 req = self.engine.submit(
-                    any_to_tokens(prompt),
+                    prompt,
                     max_new_tokens=int(max_new_tokens),
                     deadline_s=deadline_s,
-                    greedy=greedy)
+                    greedy=greedy,
+                    tenant=tenant,
+                    priority=priority)
+            except PromptTooLong:
+                # permanent rejection keeps its INVALID_ARGUMENT wire
+                # status — not the generic capacity Unavailable below
+                raise
+            except QuotaExceeded as e:
+                # the engine queue raises these UNCOUNTED (the gateway
+                # retries other replicas; a probe refusal is not a shed)
+                # — here there is no other replica, so the refusal is
+                # client-facing and counts. Wire status stays
+                # RESOURCE_EXHAUSTED with the per-tenant retry hint.
+                from lzy_tpu.serving.scheduler import count_tenant_shed
+
+                count_tenant_shed(e)
+                raise
             except AdmissionError as e:
                 # client-facing shed (single-engine plane: no other
                 # replica to try); shed_error owns the hint's wire format
@@ -106,8 +155,23 @@ class InferenceService:
                 "ttft_ms": ttft_ms, "model": self.model_name}
 
     def stats(self, *, token: Optional[str] = None) -> dict:
-        self._auth(token)
-        return {"model": self.model_name, **self.engine.stats().doc()}
+        """Engine stats. Scoped per subject: the operator (no IAM, or
+        the INTERNAL role) sees engine internals plus every tenant's
+        counters; any other subject sees only its own tenant's row."""
+        subject = self._auth(token)
+        if subject is not None:
+            from lzy_tpu.iam import INTERNAL
+
+            if subject.role != INTERNAL:
+                rows = self.engine.stats_by_tenant()
+                row = rows.get(subject.id, {
+                    "requests_finished": 0, "tokens_generated": 0,
+                    "requests_cancelled": 0, "requests_preempted": 0,
+                    "requests_error": 0, "queue_depth": 0})
+                return {"model": self.model_name, "tenant": subject.id,
+                        **row}
+        return {"model": self.model_name, **self.engine.stats().doc(),
+                "tenants": self.engine.stats_by_tenant()}
 
     def drain(self, timeout_s: float = 30.0) -> bool:
         """Graceful shutdown: stop admitting, finish in-flight rows,
@@ -164,6 +228,8 @@ def build_gateway_service(
     spec_tokens: int = 0,
     warm_start: bool = False,
     start: bool = True,
+    prefill_budget: Optional[int] = None,
+    tenants=None,
 ):
     """Construct the serving fleet gateway (``serve.py --gateway``): N
     engine replicas behind one ``InferGenerate`` endpoint with
@@ -177,7 +243,11 @@ def build_gateway_service(
     fleet unleased, plain threads). ``spec_tokens`` > 0 enables
     draft-free speculative decoding on every replica (``--serve-spec``);
     ``warm_start`` AOT-compiles each replica's decode/verify programs at
-    boot instead of on the first request.
+    boot instead of on the first request. ``prefill_budget`` bounds
+    prefill tokens per engine step (chunked-prefill interleaving);
+    ``tenants`` (a ``serving.tenancy.TenantTable``) turns on the
+    multi-tenant SLO layer: token-bucket rate limits at the gateway,
+    WFQ + per-tenant queue caps + KV quotas in every replica.
     """
     from lzy_tpu.gateway import (
         Autoscaler, GatewayService, PrefixAffinityRouter, ReplicaFleet,
@@ -192,7 +262,8 @@ def build_gateway_service(
                                       seed=seed)
     common = dict(slots=slots, max_queue=max_queue, eos_token=eos_token,
                   prefill_chunk=prefill_chunk, seed=seed,
-                  spec_tokens=spec_tokens)
+                  spec_tokens=spec_tokens, prefill_budget=prefill_budget,
+                  tenants=tenants)
 
     def engine_factory():
         if paged:
@@ -214,11 +285,17 @@ def build_gateway_service(
         autoscaler = Autoscaler(
             min_replicas=min_replicas or replicas,
             max_replicas=max_replicas or 2 * replicas)
+    slo = None
+    if tenants is not None:
+        from lzy_tpu.serving.tenancy import SloLimiter
+
+        slo = SloLimiter(tenants)
     service = GatewayService(
         fleet,
         router=router_cls(page_size if paged else prefill_chunk),
         autoscaler=autoscaler,
         model_name=model,
+        slo=slo,
     )
     try:
         for _ in range(replicas):
@@ -254,6 +331,8 @@ def build_disagg_gateway_service(
     spec_tokens: int = 0,
     warm_start: bool = False,
     start: bool = True,
+    prefill_budget: Optional[int] = None,
+    tenants=None,
 ):
     """Construct the disaggregated serving gateway (``serve.py --disagg``):
     a pool of ``prefill_replicas`` :class:`~lzy_tpu.serving.PrefillEngine`
@@ -282,7 +361,8 @@ def build_disagg_gateway_service(
                                       seed=seed)
     common = dict(slots=slots, max_queue=max_queue,
                   prefill_chunk=prefill_chunk, seed=seed,
-                  page_size=page_size, kv_blocks=kv_blocks)
+                  page_size=page_size, kv_blocks=kv_blocks,
+                  prefill_budget=prefill_budget, tenants=tenants)
 
     def decode_factory():
         engine = DecodeEngine(cfg, params, eos_token=eos_token,
@@ -309,6 +389,11 @@ def build_disagg_gateway_service(
         autoscaler = Autoscaler(
             min_replicas=min_replicas or decode_replicas,
             max_replicas=max_replicas or 2 * decode_replicas)
+    slo = None
+    if tenants is not None:
+        from lzy_tpu.serving.tenancy import SloLimiter
+
+        slo = SloLimiter(tenants)
     service = DisaggGatewayService(
         decode_fleet,
         prefill_fleet,
@@ -319,6 +404,7 @@ def build_disagg_gateway_service(
         transport=transport,
         prefill_replicas=prefill_replicas,
         model_name=model,
+        slo=slo,
     )
     try:
         for _ in range(decode_replicas):
@@ -348,6 +434,8 @@ def build_inference_service(
     spec_tokens: int = 0,
     warm_start: bool = False,
     start: bool = True,
+    prefill_budget: Optional[int] = None,
+    tenants=None,
 ) -> InferenceService:
     """Construct the engine for a named config and wrap it for RPC.
 
@@ -369,6 +457,13 @@ def build_inference_service(
     combined with the persistent XLA compilation cache (``serve.py``
     enables it) a restarted server answers its first request without
     paying a fresh compile on TTFT.
+
+    ``prefill_budget`` bounds prompt tokens prefilled per engine round
+    (chunked-prefill interleaving — long prompts cannot starve resident
+    rows); ``tenants`` (a ``serving.tenancy.TenantTable``) turns on the
+    multi-tenant SLO layer: rate limits at this front, WFQ + queue caps
+    + KV quotas in the engine (docs/serving.md "Multi-tenant SLO
+    serving").
     """
     from lzy_tpu.serving import InferenceEngine, PagedInferenceEngine
 
@@ -376,7 +471,8 @@ def build_inference_service(
                                       seed=seed)
     common = dict(slots=slots, max_queue=max_queue, eos_token=eos_token,
                   prefill_chunk=prefill_chunk, seed=seed,
-                  spec_tokens=spec_tokens)
+                  spec_tokens=spec_tokens, prefill_budget=prefill_budget,
+                  tenants=tenants)
     if paged:
         engine: InferenceEngine = PagedInferenceEngine(
             cfg, params, page_size=page_size, kv_blocks=kv_blocks, **common)
@@ -386,4 +482,9 @@ def build_inference_service(
         engine.warmup()
     if start:
         engine.start()
-    return InferenceService(engine, model_name=model)
+    slo = None
+    if tenants is not None:
+        from lzy_tpu.serving.tenancy import SloLimiter
+
+        slo = SloLimiter(tenants)
+    return InferenceService(engine, model_name=model, slo=slo)

@@ -116,6 +116,40 @@ def main(argv=None) -> int:
     parser.add_argument("--spec-tokens", type=int, default=4,
                         help="max draft tokens per verify step under "
                              "--serve-spec (gamma)")
+    parser.add_argument("--serve-prefill-budget", type=int, default=256,
+                        help="max prompt tokens prefilled per engine "
+                             "scheduling round (chunked-prefill "
+                             "interleaving: long prompts advance in "
+                             "bounded chunks BETWEEN decode steps so "
+                             "they cannot starve resident requests' "
+                             "token streams; 0 runs each prompt's "
+                             "prefill in one round)")
+    parser.add_argument("--serve-slo", action="store_true",
+                        help="multi-tenant SLO enforcement: per-tenant "
+                             "token-bucket rate limits and KV quotas at "
+                             "admission, weighted fair queueing by "
+                             "priority tier (tenant = IAM subject under "
+                             "--with-iam; docs/serving.md 'Multi-tenant "
+                             "SLO serving'). Implied by any --tenant-* "
+                             "flag")
+    parser.add_argument("--tenant-rps", type=float, default=None,
+                        help="default per-tenant requests/s limit")
+    parser.add_argument("--tenant-tps", type=float, default=None,
+                        help="default per-tenant prompt-tokens/s limit")
+    parser.add_argument("--tenant-kv-quota", type=int, default=None,
+                        help="default per-tenant KV-block quota per "
+                             "replica (paged engines)")
+    parser.add_argument("--tenant-max-queued", type=int, default=None,
+                        help="default per-tenant admission-queue cap per "
+                             "replica")
+    parser.add_argument("--tenant-burst-s", type=float, default=2.0,
+                        help="token-bucket burst window (bucket capacity "
+                             "= rate * burst)")
+    parser.add_argument("--tenant-policies", default=None,
+                        help="JSON file of per-tenant policy overrides: "
+                             "{tenant: {priority, weight, requests_per_s, "
+                             "prompt_tokens_per_s, kv_block_quota, "
+                             "max_queued, burst_s}}")
     parser.add_argument("--drain-timeout-s", type=float, default=30.0,
                         help="graceful-shutdown budget on SIGTERM/SIGINT: "
                              "the serving plane stops admitting, finishes "
@@ -173,6 +207,29 @@ def main(argv=None) -> int:
 
     warm_start = bool(args.serve_model) and not args.no_warm_start
     spec_tokens = args.spec_tokens if args.serve_spec else 0
+    prefill_budget = args.serve_prefill_budget or None
+    tenants = None
+    slo_on = args.serve_slo or any(
+        v is not None for v in (args.tenant_rps, args.tenant_tps,
+                                args.tenant_kv_quota,
+                                args.tenant_max_queued)) \
+        or args.tenant_policies
+    if args.serve_model and slo_on:
+        import json as _json
+
+        from lzy_tpu.serving.tenancy import TenantPolicy, TenantTable
+
+        default = TenantPolicy(
+            requests_per_s=args.tenant_rps,
+            prompt_tokens_per_s=args.tenant_tps,
+            kv_block_quota=args.tenant_kv_quota,
+            max_queued=args.tenant_max_queued,
+            burst_s=args.tenant_burst_s)
+        doc = {}
+        if args.tenant_policies:
+            with open(args.tenant_policies) as fh:
+                doc = _json.load(fh)
+        tenants = TenantTable.from_doc(doc, default=default)
     if warm_start:
         _enable_compile_cache()
 
@@ -201,6 +258,8 @@ def main(argv=None) -> int:
                 pool_label=args.gateway_pool,
                 spec_tokens=spec_tokens,
                 warm_start=warm_start,
+                prefill_budget=prefill_budget,
+                tenants=tenants,
             )
     elif args.serve_model and args.gateway:
         from lzy_tpu.service.inference import build_gateway_service
@@ -226,6 +285,8 @@ def main(argv=None) -> int:
                 pool_label=args.gateway_pool,
                 spec_tokens=spec_tokens,
                 warm_start=warm_start,
+                prefill_budget=prefill_budget,
+                tenants=tenants,
             )
     elif args.serve_model:
         from lzy_tpu.service.inference import build_inference_service
@@ -241,6 +302,8 @@ def main(argv=None) -> int:
             kv_blocks=args.serve_kv_blocks,
             spec_tokens=spec_tokens,
             warm_start=warm_start,
+            prefill_budget=prefill_budget,
+            tenants=tenants,
         )
 
     backend = None

@@ -21,6 +21,11 @@ most TPU serving throughput: single-pass prefill and continuous batching).
   emit up to ``spec_tokens+1`` tokens per decode step, bit-identical to
   non-speculative decode (acceptance is exact-match against the model's
   own argmax).
+- ``tenancy``: the multi-tenant SLO layer — tenant policies (priority
+  tiers, token-bucket rate limits, KV-block quotas, queue caps) enforced
+  at admission, weighted fair queueing in the scheduler, chunked-prefill
+  interleaving in the engines so one tenant's 32k-token prompt cannot
+  starve another tenant's token stream.
 
 Expose over the control plane with ``lzy_tpu.service.inference`` (the
 ``--serve-model`` flag of ``lzy_tpu.service.serve``).
@@ -30,8 +35,11 @@ from lzy_tpu.serving.engine import (
     EngineStats, InferenceEngine, PagedInferenceEngine)
 from lzy_tpu.serving.kv_cache import (
     BlockPool, KVCacheStats, NoFreeBlocks, RadixCache)
-from lzy_tpu.serving.scheduler import AdmissionError, Request, RequestQueue
+from lzy_tpu.serving.scheduler import (
+    AdmissionError, PromptTooLong, QuotaExceeded, Request, RequestQueue)
 from lzy_tpu.serving.spec import NgramProposer
+from lzy_tpu.serving.tenancy import (
+    SloLimiter, TenantPolicy, TenantTable, TokenBucket)
 from lzy_tpu.serving.disagg import (
     DecodeEngine, PrefillEngine, export_kv, import_kv)
 
@@ -46,9 +54,15 @@ __all__ = [
     "NoFreeBlocks",
     "PagedInferenceEngine",
     "PrefillEngine",
+    "PromptTooLong",
+    "QuotaExceeded",
     "RadixCache",
     "Request",
     "RequestQueue",
+    "SloLimiter",
+    "TenantPolicy",
+    "TenantTable",
+    "TokenBucket",
     "export_kv",
     "import_kv",
 ]

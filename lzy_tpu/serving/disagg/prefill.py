@@ -60,12 +60,14 @@ class PrefillEngine(PagedInferenceEngine):
         self._export_blocks = 0
 
     def submit(self, prompt, *, request_id=None, deadline_s=None,
-               **_ignored) -> Request:
+               tenant="default", priority=None, **_ignored) -> Request:
         # max_new_tokens=1 satisfies the base validation (prompt + 1 must
         # fit the cache) without reserving decode room that will never be
-        # used
+        # used; tenant/priority ride through so the prefill pool's WFQ
+        # queue and KV quotas see the same identity the decode pool does
         return super().submit(prompt, max_new_tokens=1,
-                              request_id=request_id, deadline_s=deadline_s)
+                              request_id=request_id, deadline_s=deadline_s,
+                              tenant=tenant, priority=priority)
 
     def _finish_prefill(self, slot: int, req: Request, first: int) -> None:
         """Prefill tail: snapshot the prompt's KV blocks to the host
@@ -88,6 +90,7 @@ class PrefillEngine(PagedInferenceEngine):
             _EXPORT_BLOCKS.inc(req.kv_export.n_blocks)
         self._finished += 1
         _REQUESTS.inc(status="ok")
+        self._tenant_count(req.tenant, "requests_finished")
         self._free(slot)      # tree keeps the prompt blocks cached
         req.finish()
 

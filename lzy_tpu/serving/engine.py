@@ -61,10 +61,13 @@ import numpy as np
 
 from lzy_tpu.chaos.faults import CHAOS, CRASH, DELAY, ERROR, SLOW
 from lzy_tpu.models.generate import (
-    batched_prefill, decode_config, init_cache, make_prefill_step,
-    sample_token)
+    _set_cache_index, decode_config, init_cache, make_prefill_step,
+    prefill_plan, sample_token)
 from lzy_tpu.models.llama import Llama, LlamaConfig
-from lzy_tpu.serving.scheduler import AdmissionError, Request, RequestQueue
+from lzy_tpu.serving.scheduler import (
+    AdmissionError, PromptTooLong, Request, RequestQueue)
+from lzy_tpu.serving.tenancy import (
+    TENANT_KV_BLOCKS, TENANT_REQUESTS, TENANT_TOKENS, TENANT_TTFT)
 from lzy_tpu.serving.spec import (
     ACCEPT_RATE as _SPEC_RATE, ACCEPTED as _SPEC_ACCEPTED, NgramProposer,
     PROPOSED as _SPEC_PROPOSED, TOKENS_PER_STEP as _SPEC_TPS,
@@ -112,6 +115,38 @@ _FP_STEP = CHAOS.register(
 _FP_PREFILL = CHAOS.register(
     "engine.prefill", crash_ok=True, modes=(ERROR, DELAY, SLOW, CRASH),
     doc="paged prefill device section (pool donated -> engine-fatal)")
+
+_PREFILL_ROUNDS = REGISTRY.counter(
+    "lzy_inference_prefill_rounds_total",
+    "bounded prefill rounds run between decode steps (chunked prefill)")
+
+
+@dataclasses.dataclass
+class _PrefillJob:
+    """One admitted request's in-progress prefill. With a
+    ``prefill_budget`` the engine advances jobs at most ``budget``
+    prompt tokens per scheduling round, interleaved with decode steps,
+    so a 32k-token prompt can never freeze resident rows' token streams.
+    The chunk *plan* is fixed at staging (identical to the one-shot
+    path), so pausing between chunks changes scheduling, never numerics
+    — greedy output stays bit-identical to an uncontended run."""
+
+    req: Request
+    slot: int                       # reserved; activates on completion
+    plan: list                      # [(start, take, width)] over suffix
+    next_chunk: int = 0
+    done: int = 0                   # suffix tokens already prefilled
+    cache: Any = None               # dense: private [1, ...] cache
+    last: Any = None                # logits at the last real position
+    matched: int = 0                # paged: radix-matched prompt prefix
+    table: list = dataclasses.field(default_factory=list)  # paged blocks
+    # device arrays invariant for the job's lifetime, uploaded once on
+    # the first round (a 32k prompt at budget 256 runs ~128 rounds —
+    # re-uploading the prompt and page table every round would repeat
+    # the host-to-device transfer on the decode-interleaved path the
+    # budget exists to keep short)
+    tokens_dev: Any = None          # [1, len] prompt / suffix ids
+    pt_dev: Any = None              # paged: [1, pages] page table
 
 
 @dataclasses.dataclass
@@ -174,11 +209,16 @@ class InferenceEngine:
         spec_tokens: int = 0,
         spec_ngram: int = 3,
         proposer=None,
+        prefill_budget: Optional[int] = None,
+        tenants=None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if spec_tokens < 0:
             raise ValueError(f"spec_tokens must be >= 0, got {spec_tokens}")
+        if prefill_budget is not None and prefill_budget < 1:
+            raise ValueError(
+                f"prefill_budget must be >= 1, got {prefill_budget}")
         base = decode_config(cfg)
         if spec_tokens + 1 >= base.max_seq_len:
             raise ValueError(
@@ -208,7 +248,24 @@ class InferenceEngine:
 
         self._build_decode_path(base)
 
-        self.queue = RequestQueue(max_queue)
+        # chunked-prefill interleaving: at most ``prefill_budget`` prompt
+        # tokens advance per scheduling round (None = whole prompt in one
+        # round, the pre-tenancy behavior); jobs rotate round-robin so a
+        # short prompt staged behind a long one completes in O(1) rounds
+        self.prefill_budget = (None if prefill_budget is None
+                               else int(prefill_budget))
+        self._prefill_jobs: List[_PrefillJob] = []
+        self._next_prefill = 0
+        self.prefill_rounds = 0         # public: interleave observability
+        # per-tenant SLO state: policy table (WFQ weights, queue caps, KV
+        # quotas) and terminal accounting for the scoped stats surface
+        self.tenants = tenants
+        # written by the engine loop, snapshotted by RPC stats threads —
+        # the lock covers first-seen row insertion vs. iteration
+        self._tenant_counts: dict = {}
+        self._tenant_counts_lock = threading.Lock()
+
+        self.queue = RequestQueue(max_queue, policies=tenants)
         self._active: List[Optional[Request]] = [None] * slots
         self._cur = np.zeros((slots,), np.int32)   # last token per slot
         # host mirror of each slot's cache index (tokens resident in the
@@ -335,16 +392,20 @@ class InferenceEngine:
     def submit(self, prompt: Sequence[int], *, max_new_tokens: int = 64,
                request_id: Optional[str] = None,
                deadline_s: Optional[float] = None,
-               greedy: Optional[bool] = None) -> Request:
+               greedy: Optional[bool] = None,
+               tenant: str = "default",
+               priority: Optional[int] = None) -> Request:
         """Admit a request (raises ``AdmissionError`` under backpressure,
-        ``ValueError`` if it can never fit the cache). Returns the
+        ``PromptTooLong`` if it can never fit the cache). Returns the
         :class:`Request`; wait with ``request.result(timeout)``.
         ``deadline_s``: optional client deadline relative to now — once it
         passes the engine evicts the request mid-decode (slot and cache
         blocks freed) and finishes it with the ``cancelled`` status.
         ``greedy``: per-request sampling override (True forces argmax —
         and with it speculation eligibility — on a sampling engine; None
-        follows the engine-wide temperature)."""
+        follows the engine-wide temperature). ``tenant``/``priority``:
+        SLO identity — the WFQ subqueue and fairness tier the request
+        queues under (quotas and rate limits key on the tenant)."""
         if self._closed or self._draining:
             # fail fast instead of admitting into a queue no loop will ever
             # drain (shutdown stops the engine before the RPC server, so
@@ -360,13 +421,20 @@ class InferenceEngine:
             raise ValueError(f"max_new_tokens must be >= 1, got "
                              f"{max_new_tokens}")
         if len(prompt) + max_new_tokens > self.cfg.max_seq_len:
-            raise ValueError(
-                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds max_seq_len ({self.cfg.max_seq_len})")
+            # a clear, typed rejection AT ADMISSION: past this point the
+            # prompt would die as a shape/indexing error deep inside
+            # prefill — opaque to the client and chargeable to replica
+            # health even though the request itself is at fault
+            raise PromptTooLong(
+                f"prompt ({len(prompt)} tokens) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len "
+                f"({self.cfg.max_seq_len}); the prompt can never be "
+                f"served — shorten it or reduce max_new_tokens")
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         req = Request(prompt, max_new_tokens, request_id=request_id,
-                      deadline_s=deadline_s, greedy=greedy)
+                      deadline_s=deadline_s, greedy=greedy,
+                      tenant=tenant, priority=priority)
         self.queue.submit(req)
         with self._outstanding_lock:
             self._outstanding = {r for r in self._outstanding
@@ -385,12 +453,18 @@ class InferenceEngine:
     # -- engine loop -------------------------------------------------------
 
     def step(self) -> bool:
-        """One scheduling round: reap cancelled slots, admit waiting
-        requests into free slots (prefill on arrival), then advance every
-        active slot by one jitted decode step. Returns False when there
-        was nothing to do."""
+        """One scheduling round: reap cancelled slots, admit (stage) a
+        waiting request into a free slot, advance at most one prefill
+        job by the token budget, then advance every active slot by one
+        jitted decode step. Returns False when there was nothing to do.
+
+        Prefill and decode INTERLEAVE: with a ``prefill_budget`` a long
+        prompt's prefill is spread over many rounds, each of which also
+        runs a decode step for the resident rows — bounded inter-token
+        latency for them, bounded time-to-first-chunk for newly staged
+        short prompts (jobs rotate round-robin)."""
         if CHAOS.armed is not None and (
-                self.queue.depth()
+                self.queue.depth() or self._prefill_jobs
                 or any(r is not None for r in self._active)):
             # chaos boundary, hit only on rounds with real work so a
             # parked loop's idle spins don't consume the fault schedule.
@@ -399,8 +473,9 @@ class InferenceEngine:
             CHAOS.hit("engine.step")
         self._reap_cancelled()
         admitted = self._admit()
+        progressed = self._advance_prefill()
         stepped = self._decode()
-        return admitted or stepped
+        return admitted or progressed or stepped
 
     def _reap_cancelled(self) -> None:
         """Free slots whose waiter abandoned the request (client timeout)
@@ -410,6 +485,12 @@ class InferenceEngine:
         ``cancelled`` status (partial tokens stay readable)."""
         for req in self.queue.reap_dead():
             self._finish_cancelled(req)
+        for job in list(self._prefill_jobs):
+            if job.req.cancelled or job.req.expired:
+                # a mid-prefill abandon releases everything staged (the
+                # paged engine returns the job's blocks to the pool)
+                self._abort_prefill_job(job)
+                self._finish_cancelled(job.req)
         for slot, req in enumerate(self._active):
             if req is None:
                 continue
@@ -422,65 +503,198 @@ class InferenceEngine:
 
     def _finish_cancelled(self, req: Request) -> None:
         _REQUESTS.inc(status="cancelled")
+        TENANT_REQUESTS.inc(tenant=req.tenant, status="cancelled")
+        self._tenant_count(req.tenant, "requests_cancelled")
         self._cancelled += 1
         why = "cancelled: deadline exceeded" if req.expired and \
             not req.cancelled else "cancelled"
         req.finish(error=why, status="cancelled")
 
+    def _tenant_count(self, tenant: str, key: str, n: int = 1) -> None:
+        with self._tenant_counts_lock:
+            d = self._tenant_counts.get(tenant)
+            if d is None:
+                d = self._tenant_counts[tenant] = {
+                    "requests_finished": 0, "tokens_generated": 0,
+                    "requests_cancelled": 0, "requests_preempted": 0,
+                    "requests_error": 0}
+            d[key] += n
+
     def _can_admit(self, req: Request) -> bool:
-        """Resource gate checked BEFORE popping the head of the queue; the
-        dense engine only needs the free slot the caller already found.
-        The paged engine overrides this with its KV block budget."""
+        """Resource gate checked BEFORE popping a candidate; the dense
+        engine only needs the free slot the caller already found. The
+        paged engine overrides this with its KV block budget."""
         return True
+
+    def _admit_verdict(self, req: Request) -> str:
+        """``"admit"`` (pop and stage), ``"wait"`` (global capacity —
+        the whole queue waits so big prompts are never starved by
+        smaller late arrivals), or ``"skip"`` (a *tenant-scoped* limit:
+        this tenant's head steps aside without blocking other tenants'
+        admissible heads — one tenant's quota must never become another
+        tenant's latency)."""
+        return "admit" if self._can_admit(req) else "wait"
+
+    def _free_slot(self) -> Optional[int]:
+        """A slot neither active nor reserved by a pending prefill job."""
+        reserved = {job.slot for job in self._prefill_jobs}
+        for slot, req in enumerate(self._active):
+            if req is None and slot not in reserved:
+                return slot
+        return None
 
     def _admit(self) -> bool:
         admitted = False
-        while any(r is None for r in self._active):
-            req = self.queue.peek()
-            if req is None:
+        while True:
+            slot = self._free_slot()
+            if slot is None:
                 break
-            if req.cancelled or req.expired:
-                self.queue.pop()
-                self._finish_cancelled(req)
-                continue
-            if not self._can_admit(req):
-                # head-of-line waits for capacity (blocks free as running
-                # requests finish); skipping ahead would starve big prompts
+            rescan = False
+            for req in self.queue.candidates():
+                if req.cancelled or req.expired:
+                    if self.queue.pop_request(req):
+                        self._finish_cancelled(req)
+                    rescan = True
+                    break
+                verdict = self._admit_verdict(req)
+                if verdict == "skip":
+                    continue
+                if verdict == "wait":
+                    break
+                self.queue.pop_request(req)
+                try:
+                    job = self._stage_prefill(slot, req)
+                except PoolCorruption:
+                    raise    # engine-fatal: the shared pool was donated
+                except Exception as e:  # noqa: BLE001 — request-scoped
+                    _LOG.warning("prefill staging failed for %s: %s",
+                                 req.id, e)
+                    _REQUESTS.inc(status="error")
+                    TENANT_REQUESTS.inc(tenant=req.tenant, status="error")
+                    self._tenant_count(req.tenant, "requests_error")
+                    req.finish(error=f"{type(e).__name__}: {e}")
+                    rescan = True
+                    break
+                self._prefill_jobs.append(job)
+                admitted = True
                 break
-            self.queue.pop()
-            slot = self._active.index(None)
-            try:
-                self._prefill_into(slot, req)
-            except PoolCorruption:
-                raise        # engine-fatal: the shared pool was donated
-            except Exception as e:  # noqa: BLE001 — request-scoped failure
-                _LOG.warning("prefill failed for %s: %s", req.id, e)
-                _REQUESTS.inc(status="error")
-                req.finish(error=f"{type(e).__name__}: {e}")
+            if rescan:
                 continue
-            admitted = True
-            # at most ONE prefill per scheduling round: admissions run
-            # between decode steps, so draining a burst of long prompts
-            # here would stall every in-flight request's token stream for
-            # the whole burst — one per round caps the inter-token latency
-            # spike at a single prefill while the rest of the queue joins
-            # over the next few rounds
+            # at most ONE staging per scheduling round: admissions run
+            # between decode steps, and one-per-round caps the scheduling
+            # work (and, with no budget, the inter-token latency spike)
+            # at a single prefill while the rest of the queue joins over
+            # the next few rounds
             break
         _BUSY.set(float(sum(r is not None for r in self._active)))
         return admitted
 
-    def _prefill_into(self, slot: int, req: Request) -> None:
-        prompt = jnp.asarray([req.prompt], jnp.int32)
-        # fresh zeros each time (prefill donates the cache buffers); the
-        # shapes were computed once at construction
+    # -- chunked prefill (the _PrefillJob state machine) ---------------------
+
+    def _stage_prefill(self, slot: int, req: Request) -> _PrefillJob:
+        """Allocate everything a prefill needs (dense: a private batch-1
+        cache) WITHOUT running device work — the budgeted advance does
+        that. Failures here are request-scoped (nothing shared was
+        touched)."""
         cache = jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype),
             self._prefill_cache_shapes)
-        cache, last_logits = batched_prefill(
-            self._prefill_model, cache, self.params, prompt,
-            chunk=self.prefill_chunk, max_seq_len=self.cfg.max_seq_len,
-            prefill_step=self._prefill_step)
-        first, self._rng = self._pick_first(last_logits, req)
+        plan = prefill_plan(len(req.prompt), self.prefill_chunk,
+                            self.cfg.max_seq_len)
+        return _PrefillJob(req=req, slot=slot, plan=plan, cache=cache)
+
+    def _advance_prefill(self) -> bool:
+        """Advance ONE pending prefill job by at most ``prefill_budget``
+        prompt tokens (all of them when the budget is None), rotating
+        round-robin across jobs so a short prompt staged behind a long
+        one still reaches its first token in O(1) rounds."""
+        if not self._prefill_jobs:
+            return False
+        if self._next_prefill >= len(self._prefill_jobs):
+            self._next_prefill = 0
+        job = self._prefill_jobs[self._next_prefill]
+        req = job.req
+        if req.cancelled or req.expired:
+            self._abort_prefill_job(job)
+            self._finish_cancelled(req)
+            return True
+        try:
+            finished = self._advance_prefill_round(job)
+        except PoolCorruption:
+            raise            # engine-fatal: the shared pool was donated
+        except Exception as e:  # noqa: BLE001 — request-scoped (dense:
+            # the half-built cache was private to this request)
+            _LOG.warning("prefill failed for %s: %s", req.id, e)
+            _REQUESTS.inc(status="error")
+            TENANT_REQUESTS.inc(tenant=req.tenant, status="error")
+            self._tenant_count(req.tenant, "requests_error")
+            self._drop_prefill_job(job)
+            req.finish(error=f"{type(e).__name__}: {e}")
+            return True
+        self.prefill_rounds += 1
+        _PREFILL_ROUNDS.inc()
+        if finished:
+            self._drop_prefill_job(job)
+        else:
+            self._next_prefill += 1
+        return True
+
+    def _drop_prefill_job(self, job: _PrefillJob) -> None:
+        idx = self._prefill_jobs.index(job)
+        del self._prefill_jobs[idx]
+        if self._next_prefill > idx:
+            self._next_prefill -= 1
+
+    def _abort_prefill_job(self, job: _PrefillJob) -> None:
+        """Release a job's staged resources without finishing its
+        request (the caller decides the terminal status); the paged
+        engine returns the staged blocks to the pool."""
+        self._drop_prefill_job(job)
+
+    def _run_prefill_chunks(self, job: _PrefillJob, cache, arr, run_chunk):
+        """Shared budget loop: run chunks of ``job.plan`` through
+        ``run_chunk(cache, tokens, take)`` until the plan ends or the
+        budget is spent. Returns ``(cache, finished)``; ``job.last``
+        holds the final chunk's last-position logits once finished."""
+        budget = self.prefill_budget
+        spent = 0
+        while job.next_chunk < len(job.plan):
+            start, take, width = job.plan[job.next_chunk]
+            tokens = arr[:, start:start + take]
+            if width != take:
+                tokens = jnp.pad(tokens, ((0, 0), (0, width - take)))
+            cache, job.last = run_chunk(cache, tokens, take)
+            job.next_chunk += 1
+            job.done += take
+            spent += take
+            if budget is not None and spent >= budget \
+                    and job.next_chunk < len(job.plan):
+                return cache, False
+        return cache, True
+
+    def _advance_prefill_round(self, job: _PrefillJob) -> bool:
+        """One budgeted round of a DENSE prefill; True when the job
+        finished (slot activated). The chunk plan — and with it every
+        device call — is identical to the one-shot path; only the wall-
+        clock interleaving with decode steps differs, so greedy output
+        is bit-identical chunked or not."""
+        req = job.req
+        if job.tokens_dev is None:
+            job.tokens_dev = jnp.asarray([req.prompt], jnp.int32)
+        cache, finished = self._run_prefill_chunks(
+            job, job.cache, job.tokens_dev,
+            lambda c, tokens, take: self._prefill_step(
+                c, self.params, tokens, jnp.asarray(take - 1, jnp.int32)))
+        if not finished:
+            job.cache = cache
+            return False
+        job.cache = None
+        _, last_take, last_width = job.plan[-1]
+        if last_take != last_width:
+            # final chunk was padded: rewind the index to the true length
+            cache = _set_cache_index(cache, len(req.prompt))
+        first, self._rng = self._pick_first(job.last, req)
+        slot = job.slot
 
         # splice the prefilled batch-1 cache into the slot's rows; the
         # scalar index leaves land in the [slots] index at this row
@@ -491,6 +705,7 @@ class InferenceEngine:
 
         self._cache = jax.tree_util.tree_map(ins, self._cache, cache)
         self._finish_prefill(slot, req, int(first[0]))
+        return True
 
     def _finish_prefill(self, slot: int, req: Request, first: int) -> None:
         """Shared prefill tail: record TTFT, emit the first token, and
@@ -498,6 +713,7 @@ class InferenceEngine:
         now = time.monotonic()
         req.first_token_at = now
         _TTFT.observe(now - req.submitted_at)
+        TENANT_TTFT.observe(now - req.submitted_at, tenant=req.tenant)
         # the prompt is now cache-resident; the first generated token is
         # not (the next decode step writes it at this position)
         self._pos[slot] = len(req.prompt)
@@ -730,10 +946,14 @@ class InferenceEngine:
         req.tokens.append(token)
         self._tokens_out += 1
         _TOKENS.inc()
+        TENANT_TOKENS.inc(tenant=req.tenant)
+        self._tenant_count(req.tenant, "tokens_generated")
         hit_eos = self.eos_token is not None and token == self.eos_token
         if hit_eos or len(req.tokens) >= req.max_new_tokens:
             self._finished += 1
             _REQUESTS.inc(status="ok")
+            TENANT_REQUESTS.inc(tenant=req.tenant, status="ok")
+            self._tenant_count(req.tenant, "requests_finished")
             if active:
                 # free BEFORE finish(): the waiter wakes on finish and
                 # must observe the slot/blocks already released
@@ -870,6 +1090,10 @@ class InferenceEngine:
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+        # staged prefills release their resources (paged: blocks back to
+        # the pool); their requests are failed by the untracked sweep
+        for job in list(self._prefill_jobs):
+            self._abort_prefill_job(job)
         for req in self.queue.drain():
             _REQUESTS.inc(status="shed")
             req.finish(error="engine shutting down")
@@ -917,6 +1141,23 @@ class InferenceEngine:
                 spec_tokens_per_step=round(tps, 4),
             )
         return s
+
+    def stats_by_tenant(self) -> dict:
+        """Per-tenant terminal counters plus live queue depth — the
+        scoped half of the stats surface (a tenant sees its own row, the
+        operator sees them all; the gateway fleet aggregates these
+        across replicas). The paged engine adds resident KV blocks."""
+        with self._tenant_counts_lock:
+            out = {t: dict(d) for t, d in self._tenant_counts.items()}
+        for tenant in self.queue.tenants():
+            row = out.setdefault(tenant, {
+                "requests_finished": 0, "tokens_generated": 0,
+                "requests_cancelled": 0, "requests_preempted": 0,
+                "requests_error": 0})
+            row["queue_depth"] = self.queue.depth_of(tenant)
+        for row in out.values():
+            row.setdefault("queue_depth", 0)
+        return out
 
 
 class PagedInferenceEngine(InferenceEngine):
@@ -1066,16 +1307,44 @@ class PagedInferenceEngine(InferenceEngine):
         from lzy_tpu.serving.kv_cache import blocks_for
 
         prompt = list(prompt)
-        # reject prompts the pool can NEVER cover: past submit they would
-        # park at the head of the queue forever (head-of-line admission
-        # waits for blocks that cannot exist) and starve everyone behind
+        # reject prompts the pool — or the tenant's quota — can NEVER
+        # cover: past submit they would park in the queue forever
+        # (admission waits for blocks that cannot exist) and waste a
+        # tenant's WFQ share on an unservable head
         if prompt and blocks_for(len(prompt), self._page) > self._kv_blocks - 1:
-            raise ValueError(
+            raise PromptTooLong(
                 f"prompt ({len(prompt)} tokens) needs "
                 f"{blocks_for(len(prompt), self._page)} KV blocks but the "
                 f"pool only has {self._kv_blocks - 1}; raise kv_blocks or "
                 f"shorten the prompt")
+        tenant = kwargs.get("tenant") or "default"
+        quota = self._tenant_quota(tenant)
+        if prompt and quota is not None \
+                and blocks_for(len(prompt), self._page) > quota:
+            raise PromptTooLong(
+                f"prompt ({len(prompt)} tokens) needs "
+                f"{blocks_for(len(prompt), self._page)} KV blocks but "
+                f"tenant {tenant!r} is capped at {quota}; shorten the "
+                f"prompt or raise the tenant's kv_block_quota")
         return super().submit(prompt, **kwargs)
+
+    def _tenant_quota(self, tenant: str) -> Optional[int]:
+        if self.tenants is None:
+            return None
+        return self.tenants.resolve(tenant).kv_block_quota
+
+    def _tenant_block_usage(self, tenant: str) -> int:
+        """Blocks this tenant currently pins on THIS replica: resident
+        slots plus staged (mid-prefill) jobs. Quotas are per-replica —
+        each replica owns its own pool."""
+        held = 0
+        for slot, req in enumerate(self._active):
+            if req is not None and req.tenant == tenant:
+                held += len(self._slot_blocks[slot])
+        for job in self._prefill_jobs:
+            if job.req.tenant == tenant:
+                held += len(job.table)
+        return held
 
     def _can_admit(self, req: Request) -> bool:
         """Admission is gated on the BLOCK budget, not the slot count: the
@@ -1087,12 +1356,27 @@ class PagedInferenceEngine(InferenceEngine):
 
         return self.kv.available() >= blocks_for(len(req.prompt), self._page)
 
-    def _prefill_into(self, slot: int, req: Request) -> None:
+    def _admit_verdict(self, req: Request) -> str:
+        """Tenant KV quota first (a tenant AT its quota is skipped, not
+        head-of-line-blocked — its blocks free as its own requests
+        finish, and other tenants must not wait on that), then the
+        global pool budget (a genuine capacity wait: everyone holds so
+        big prompts are not starved by smaller late arrivals)."""
+        from lzy_tpu.serving.kv_cache import blocks_for
+
+        quota = self._tenant_quota(req.tenant)
+        if quota is not None:
+            need = blocks_for(len(req.prompt), self._page)
+            if self._tenant_block_usage(req.tenant) + need > quota:
+                return "skip"
+        return "admit" if self._can_admit(req) else "wait"
+
+    def _stage_prefill(self, slot: int, req: Request) -> _PrefillJob:
         from lzy_tpu.models.generate import prefill_plan
+        from lzy_tpu.serving.kv_cache import blocks_for
 
         prompt = req.prompt
         t0 = len(prompt)
-        page = self._page
         # longest cached whole-block prefix; capped at prompt[:-1] so at
         # least one real token remains to forward (logits for the first
         # generated token must come from an actual prefill position)
@@ -1105,36 +1389,56 @@ class PagedInferenceEngine(InferenceEngine):
         # prefix, map to the scratch block, and are masked garbage by
         # construction — allocating coverage for them would waste up to
         # bucket_width/page blocks per short request
-        from lzy_tpu.serving.kv_cache import blocks_for
-
         try:
-            owned = self.kv.allocate(blocks_for(t0, page) - len(blocks))
+            owned = self.kv.allocate(blocks_for(t0, self._page)
+                                     - len(blocks))
         except Exception:
             self.kv.release(blocks)   # roll back the match refs
             raise
-        table = blocks + owned
-        self._tables[slot, :len(table)] = table
-        self._tables[slot, len(table):] = 0
-        pt = jnp.asarray(self._tables[slot:slot + 1])
+        # NOTE: the slot's row of self._tables stays scratch until the
+        # job completes — decode rounds interleaved with this prefill
+        # must see the reserved slot as idle (its garbage writes land on
+        # block 0), never on the job's half-written real blocks
+        return _PrefillJob(req=req, slot=slot, plan=plan, matched=matched,
+                           table=blocks + owned)
 
+    def _advance_prefill_round(self, job: _PrefillJob) -> bool:
+        """One budgeted round of a PAGED prefill. The pool k/v leaves are
+        re-skinned for the batch-1 prefill, advanced by up to the budget,
+        and merged back into the decode tree before returning — decode
+        steps between rounds run against a fully consistent tree (the
+        job's slot reads as idle: index 0, scratch page table). Resuming
+        at ``matched + done`` reproduces the one-shot index exactly
+        (interior chunks are unpadded), so chunking never changes the
+        device math — only its interleaving."""
+        req = job.req
+        t0 = len(req.prompt)
+        if job.pt_dev is None:
+            pt = np.zeros((1, self._pages_per_seq), np.int32)
+            pt[0, :len(job.table)] = job.table
+            job.pt_dev = jnp.asarray(pt)
+        pt = job.pt_dev
         # everything device-side below donates the SHARED pool: a failure
         # here poisons every request, not just this one
         try:
             # chaos boundary: an injected error here is exactly a device
             # call dying mid-prefill — engine-fatal by construction
             CHAOS.hit("engine.prefill")
-            cache = self._pool_to_prefill(matched)
-            suffix_arr = jnp.asarray([suffix], jnp.int32)
-            last = None
-            for start, take, width in plan:
-                tokens = suffix_arr[:, start:start + take]
-                if width != take:
-                    tokens = jnp.pad(tokens, ((0, 0), (0, width - take)))
-                cache, last = self._prefill_step(
-                    cache, self.params, tokens, pt,
-                    jnp.asarray(take - 1, jnp.int32))
-            first, self._rng = self._pick_first(last, req)
-            self._merge_prefill(cache, slot, t0)
+            cache = self._pool_to_prefill(job.matched + job.done)
+            if job.tokens_dev is None:
+                job.tokens_dev = jnp.asarray(
+                    [req.prompt[job.matched:]], jnp.int32)
+            cache, finished = self._run_prefill_chunks(
+                job, cache, job.tokens_dev,
+                lambda c, tokens, take: self._prefill_step(
+                    c, self.params, tokens, pt,
+                    jnp.asarray(take - 1, jnp.int32)))
+            if not finished:
+                self._merge_prefill(cache, job.slot, 0)
+                self._index_aliased = True
+                return False
+            first, self._rng = self._pick_first(job.last, req)
+            self._merge_prefill(cache, job.slot, t0)
         except Exception as e:  # noqa: BLE001 — see PoolCorruption
             raise PoolCorruption(
                 f"paged prefill died mid-flight for {req.id}: "
@@ -1143,13 +1447,26 @@ class PagedInferenceEngine(InferenceEngine):
         # register the prompt's full blocks for future prefix hits (the
         # matched prefix nodes already exist and are skipped; pad garbage
         # only ever lands at positions >= t0, never inside a full block)
-        n_full = t0 // page
+        slot, table = job.slot, job.table
+        n_full = t0 // self._page
         if n_full:
-            self.kv.insert(prompt[:n_full * page], table[:n_full])
-        self._slot_blocks[slot] = table
+            self.kv.insert(req.prompt[:n_full * self._page], table[:n_full])
+        self._tables[slot, :len(table)] = table
+        self._tables[slot, len(table):] = 0
+        self._slot_blocks[slot] = list(table)
         self._admissions += 1
         self._admit_seq[slot] = self._admissions
         self._finish_prefill(slot, req, int(first[0]))
+        return True
+
+    def _abort_prefill_job(self, job: _PrefillJob) -> None:
+        super()._abort_prefill_job(job)
+        # drop the staged refs: matched prefix blocks fall back to
+        # cached, freshly-owned ones return to the free list (their
+        # half-written K/V is dead weight a future holder overwrites
+        # during its own prefill, same as any freed slot's blocks)
+        self.kv.release(job.table)
+        job.table = []
 
     # -- decode --------------------------------------------------------------
 
@@ -1185,6 +1502,8 @@ class PagedInferenceEngine(InferenceEngine):
         req = self._active[victim]
         _LOG.warning("kv block pool exhausted: preempting %s", req.id)
         _REQUESTS.inc(status="preempted")
+        TENANT_REQUESTS.inc(tenant=req.tenant, status="preempted")
+        self._tenant_count(req.tenant, "requests_preempted")
         self._free(victim)     # free before finish (see _reap_cancelled)
         req.finish(error="preempted: kv block pool exhausted")
         return victim
@@ -1299,3 +1618,18 @@ class PagedInferenceEngine(InferenceEngine):
             prefix_hit_rate=round(ks.hit_rate, 4),
             prefill_tokens_saved=ks.prefill_tokens_saved,
         )
+
+    def stats_by_tenant(self) -> dict:
+        out = super().stats_by_tenant()
+        tenants = set(out)
+        tenants.update(r.tenant for r in self._active if r is not None)
+        tenants.update(j.req.tenant for j in self._prefill_jobs)
+        for tenant in tenants:
+            held = self._tenant_block_usage(tenant)
+            row = out.setdefault(tenant, {
+                "requests_finished": 0, "tokens_generated": 0,
+                "requests_cancelled": 0, "requests_preempted": 0,
+                "requests_error": 0, "queue_depth": 0})
+            row["kv_blocks"] = held
+            TENANT_KV_BLOCKS.set(float(held), tenant=tenant)
+        return out

@@ -1,11 +1,27 @@
 """Request admission for the inference engine.
 
-A bounded FIFO with explicit backpressure: ``submit`` raises
-``AdmissionError`` when the queue is full (the serving front maps it to a
-retryable RESOURCE_EXHAUSTED-style error) instead of buffering unboundedly
-— under overload the caller should shed or retry elsewhere, not pile
-latency onto everyone already queued. Queue depth is exported as a gauge so
-operators see saturation before users do.
+Historically a bounded FIFO; now a **weighted fair queue over per-tenant
+subqueues** (virtual-time WFQ, a.k.a. start-time fair queuing): every
+request carries a tenant and a priority tier, each tenant owns a FIFO
+subqueue, and the queue dispenses the head with the smallest virtual
+finish tag. Cost is measured in tokens (prompt + requested continuation)
+scaled by the tenant's weight, so
+
+- tenants sharing a replica split its token throughput by weight, not by
+  arrival rate — a client flooding the queue only competes with itself;
+- a starved tenant's head request always ages to the front: its start
+  tag is clamped to the global virtual time, which advances with every
+  dispatch, so no weight assignment can postpone it forever;
+- with a single tenant (or uniform weights and one-at-a-time arrivals)
+  dispatch order degrades to exactly the old FIFO.
+
+Backpressure is two-layered: a *global* bound (``max_depth``) sheds with
+the queue-wide drain estimate, and a *per-tenant* bound
+(``TenantPolicy.max_queued``) sheds that tenant alone with a
+tenant-scoped ``retry_after_s`` — one tenant's backlog never converts
+into another tenant's rejection. Queue depth is exported globally and
+per tenant so operators see *who* is saturating, not just that someone
+is.
 """
 
 from __future__ import annotations
@@ -14,13 +30,16 @@ import itertools
 import threading
 import time
 from collections import deque
-from typing import Any, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 from lzy_tpu.chaos.faults import CHAOS
 from lzy_tpu.utils.metrics import REGISTRY
 
 _QUEUE_DEPTH = REGISTRY.gauge(
     "lzy_inference_queue_depth", "requests admitted but not yet prefilled")
+_TENANT_QUEUE = REGISTRY.gauge(
+    "lzy_tenant_queue_depth",
+    "requests admitted but not yet prefilled, by tenant")
 _REJECTED = REGISTRY.counter(
     "lzy_inference_rejected_total", "requests refused at admission")
 #: shared shedding counter (the gateway imports this rather than
@@ -28,6 +47,28 @@ _REJECTED = REGISTRY.counter(
 SHED_REQUESTS = REGISTRY.counter(
     "lzy_shed_requests_total",
     "requests shed with a retry-after hint instead of queued, by reason")
+TENANT_SHED = REGISTRY.counter(
+    "lzy_tenant_shed_total",
+    "requests shed at a tenant-scoped limit, by tenant and reason")
+
+#: the default tenant every request without an identity lands on — the
+#: single-tenant deployments (and every pre-tenancy caller) run entirely
+#: inside this one
+DEFAULT_TENANT = "default"
+
+#: priority tier -> WFQ weight. Tier 0 is interactive (largest share),
+#: tier 1 the standard default, tier 2 batch/background. Weights are
+#: RELATIVE shares of a contended replica's token throughput, not
+#: absolute guarantees; an uncontended tenant always gets full speed.
+TIER_WEIGHTS = {0: 4.0, 1: 2.0, 2: 1.0}
+DEFAULT_PRIORITY = 1
+
+
+def tier_weight(priority: Optional[int]) -> float:
+    """WFQ weight for a priority tier (out-of-range tiers clamp)."""
+    if priority is None:
+        priority = DEFAULT_PRIORITY
+    return TIER_WEIGHTS[min(max(int(priority), 0), max(TIER_WEIGHTS))]
 
 
 class AdmissionError(RuntimeError):
@@ -45,6 +86,33 @@ class AdmissionError(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
+class PromptTooLong(AdmissionError, ValueError):
+    """The prompt can never be served by this plane (prompt +
+    max_new_tokens exceeds the model's ``max_seq_len``, or the prompt
+    alone exceeds a hard pool/quota bound). A *permanent* admission
+    rejection: unlike its retryable parent it carries no retry hint, is
+    never failed over (it would fail identically on every replica), and
+    maps to INVALID_ARGUMENT on the wire — the request itself is wrong,
+    not the plane's capacity. Raised at admission so an over-long prompt
+    surfaces as one clear error instead of a shape/indexing failure deep
+    inside prefill (which would also count against replica health)."""
+
+
+class QuotaExceeded(AdmissionError):
+    """A tenant-scoped SLO limit refused the request: token-bucket rate
+    limit (requests/s or prompt-tokens/s), per-tenant queue depth, or
+    per-tenant KV-block quota. Retryable — ``retry_after_s`` is sized to
+    *that tenant's* refill/drain schedule, so a well-behaved client backs
+    off on its own clock while other tenants are unaffected. Maps to
+    RESOURCE_EXHAUSTED on the wire."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None,
+                 tenant: Optional[str] = None, reason: Optional[str] = None):
+        super().__init__(msg, retry_after_s)
+        self.tenant = tenant
+        self.reason = reason
+
+
 def shed_error(exc_type, msg: str, *, reason: str,
                retry_after_s: Optional[float] = None):
     """Build (and count) a load-shedding rejection: the retry-after
@@ -58,6 +126,34 @@ def shed_error(exc_type, msg: str, *, reason: str,
     err = exc_type(msg)
     err.retry_after_s = retry_after_s
     return err
+
+
+def quota_error(msg: str, *, tenant: str, reason: str,
+                retry_after_s: Optional[float] = None,
+                counted: bool = True) -> QuotaExceeded:
+    """Tenant-scoped twin of :func:`shed_error`: counts the shed under
+    both the fleet-wide and the per-tenant counter and builds the
+    :class:`QuotaExceeded` with the hint riding the message (wire) and
+    the attribute (in-process). ``counted=False`` skips the counters —
+    for refusals that are NOT client-facing (an engine probe the gateway
+    retries elsewhere; the client-facing boundary counts those via
+    :func:`count_tenant_shed` only when the refusal reaches the client)."""
+    if counted:
+        SHED_REQUESTS.inc(reason=reason)
+        TENANT_SHED.inc(tenant=tenant, reason=reason)
+    if retry_after_s is not None:
+        msg = f"{msg} (retry_after_s={retry_after_s:.2f})"
+    return QuotaExceeded(msg, retry_after_s=retry_after_s,
+                         tenant=tenant, reason=reason)
+
+
+def count_tenant_shed(err: QuotaExceeded) -> None:
+    """Count an engine-raised (uncounted) quota refusal at the boundary
+    where it becomes client-facing — the single-engine plane has no
+    other replica to try, so the refusal IS the shed there."""
+    SHED_REQUESTS.inc(reason=err.reason or "quota")
+    TENANT_SHED.inc(tenant=err.tenant or DEFAULT_TENANT,
+                    reason=err.reason or "quota")
 
 
 _ids = itertools.count(1)
@@ -82,16 +178,25 @@ class Request:
     — ``serving/spec.py``); ``False`` forces sampling with the engine's
     temperature/top_k/top_p; ``None`` (default) follows the engine-wide
     setting. Sampled rows sharing a batch with greedy rows keep the exact
-    rng draw order they had before the override existed."""
+    rng draw order they had before the override existed.
+
+    ``tenant``/``priority`` are the SLO identity: the tenant names the
+    WFQ subqueue (and the KV quota / rate-limit bucket), the priority
+    tier sets the fairness weight. Both default to the single-tenant
+    values, so pre-tenancy callers are unchanged."""
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
                  request_id: Optional[str] = None,
                  deadline_s: Optional[float] = None,
-                 greedy: Optional[bool] = None):
+                 greedy: Optional[bool] = None,
+                 tenant: str = DEFAULT_TENANT,
+                 priority: Optional[int] = None):
         self.id = request_id or f"req-{next(_ids)}"
         self.prompt: List[int] = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.greedy = greedy
+        self.tenant = str(tenant) if tenant else DEFAULT_TENANT
+        self.priority = None if priority is None else int(priority)
         self.tokens: List[int] = []
         self.error: Optional[str] = None
         self.status: Optional[str] = None     # "ok" | "cancelled" | "error"
@@ -103,6 +208,12 @@ class Request:
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self._done = threading.Event()
+        # WFQ bookkeeping (owned by RequestQueue): virtual start/finish
+        # tags, arrival sequence, and the queued flag
+        self._vstart = 0.0
+        self._vfinish = 0.0
+        self._qseq = 0
+        self._queued = False
 
     def cancel(self) -> None:
         """Best-effort abandon (e.g. the waiting client timed out): a
@@ -150,16 +261,28 @@ _FP_ADMIT = CHAOS.register(
 
 
 class RequestQueue:
-    """Bounded FIFO; thread-safe; wakes the engine loop on submit.
+    """Bounded weighted-fair queue; thread-safe; wakes the engine loop
+    on submit.
 
-    The bound is the load-shedding line: past it, ``submit`` rejects
-    with a ``retry_after_s`` hint sized to the queue's recent drain rate
-    instead of growing without bound (overload must surface as fast,
-    cheap rejections — not as unbounded latency for everyone queued)."""
+    Per-tenant FIFO subqueues dispatched by virtual finish tag (module
+    docstring has the fairness argument). The bound is the load-shedding
+    line: past it, ``submit`` rejects with a ``retry_after_s`` hint sized
+    to the queue's recent drain rate instead of growing without bound
+    (overload must surface as fast, cheap rejections — not as unbounded
+    latency for everyone queued). ``policies`` (a
+    ``serving.tenancy.TenantTable``-shaped object) supplies per-tenant
+    weights and queue caps; without it every tenant gets the tier-1
+    default weight and only the global bound applies."""
 
-    def __init__(self, max_depth: int = 64):
+    def __init__(self, max_depth: int = 64, policies=None):
         self.max_depth = max_depth
-        self._q: deque = deque()
+        self.policies = policies
+        self._subq: Dict[str, deque] = {}
+        self._finish_tag: Dict[str, float] = {}
+        self._vtime = 0.0
+        self._seq = 0
+        self._depth = 0
+        self._head: Optional[Request] = None     # pinned by peek()
         self._lock = threading.Lock()
         # drain-rate estimate for the retry-after hint: EWMA of the
         # interval between pops (i.e. seconds per admitted request)
@@ -168,21 +291,37 @@ class RequestQueue:
         #: signalled on submit so an idle engine loop wakes immediately
         self.work_available = threading.Event()
 
+    # -- shed hints ----------------------------------------------------------
+
     def _retry_after_locked(self) -> float:
         """Estimated time until queue space exists — the time to drain
         half the queue at the recent pop rate, clamped to [0.05s, 10s].
         Caller holds ``self._lock``."""
-        est = self._pop_interval_s * max(1.0, len(self._q) / 2.0)
+        est = self._pop_interval_s * max(1.0, self._depth / 2.0)
         return min(10.0, max(0.05, est))
 
     def retry_after_s(self) -> float:
         with self._lock:
             return self._retry_after_locked()
 
+    def _tenant_retry_locked(self, tenant: str) -> float:
+        """Tenant-scoped hint: time to drain that tenant's own backlog
+        at the recent pop rate. Approximate (the tenant drains at its
+        weight share, not the full pop rate), but it keys the backoff to
+        the offender's backlog instead of the fleet's."""
+        backlog = len(self._subq.get(tenant, ()))
+        est = self._pop_interval_s * max(1.0, float(backlog))
+        return min(10.0, max(0.05, est))
+
+    # -- admission -----------------------------------------------------------
+
     def submit(self, request: Request) -> Request:
         CHAOS.hit("engine.admit")
+        tenant = request.tenant
+        policy = (self.policies.resolve(tenant)
+                  if self.policies is not None else None)
         with self._lock:
-            if len(self._q) >= self.max_depth:
+            if self._depth >= self.max_depth:
                 # counted as a REJECTION here, as a SHED only where the
                 # refusal is client-facing (the gateway retries other
                 # replicas first — a probe refusal is not a shed request)
@@ -191,55 +330,178 @@ class RequestQueue:
                     f"inference queue full ({self.max_depth} waiting); "
                     f"retry later",
                     retry_after_s=self._retry_after_locked())
-            self._q.append(request)
-            _QUEUE_DEPTH.set(float(len(self._q)))
+            cap = getattr(policy, "max_queued", None)
+            sub = self._subq.get(tenant)
+            if cap is not None and sub is not None and len(sub) >= cap:
+                # counted as a REJECTION only (same convention as the
+                # global bound above): the gateway retries other
+                # replicas, so the shed counters move at the boundary
+                # where the refusal reaches the client
+                _REJECTED.inc()
+                raise quota_error(
+                    f"tenant {tenant!r} already has {len(sub)} request(s) "
+                    f"queued (cap {cap}); retry later",
+                    tenant=tenant, reason="max_queued",
+                    retry_after_s=self._tenant_retry_locked(tenant),
+                    counted=False)
+            weight = (policy.effective_weight(request.priority)
+                      if policy is not None
+                      else tier_weight(request.priority))
+            # start tag clamps to the global virtual time: a tenant that
+            # sat idle (or starved) re-enters AT the front of the virtual
+            # timeline, never behind a busy tenant's accumulated backlog
+            start = max(self._vtime, self._finish_tag.get(tenant, 0.0))
+            cost = (len(request.prompt) + request.max_new_tokens) \
+                / max(weight, 1e-9)
+            request._vstart = start
+            request._vfinish = self._finish_tag[tenant] = start + cost
+            self._seq += 1
+            request._qseq = self._seq
+            request._queued = True
+            self._subq.setdefault(tenant, deque()).append(request)
+            self._depth += 1
+            _QUEUE_DEPTH.set(float(self._depth))
+            _TENANT_QUEUE.set(float(len(self._subq[tenant])), tenant=tenant)
         self.work_available.set()
         return request
 
+    # -- dispatch ------------------------------------------------------------
+
+    def _select_locked(self) -> Optional[Request]:
+        best = None
+        for q in self._subq.values():
+            head = q[0]
+            if best is None or (head._vfinish, head._qseq) < \
+                    (best._vfinish, best._qseq):
+                best = head
+        return best
+
+    def _remove_locked(self, req: Request) -> None:
+        q = self._subq.get(req.tenant)
+        if q is None or not req._queued:
+            return
+        if q and q[0] is req:
+            q.popleft()
+        else:
+            try:
+                q.remove(req)
+            except ValueError:
+                return
+        req._queued = False
+        self._depth -= 1
+        _TENANT_QUEUE.set(float(len(q)), tenant=req.tenant)
+        if not q:
+            del self._subq[req.tenant]
+            # a drained tenant whose finish tag fell behind the virtual
+            # clock carries no information — prune so the dict stays
+            # bounded by ACTIVE tenants
+            if self._finish_tag.get(req.tenant, 0.0) <= self._vtime:
+                self._finish_tag.pop(req.tenant, None)
+        _QUEUE_DEPTH.set(float(self._depth))
+        if self._head is req:
+            self._head = None
+
+    def _note_pop_locked(self, req: Request) -> None:
+        self._vtime = max(self._vtime, req._vstart)
+        # sweep drained tenants whose finish tag fell behind the virtual
+        # clock: their tag carries no information any more (a re-submit
+        # would clamp to vtime anyway), and with IAM on tenant ids are
+        # subject ids — without the sweep the dict grows by one entry
+        # per user EVER seen, not per active tenant
+        stale = [t for t, tag in self._finish_tag.items()
+                 if tag <= self._vtime and t not in self._subq]
+        for t in stale:
+            del self._finish_tag[t]
+        now = time.monotonic()
+        if self._last_pop is not None:
+            dt = now - self._last_pop
+            self._pop_interval_s += 0.2 * (dt - self._pop_interval_s)
+        # a pop that EMPTIES the queue ends the busy window: the gap to
+        # the next pop would measure idleness, not drain rate, and one
+        # 60s-idle sample would poison the retry-after hint for the next
+        # ~dozen rejections
+        self._last_pop = now if self._depth else None
+
     def pop(self) -> Optional[Request]:
         with self._lock:
-            req = self._q.popleft() if self._q else None
+            req = (self._head if self._head is not None
+                   and self._head._queued else self._select_locked())
             if req is not None:
-                now = time.monotonic()
-                if self._last_pop is not None:
-                    dt = now - self._last_pop
-                    self._pop_interval_s += 0.2 * (dt - self._pop_interval_s)
-                # a pop that EMPTIES the queue ends the busy window: the
-                # gap to the next pop would measure idleness, not drain
-                # rate, and one 60s-idle sample would poison the
-                # retry-after hint for the next ~dozen rejections
-                self._last_pop = now if self._q else None
-            _QUEUE_DEPTH.set(float(len(self._q)))
+                self._remove_locked(req)
+                self._note_pop_locked(req)
+            self._head = None
             return req
 
-    def peek(self) -> Optional[Request]:
-        """Head of the queue without removing it — the engine budgets a
-        request's KV blocks BEFORE committing to pop it (single consumer,
-        so peek-then-pop returns the same request)."""
+    def pop_request(self, req: Request) -> bool:
+        """Remove a SPECIFIC queued request (the engine admits by
+        candidate, not strictly by head: a tenant over its KV quota is
+        skipped without blocking the tenants behind it). False if the
+        request was no longer queued."""
         with self._lock:
-            return self._q[0] if self._q else None
+            if not req._queued:
+                return False
+            self._remove_locked(req)
+            self._note_pop_locked(req)
+            return True
+
+    def peek(self) -> Optional[Request]:
+        """Next request WFQ would dispatch, without removing it. The
+        head is pinned: a later submit (even one with an earlier virtual
+        finish tag) does not change what a subsequent :meth:`pop`
+        returns — the single-consumer peek-then-pop contract the engine's
+        budget-then-commit admission relies on."""
+        with self._lock:
+            if self._head is None or not self._head._queued:
+                self._head = self._select_locked()
+            return self._head
+
+    def candidates(self) -> List[Request]:
+        """Per-tenant head requests in WFQ dispatch order — the engine's
+        admission scans these so one tenant blocked on its own quota
+        never blocks another tenant's admissible head."""
+        with self._lock:
+            heads = [q[0] for q in self._subq.values()]
+        return sorted(heads, key=lambda r: (r._vfinish, r._qseq))
+
+    # -- maintenance ---------------------------------------------------------
 
     def reap_dead(self) -> List[Request]:
         """Remove every cancelled/expired request, wherever it sits in
         the queue — a passed deadline must terminate promptly even while
         every slot is busy, not when a slot finally frees."""
+        dead: List[Request] = []
         with self._lock:
-            dead = [r for r in self._q if r.cancelled or r.expired]
-            if dead:
-                self._q = deque(r for r in self._q
-                                if not (r.cancelled or r.expired))
-                _QUEUE_DEPTH.set(float(len(self._q)))
+            for q in list(self._subq.values()):
+                dead.extend(r for r in q if r.cancelled or r.expired)
+            for r in dead:
+                self._remove_locked(r)
         return dead
 
     def depth(self) -> int:
         with self._lock:
-            return len(self._q)
+            return self._depth
+
+    def depth_of(self, tenant: str) -> int:
+        with self._lock:
+            return len(self._subq.get(tenant, ()))
+
+    def tenants(self) -> List[str]:
+        """Tenants with queued work (dispatch-order-agnostic)."""
+        with self._lock:
+            return sorted(self._subq)
 
     def drain(self) -> List[Request]:
         """Empty the queue (shutdown path); returns the unserved requests."""
         with self._lock:
-            out = list(self._q)
-            self._q.clear()
+            out: List[Request] = []
+            for tenant, q in self._subq.items():
+                out.extend(q)
+                _TENANT_QUEUE.set(0.0, tenant=tenant)
+            for r in out:
+                r._queued = False
+            self._subq.clear()
+            self._depth = 0
+            self._head = None
             _QUEUE_DEPTH.set(0.0)
         return out
 

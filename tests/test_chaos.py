@@ -38,7 +38,8 @@ from lzy_tpu.models.llama import LlamaConfig
 from lzy_tpu.rpc.core import Unavailable
 from lzy_tpu.serving import (
     AdmissionError, DecodeEngine, InferenceEngine, PagedInferenceEngine,
-    PrefillEngine, RadixCache)
+    PrefillEngine, QuotaExceeded, RadixCache, SloLimiter, TenantPolicy,
+    TenantTable)
 from lzy_tpu.serving.scheduler import RequestQueue
 from lzy_tpu.utils.backoff import RetryPolicy
 
@@ -641,23 +642,26 @@ class TestAutoscalerStability:
 # the chaos soak: disagg gateway + faults at every registered point
 
 
-def _build_disagg(cfg, params, *, decode=2, prefill=1):
+def _build_disagg(cfg, params, *, decode=2, prefill=1, tenants=None,
+                  prefill_budget=None):
+    kw = dict(slots=2, page_size=PAGE, temperature=0.7,
+              tenants=tenants, prefill_budget=prefill_budget)
     decode_fleet = ReplicaFleet(
-        lambda: DecodeEngine(cfg, params, slots=2, page_size=PAGE,
-                             temperature=0.7),
+        lambda: DecodeEngine(cfg, params, **kw),
         replica_prefix="decode")
     prefill_fleet = ReplicaFleet(
-        lambda: PrefillEngine(cfg, params, slots=2, page_size=PAGE,
-                              temperature=0.7),
+        lambda: PrefillEngine(cfg, params, **kw),
         replica_prefix="prefill")
     scaler = Autoscaler(min_replicas=decode, max_replicas=decode + 1,
                         up_sustain_s=3600.0, down_sustain_s=3600.0,
                         cooldown_s=0.1)
+    slo = SloLimiter(tenants) if tenants is not None else None
     gw = DisaggGatewayService(
         decode_fleet, prefill_fleet, page_size=PAGE,
         router=PrefixAffinityRouter(PAGE),
         prefill_router=PrefixAffinityRouter(PAGE),
-        autoscaler=scaler, prefill_replicas=prefill, model_name="tiny")
+        autoscaler=scaler, prefill_replicas=prefill, model_name="tiny",
+        slo=slo)
     for _ in range(decode):
         decode_fleet.add_replica()
     for _ in range(prefill):
@@ -672,13 +676,30 @@ def _audit_all(gw, decode_fleet, prefill_fleet):
             audit_engine(replica.engine)
 
 
-def _chaos_round(tiny_model, seed, *, n_requests, max_faults):
+def _chaos_round(tiny_model, seed, *, n_requests, max_faults,
+                 tenants=False):
     """One seeded soak: mixed greedy+sampled traffic with faults armed
     at EVERY registered point; auditors after every request; greedy
-    bit-identical to the uninterrupted oracle."""
+    bit-identical to the uninterrupted oracle. With ``tenants`` the
+    traffic is two-tenant with heavy-tailed prompt lengths (an aggressor
+    dragging 10+-block prompts next to a short-prompt victim) through
+    the SLO layer — rate limits, WFQ, KV quotas, chunked prefill — and
+    the same auditors/oracle must hold."""
     cfg, params = tiny_model
     header = list(range(2 * PAGE))          # shared whole-block prefix
-    gw, decode_fleet, prefill_fleet = _build_disagg(cfg, params)
+    table = None
+    if tenants:
+        table = TenantTable(default=TenantPolicy(
+            requests_per_s=200.0, prompt_tokens_per_s=20000.0,
+            burst_s=1.0, kv_block_quota=24, max_queued=8))
+        table.set_policy(TenantPolicy(
+            tenant="agg", priority=2, requests_per_s=100.0,
+            prompt_tokens_per_s=8000.0, burst_s=1.0, kv_block_quota=20,
+            max_queued=6))
+        table.set_policy(TenantPolicy(tenant="vic", priority=0))
+    gw, decode_fleet, prefill_fleet = _build_disagg(
+        cfg, params, tenants=table,
+        prefill_budget=2 * PAGE if tenants else None)
     gw.fence_auditor = FenceAuditor()
     plan = CHAOS.arm(FaultPlan(
         seed, rate=0.08, modes=(ERROR, DELAY, CRASH),
@@ -686,14 +707,27 @@ def _chaos_round(tiny_model, seed, *, n_requests, max_faults):
     try:
         for i in range(n_requests):
             greedy = i % 2 == 0
-            prompt = header + [40 + (i * 7) % 20, 30 + i]
+            tenant = None
+            if tenants:
+                tenant = "agg" if i % 3 == 0 else "vic"
+            if tenants and tenant == "agg" and i % 6 == 0:
+                # the heavy tail: a 10-block prompt through chunked
+                # prefill while the victim's short prompts interleave
+                prompt = header + [(i * 5 + j) % 50 + 1
+                                   for j in range(10 * PAGE)]
+            else:
+                prompt = header + [40 + (i * 7) % 20, 30 + i]
             n = 10 + (i % 3)
             res = None
             for _ in range(30):         # shed/Unavailable => client retry
                 try:
                     res = gw.generate(prompt, max_new_tokens=n,
-                                      timeout_s=120, greedy=greedy)
+                                      timeout_s=120, greedy=greedy,
+                                      tenant=tenant)
                     break
+                except QuotaExceeded as e:
+                    # tenant-scoped shed: back off on ITS hint
+                    time.sleep(min(e.retry_after_s or 0.02, 0.05))
                 except Unavailable:
                     gw.tick()           # re-lease toward the floor
                     time.sleep(0.02)
@@ -736,6 +770,15 @@ class TestChaosSmoke:
         # nothing; the fixed seed makes this stable
         assert plan.fired > 0, plan.describe()
 
+    def test_fixed_seed_multi_tenant_smoke(self, tiny_model):
+        """Tier-1 twin with the SLO layer armed: two tenants,
+        heavy-tailed prompts, faults at every point INCLUDING the new
+        slo.admit admission boundary — auditors clean, greedy
+        bit-identical."""
+        plan = _chaos_round(tiny_model, seed=20260804, n_requests=6,
+                            max_faults=1, tenants=True)
+        assert plan.fired > 0, plan.describe()
+
 
 @pytest.mark.slow
 @pytest.mark.skipif(not os.environ.get("LZY_SLOW"),
@@ -754,4 +797,22 @@ class TestChaosSoak:
             total += plan.fired
         assert total > 0
         record_tier_run("chaos_soak",
+                        f"seeds={seeds} faults_fired={total}")
+
+    def test_multi_tenant_soak(self, tiny_model):
+        """The ISSUE-7 soak: two tenants (long-prompt aggressor,
+        short-prompt victim) with the SLO layer on — rate limits, WFQ,
+        KV quotas, chunked prefill — faults armed at every point, fence
+        and pool auditors after every request, greedy bit-identical."""
+        from tests.conftest import record_tier_run
+
+        env_seed = os.environ.get("LZY_CHAOS_SEED")
+        seeds = [int(env_seed)] if env_seed else [7, 19, 31]
+        total = 0
+        for seed in seeds:
+            plan = _chaos_round(tiny_model, seed, n_requests=12,
+                                max_faults=2, tenants=True)
+            total += plan.fired
+        assert total > 0
+        record_tier_run("chaos_soak_multi_tenant",
                         f"seeds={seeds} faults_fired={total}")

@@ -1,0 +1,82 @@
+"""Every registered metric must reach the generated Grafana dashboard.
+
+``tools/gen_dashboard.py`` builds panels from the metrics REGISTRY after
+importing a curated list of service modules. The failure mode this file
+pins: a new module registers ``lzy_*`` metrics but is never added to the
+generator's import list — the process registry sees the metric in tests
+(everything is imported here), the standalone generator does not, and
+the dashboard silently loses the panel. So:
+
+- the generator runs in a SUBPROCESS (its own imports only) and its
+  panel set must cover every metric this process can find by walking the
+  whole ``lzy_tpu`` package;
+- the committed ``deploy/grafana/dashboard.json`` must equal a fresh
+  generation (hand-edits and forgotten regens both fail loudly).
+"""
+
+import importlib
+import json
+import os
+import pkgutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DASHBOARD = os.path.join(REPO, "deploy", "grafana", "dashboard.json")
+
+
+def _walk_import_all():
+    """Import every importable lzy_tpu module so each one's metrics land
+    in the process REGISTRY. Modules with unavailable optional deps are
+    skipped — they cannot register metrics in production either."""
+    import lzy_tpu
+
+    for info in pkgutil.walk_packages(lzy_tpu.__path__,
+                                      prefix="lzy_tpu."):
+        try:
+            importlib.import_module(info.name)
+        except Exception:  # noqa: BLE001 — optional deps, script mains
+            pass
+
+
+def _registry_names():
+    from lzy_tpu.utils.metrics import REGISTRY
+
+    return set(REGISTRY._metrics)
+
+
+class TestDashboardCoversRegistry:
+    def test_every_registered_metric_has_a_panel(self):
+        _walk_import_all()
+        names = _registry_names()
+        assert names, "metric walk found nothing — broken test"
+        committed = json.load(open(DASHBOARD))
+        covered = set(committed.get("_generated_from", []))
+        missing = sorted(names - covered)
+        assert not missing, (
+            f"metrics registered in lzy_tpu but absent from the "
+            f"dashboard: {missing}. Add their module to "
+            f"tools/gen_dashboard.py registry_metrics() and run "
+            f"`python tools/gen_dashboard.py`.")
+
+    @pytest.mark.slow
+    def test_committed_dashboard_is_regenerated(self, tmp_path):
+        """A fresh standalone generation must byte-match the committed
+        dashboard — running the generator in a subprocess also proves
+        its OWN import list reaches every metric (no inherited
+        test-process imports). Slow tier: the subprocess pays a full
+        jax import."""
+        before = open(DASHBOARD, "rb").read()
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "gen_dashboard.py")],
+            cwd=tmp_path, env=env, capture_output=True, text=True,
+            timeout=300)
+        assert out.returncode == 0, out.stderr
+        after = open(DASHBOARD, "rb").read()
+        assert before == after, (
+            "deploy/grafana/dashboard.json is stale — commit the "
+            "regenerated file (python tools/gen_dashboard.py)")

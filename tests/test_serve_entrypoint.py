@@ -177,6 +177,52 @@ class TestServeModel:
                 proc.kill()
                 proc.wait(10)
 
+    def test_serve_slo_quota_over_the_wire(self, tmp_path):
+        """--serve-slo + --tenant-rps: the tenant's second request inside
+        the burst window comes back as the typed QuotaExceeded
+        (RESOURCE_EXHAUSTED) with the retry_after_s hint rehydrated from
+        the wire — the quota-exceeded status end to end through a real
+        server process."""
+        from lzy_tpu.rpc import RpcInferenceClient
+        from lzy_tpu.serving import QuotaExceeded
+
+        port = _free_port()
+        proc, banner = _spawn_serve([
+            "--db", str(tmp_path / "m.db"),
+            "--storage-uri", f"file://{tmp_path}/s",
+            "--port", str(port),
+            "--serve-model", "tiny",
+            "--serve-slots", "2",
+            "--serve-slo",
+            # 0.01 req/s: the first request's compile time (seconds)
+            # must not refill the bucket before the second call
+            "--tenant-rps", "0.01",
+            "--tenant-burst-s", "100",
+        ], timeout_s=120)
+        try:
+            client = RpcInferenceClient(f"127.0.0.1:{port}")
+            try:
+                res = client.generate([5, 9], max_new_tokens=2,
+                                      timeout_s=120, tenant="cust-a")
+                assert res["status"] == "ok"
+                with pytest.raises(QuotaExceeded) as ei:
+                    client.generate([5, 9], max_new_tokens=2,
+                                    timeout_s=120, tenant="cust-a")
+                assert "cust-a" in str(ei.value)
+                assert ei.value.retry_after_s is not None
+                # another tenant's bucket is untouched
+                assert client.generate([5, 9], max_new_tokens=2,
+                                       timeout_s=120,
+                                       tenant="cust-b")["status"] == "ok"
+            finally:
+                client.close()
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(30) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(10)
+
     def test_unknown_model_fails_fast(self, tmp_path):
         res = subprocess.run(
             [sys.executable, "-m", "lzy_tpu.service.serve",

@@ -36,6 +36,9 @@ def registry_metrics():
     import lzy_tpu.serving.scheduler  # noqa: F401
     # speculative decoding: proposed/accepted, acceptance rate, tok/step
     import lzy_tpu.serving.spec  # noqa: F401
+    # multi-tenant SLO: per-tenant requests/tokens/TTFT, queue depth,
+    # KV blocks, rate-bucket levels, sheds (lzy_tenant_*)
+    import lzy_tpu.serving.tenancy  # noqa: F401
     # gateway: routing hit rate, failovers, autoscale, per-replica load
     import lzy_tpu.gateway.fleet  # noqa: F401
     import lzy_tpu.gateway.router  # noqa: F401
