@@ -463,6 +463,18 @@ def run() -> None:
     if extra:
         detail.update(extra)
         emit()
+    extra = llm_op_pipeline_measurement(
+        jax, cfg, params,
+        replicas=2,
+        slots=4 if is_tpu else 2,
+        page_size=64 if is_tpu else 16,
+        prompt_len=128 if is_tpu else 32,
+        new_tokens=32 if is_tpu else 8,
+        n_conversations=6 if is_tpu else 3,
+        steps=3)
+    if extra:
+        detail.update(extra)
+        emit()
     if platform in ("tpu", "axon"):
         # each extra pass builds a whole second model+optimizer: evict the
         # previous one (buffers AND compiled executables) first or OOM
@@ -1195,6 +1207,130 @@ def slo_measurement(jax, cfg, params, *, slots: int, page_size: int,
                 "slo_prefill_budget": prefill_budget}
     except Exception as e:  # noqa: BLE001 — diagnostics only
         _log(f"slo skipped: {type(e).__name__}: {e}")
+        return {}
+
+
+def llm_op_pipeline_measurement(jax, cfg, params, *, replicas: int,
+                                slots: int, page_size: int,
+                                prompt_len: int, new_tokens: int,
+                                n_conversations: int, steps: int):
+    """Workflow-native inference point: interleaved multi-step
+    conversations (``llm.generate → tool op → llm.generate``) driven
+    through the WORKFLOW surface against a paged gateway fleet, next to
+    the same traffic as raw gateway submits — the surface-cost number —
+    and with session affinity on vs round-robin routing — the
+    conversation-locality number (aggregate radix prefix hit rate).
+    Wrapped so a hiccup never loses the headline metric."""
+    try:
+        from concurrent import futures as _futures
+
+        from lzy_tpu import Lzy, llm, op
+        from lzy_tpu.gateway import (
+            GatewayService, PrefixAffinityRouter, ReplicaFleet,
+            RoundRobinRouter)
+        from lzy_tpu.serving import PagedInferenceEngine
+        from lzy_tpu.storage import DefaultStorageRegistry, StorageConfig
+
+        @op
+        def extend(g, extra: list) -> list:
+            return g.full_tokens() + list(extra)
+
+        base_len = max(page_size, prompt_len - prompt_len % page_size)
+        prompts = [list(range(1, base_len + 1)) + [i % 50 + 2]
+                   for i in range(n_conversations)]
+
+        def build_gw(router):
+            fleet = ReplicaFleet(lambda: PagedInferenceEngine(
+                cfg, params, slots=slots, page_size=page_size,
+                max_queue=4 * n_conversations))
+            gw = GatewayService(fleet, router=router, model_name="bench",
+                                max_waiters=replicas * slots + 2)
+            for _ in range(replicas):
+                fleet.add_replica()
+            # warm prefill buckets + decode once, off-clock
+            gw.generate(prompts[0], max_new_tokens=2, timeout_s=600)
+            return gw, fleet
+
+        def drive_workflow(router, tag):
+            """steps rounds of one llm_op per conversation, rounds
+            barriered (step N+1 needs step N's output), conversations
+            fanning out through the graph executor's concurrency."""
+            gw, fleet = build_gw(router)
+            try:
+                llm.configure(gw)
+                reg = DefaultStorageRegistry()
+                reg.register_storage(
+                    "default",
+                    StorageConfig(uri=f"mem://bench-llm-{tag}"),
+                    default=True)
+                lzy = Lzy(storage_registry=reg)
+                convs = [llm.Conversation(f"bench-{tag}-{i}")
+                         for i in range(n_conversations)]
+                total = 0
+                t0 = time.perf_counter()
+                with lzy.workflow(f"bench-{tag}") as wf:
+                    cur = [list(p) for p in prompts]
+                    for s in range(steps):
+                        gens = []
+                        for i, conv in enumerate(convs):
+                            g = llm.generate(
+                                cur[i], max_new_tokens=new_tokens,
+                                greedy=True, cache=False,
+                                conversation=conv, timeout_s=600)
+                            gens.append(g)
+                            cur[i] = extend(g, [60 + i + s])
+                        wf.barrier()
+                        total += sum(len(list(g.tokens)) for g in gens)
+                dt = time.perf_counter() - t0
+                agg = fleet.aggregate()
+                hit = (agg["prefix_hit_tokens"]
+                       / max(1, agg["prefix_lookup_tokens"]))
+                return total / dt, round(hit, 4)
+            finally:
+                llm.configure(None)
+                gw.close()
+
+        def drive_raw():
+            """The same conversation traffic as raw gateway submits —
+            no workflow graph, no session hint (the pre-llm_op client
+            shape)."""
+            gw, _fleet = build_gw(PrefixAffinityRouter(page_size))
+            try:
+                def one_conv(i):
+                    cur, n = list(prompts[i]), 0
+                    for s in range(steps):
+                        res = gw.generate(cur,
+                                          max_new_tokens=new_tokens,
+                                          timeout_s=600, greedy=True)
+                        n += len(res["tokens"])
+                        cur = cur + res["tokens"] + [60 + i + s]
+                    return n
+                t0 = time.perf_counter()
+                with _futures.ThreadPoolExecutor(n_conversations) as pool:
+                    total = sum(pool.map(one_conv,
+                                         range(n_conversations)))
+                return total / (time.perf_counter() - t0)
+            finally:
+                gw.close()
+
+        _log(f"llm_op pipeline: {n_conversations} conversations x "
+             f"{steps} steps x {new_tokens} tokens, {replicas} "
+             f"replicas...")
+        tps_aff, hit_aff = drive_workflow(
+            PrefixAffinityRouter(page_size), "aff")
+        _tps_rr, hit_rr = drive_workflow(RoundRobinRouter(), "rr")
+        tps_raw = drive_raw()
+        _log(f"llm_op pipeline: {tps_aff:.1f} tok/s via workflow "
+             f"(raw gateway {tps_raw:.1f}); radix hit rate "
+             f"{hit_aff} affinity vs {hit_rr} round-robin")
+        return {"llm_op_pipeline_tokens_per_s": round(tps_aff, 1),
+                "llm_op_raw_gateway_tokens_per_s": round(tps_raw, 1),
+                "llm_op_affinity_prefix_hit_rate": hit_aff,
+                "llm_op_rr_prefix_hit_rate": hit_rr,
+                "llm_op_conversations": n_conversations,
+                "llm_op_steps": steps}
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        _log(f"llm_op pipeline skipped: {type(e).__name__}: {e}")
         return {}
 
 

@@ -1,0 +1,126 @@
+"""Agent pipeline through workflow-native inference (``lzy_tpu.llm``).
+
+The full join of the two stacks in one runnable file (CPU-friendly; the
+same code targets a TPU fleet by pointing ``llm.configure`` — or
+``LZY_LLM_ENDPOINT`` — at a deployed gateway):
+
+  1. a 2-replica serving gateway (paged engines, prefix-affinity
+     routing) is built in-process;
+  2. a 3-step ``generate → tool op → generate`` conversation runs as a
+     plain lzy workflow — each ``llm.generate`` is an ordinary op whose
+     typed ``Generation`` result flows through the graph;
+  3. the ``Conversation`` handle pins every step to the replica whose
+     RadixCache holds the earlier steps (watch ``routed_by``);
+  4. a second run of the same workflow is satisfied from the op cache —
+     the fleet is never touched;
+  5. the final generation lands on a versioned whiteboard, queryable
+     after the run.
+
+Run: ``python examples/agent_pipeline.py``
+
+See docs/serving.md ("Workflow-native inference") for the semantics.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "JAX_PLATFORMS" not in os.environ:          # default to CPU off-TPU
+    os.environ["JAX_PLATFORMS"] = "cpu"
+if os.environ.get("JAX_PLATFORMS"):
+    # config-level too: a site-pinned TPU plugin overrides env vars
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from lzy_tpu import Lzy, llm, op
+from lzy_tpu.channels.token_stream import TokenStreamChannel
+from lzy_tpu.storage import DefaultStorageRegistry, StorageConfig
+
+PAGE = 8
+
+
+def build_gateway():
+    """A 2-replica paged fleet behind one gateway — the in-process twin
+    of ``serve.py --gateway --serve-paged``."""
+    import jax as _jax
+
+    from lzy_tpu.gateway import (
+        GatewayService, PrefixAffinityRouter, ReplicaFleet)
+    from lzy_tpu.models import llama, unbox
+    from lzy_tpu.serving import PagedInferenceEngine
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=64)
+    boxed, _ = llama.init_params(cfg, _jax.random.PRNGKey(0))
+    params = unbox(boxed)
+    fleet = ReplicaFleet(lambda: PagedInferenceEngine(
+        cfg, params, slots=2, page_size=PAGE))
+    gw = GatewayService(fleet, router=PrefixAffinityRouter(PAGE),
+                        model_name="tiny")
+    for _ in range(2):
+        fleet.add_replica()
+    return gw
+
+
+@op
+def consult_tool(g: llm.Generation, observation: list) -> list:
+    """The 'tool' step of the agent loop: fold the model's output and
+    the tool's observation back into the next prompt."""
+    return g.full_tokens() + list(observation)
+
+
+def main():
+    gw = build_gateway()
+    llm.configure(gw)
+    reg = DefaultStorageRegistry()
+    reg.register_storage("default",
+                         StorageConfig(uri="file:///tmp/lzy-agent-demo"),
+                         default=True)
+    lzy = Lzy(storage_registry=reg)
+
+    conv = llm.Conversation("demo-conv")
+    stream = TokenStreamChannel()
+    try:
+        with lzy.workflow("agent") as wf:
+            prompt = list(range(16)) + [3]
+            g1 = llm.generate(prompt, max_new_tokens=8, greedy=True,
+                              conversation=conv)
+            p2 = consult_tool(g1, [41, 42])
+            g2 = llm.generate(p2, max_new_tokens=8, greedy=True,
+                              conversation=conv)
+            p3 = consult_tool(g2, [43])
+            g3 = llm.generate(p3, max_new_tokens=8, greedy=True,
+                              conversation=conv, stream=stream)
+            wb = llm.record_generation(wf, g3, conversation=conv)
+            steps = [(g.replica, g.routed_by, list(g.tokens))
+                     for g in (g1, g2, g3)]
+
+        for i, (replica, why, tokens) in enumerate(steps, start=1):
+            print(f"step {i}: replica={replica} routed_by={why} "
+                  f"tokens={tokens}")
+        print(f"stream (step 3, incremental): {stream.tokens()} "
+              f"status={stream.status}")
+        print(f"whiteboard version: {wb.id}")
+
+        found = lzy.whiteboards(name=llm.GENERATION_WB_NAME,
+                                tags=[f"conversation:{conv.id}"])
+        print(f"index round-trip: {len(found)} record(s); provenance "
+              f"{found[0].provenance}")
+
+        # greedy generations cache on (prompt, params, model digest):
+        # the second, identical run is satisfied from the op cache and
+        # the fleet is never touched
+        with lzy.workflow("cached"):
+            llm.generate(prompt, max_new_tokens=8, greedy=True)
+        served_before = gw.stats()["requests_finished"]
+        with lzy.workflow("cached"):
+            llm.generate(prompt, max_new_tokens=8, greedy=True)
+        print(f"cached re-run: fleet served {served_before} before, "
+              f"{gw.stats()['requests_finished']} after (unchanged)")
+    finally:
+        gw.close()
+
+
+if __name__ == "__main__":
+    main()

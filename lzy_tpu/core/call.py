@@ -26,6 +26,29 @@ if TYPE_CHECKING:
 class CacheSettings:
     cache: bool = False
     version: str = "0.0"
+    #: arg names (bound positional or keyword) excluded from the cache
+    #: key: operational knobs — timeouts, deadlines, stream wiring —
+    #: that cannot change the op's output must not fragment the cache
+    #: (``llm.generate`` threads its runtime options through here)
+    exclude_args: Tuple[str, ...] = ()
+
+
+def result_cacheable(func: Any, result: Any) -> bool:
+    """Per-RESULT cache veto, consulted by every runtime before a
+    cacheable op's output is persisted at its cache URI. An op that can
+    return degraded-but-valid values (``llm_generate``'s
+    deadline-truncated ``status="cancelled"`` generations) sets
+    ``func.__lzy_result_cacheable__ = lambda result: ...``; vetoed
+    results are still stored for this execution's consumers but never
+    satisfy a later cache check. A probe that itself fails vetoes —
+    never cache what cannot be judged."""
+    probe = getattr(func, "__lzy_result_cacheable__", None)
+    if probe is None:
+        return True
+    try:
+        return bool(probe(result))
+    except Exception:  # noqa: BLE001 — conservative: do not cache
+        return False
 
 
 class LzyCall:

@@ -197,6 +197,8 @@ class LzyWorkflow:
             parts = [call.op_name, call.cache_settings.version]
             named_inputs = list(zip(call.signature.param_names, call.arg_entry_ids))
             named_inputs += sorted(call.kwarg_entry_ids.items())
+            excluded = set(call.cache_settings.exclude_args)
+            named_inputs = [(n, e) for n, e in named_inputs if n not in excluded]
             for name, eid in named_inputs:
                 entry = snapshot.get_entry(eid)
                 if entry.hash:

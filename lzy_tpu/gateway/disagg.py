@@ -122,16 +122,31 @@ class DisaggGatewayService(GatewayService):
             meta = self._tls.meta = {}
         return meta
 
+    def _note_result(self, req) -> None:
+        """Terminal-attempt provenance: the decode engine records (at
+        prefix-match time) which imported blocks the request actually
+        HIT — i.e. which prefill replica really produced the KV it
+        decoded from. Staged-but-refused imports (pool pressure, lost
+        payload) leave this None and the request re-prefilled locally."""
+        self._meta()["kv_used_from"] = getattr(req, "kv_prefilled_by",
+                                               None)
+
     def _reply_extras(self) -> dict:
         meta = self._meta()
         return {
+            # the prefill replica whose KV the final serving attempt
+            # actually USED (its imported blocks matched at prefill) —
+            # None when the request re-prefilled locally, the prompt was
+            # sub-block, or no import was ever staged. A repeat prompt
+            # served straight from the decode replica's radix cache still
+            # credits the pool that originally produced those blocks —
+            # provenance follows the KV, not the transfer.
+            "prefilled_by": meta.get("kv_used_from"),
             # the prefill replica whose KV was STAGED for the final
-            # serving attempt (None: transfer skipped, sub-block prompt,
-            # or fallback). Staged, not "used": the decode engine folds
-            # imports in opportunistically, and a refusal under pool
-            # pressure silently re-prefills — by design the gateway
-            # never blocks a request on the import's fate
-            "prefilled_by": meta.get("prefilled_by"),
+            # attempt (the decode engine folds imports in
+            # opportunistically, so staged ≠ used: a refusal under pool
+            # pressure silently re-prefills)
+            "kv_staged_by": meta.get("prefilled_by"),
             "kv_transfer_ms": meta.get("kv_transfer_ms"),
             "kv_transfer_skipped": bool(meta.get("skipped", False)),
             "reprefills": int(meta.get("reprefills", 0)),

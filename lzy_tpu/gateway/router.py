@@ -46,6 +46,21 @@ _IMBALANCE = REGISTRY.gauge(
     "lzy_gateway_load_imbalance",
     "max - min replica load (queue depth + busy slots) at the last route")
 
+_SESSION_RATE = None
+
+
+def _session_rate_gauge():
+    """Lazy-cached ``lzy_llm_conversation_affinity_rate`` gauge: the
+    metric lives in the llm leaf module (the gateway must not import the
+    llm package at module scope — the llm backend layer imports gateway
+    surfaces), resolved at most once, never under the router lock."""
+    global _SESSION_RATE
+    if _SESSION_RATE is None:
+        from lzy_tpu.llm.metrics import CONVERSATION_AFFINITY_RATE
+
+        _SESSION_RATE = CONVERSATION_AFFINITY_RATE
+    return _SESSION_RATE
+
 
 def chunk_hashes(tokens: Sequence[int], page_size: int) -> List[int]:
     """Rolling hashes of the prompt's full ``page_size``-token chunks:
@@ -71,7 +86,8 @@ class PrefixAffinityRouter:
     """
 
     def __init__(self, page_size: int, *, max_imbalance: int = 4,
-                 index_chains_per_replica: int = 4096):
+                 index_chains_per_replica: int = 4096,
+                 max_sessions: int = 4096):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         self.page_size = page_size
@@ -79,6 +95,16 @@ class PrefixAffinityRouter:
         self._cap = index_chains_per_replica
         # replica -> {chain_hash: last_touch_clock}
         self._index: Dict[str, Dict[int, int]] = {}
+        # conversation pinning: session id -> (replica, last_touch clock).
+        # A session is the STABLE routing hint a multi-step conversation
+        # carries (llm.Conversation): step N+1's prompt extends step N's
+        # prompt + response, so the replica that served steps 1..N holds
+        # the deepest RadixCache prefix — pin unless the imbalance bound
+        # says otherwise. Bounded LRU like the chain index.
+        self._sessions: Dict[str, Tuple[str, int]] = {}
+        self._session_cap = max_sessions
+        self._session_routed = 0
+        self._session_hits = 0
         self._clock = 0
         self._routed = 0
         self._routed_prefix = 0
@@ -86,11 +112,19 @@ class PrefixAffinityRouter:
 
     # -- index ---------------------------------------------------------------
 
-    def observe(self, replica_id: str, tokens: Sequence[int]) -> None:
+    def observe(self, replica_id: str, tokens: Sequence[int],
+                session: Optional[str] = None) -> None:
         """Record that ``tokens`` were routed to ``replica_id`` — its
-        engine will now hold (or refresh) those prefix blocks."""
+        engine will now hold (or refresh) those prefix blocks.
+        ``session`` additionally pins that conversation to the replica."""
         with self._lock:
             self._clock += 1
+            if session is not None:
+                self._sessions[session] = (replica_id, self._clock)
+                if len(self._sessions) > self._session_cap:
+                    victim = min(self._sessions,
+                                 key=lambda s: self._sessions[s][1])
+                    del self._sessions[victim]
             idx = self._index.setdefault(replica_id, {})
             for depth, h in enumerate(
                     chunk_hashes(tokens, self.page_size)):
@@ -108,9 +142,21 @@ class PrefixAffinityRouter:
                     del idx[h]
 
     def forget(self, replica_id: str) -> None:
-        """Drop a removed/dead replica's index (its cache is gone)."""
+        """Drop a removed/dead replica's index (its cache is gone) and
+        unpin every conversation that lived on it (the next step re-pins
+        wherever it lands)."""
         with self._lock:
             self._index.pop(replica_id, None)
+            for session in [s for s, (rid, _) in self._sessions.items()
+                            if rid == replica_id]:
+                del self._sessions[session]
+
+    def session_replica(self, session: str) -> Optional[str]:
+        """The replica a conversation is currently pinned to (probe —
+        no LRU bump)."""
+        with self._lock:
+            pin = self._sessions.get(session)
+            return pin[0] if pin is not None else None
 
     def match_len(self, replica_id: str, tokens: Sequence[int]) -> int:
         """Expected cached prefix on ``replica_id``, in tokens.
@@ -135,38 +181,66 @@ class PrefixAffinityRouter:
 
     # -- choice --------------------------------------------------------------
 
-    def choose(self, tokens: Sequence[int],
-               loads: Dict[str, int]) -> Tuple[Optional[str], str]:
+    def choose(self, tokens: Sequence[int], loads: Dict[str, int],
+               session: Optional[str] = None) -> Tuple[Optional[str], str]:
         """Pick a replica from ``loads`` (replica_id -> queue+busy).
-        Returns ``(replica_id, reason)`` with reason ``"prefix"`` or
-        ``"load"``; ``(None, "empty")`` when no candidates exist. The
+        Returns ``(replica_id, reason)`` with reason ``"session"``,
+        ``"prefix"`` or ``"load"``; ``(None, "empty")`` when no
+        candidates exist. ``session`` (a conversation id) prefers the
+        pinned replica — subject to the SAME imbalance bound as prefix
+        affinity, so a hot conversation cannot melt one replica. The
         caller must :meth:`observe` the prompt on the chosen replica once
         the request is actually submitted."""
         if not loads:
             return None, "empty"
+        session_rate = None
         with self._lock:
-            # hash the prompt ONCE; matching each replica's index is then
-            # O(chunks) membership checks on the request hot path
-            hashes = chunk_hashes(tokens, self.page_size)
             min_load = min(loads.values())
-            best_id, best_match = None, 0
-            for rid in loads:
-                m = self._match_locked(rid, hashes)
-                if m > best_match:
-                    best_id, best_match = rid, m
-            if (best_id is not None
-                    and loads[best_id] <= min_load + self.max_imbalance):
-                choice, reason = best_id, "prefix"
-            else:
-                # least loaded; ties break on replica id for determinism
-                choice = min(sorted(loads), key=lambda r: loads[r])
-                reason = "load"
+            choice = reason = None
+            if session is not None:
+                pin = self._sessions.get(session)
+                # the rate counts only routes where a pin EXISTED: a
+                # conversation's first step cannot hit, and counting it
+                # as a miss would structurally deflate the gauge (a
+                # fleet of perfectly-pinned 2-step conversations would
+                # read 0.5)
+                if pin is not None:
+                    self._session_routed += 1
+                    if pin[0] in loads and \
+                            loads[pin[0]] <= min_load + self.max_imbalance:
+                        choice, reason = pin[0], "session"
+                        self._session_hits += 1
+                    session_rate = (self._session_hits
+                                    / self._session_routed)
+            if choice is None:
+                # hash the prompt ONCE; matching each replica's index is
+                # then O(chunks) membership checks on the request hot path
+                hashes = chunk_hashes(tokens, self.page_size)
+                best_id, best_match = None, 0
+                for rid in loads:
+                    m = self._match_locked(rid, hashes)
+                    if m > best_match:
+                        best_id, best_match = rid, m
+                if (best_id is not None
+                        and loads[best_id] <= min_load
+                        + self.max_imbalance):
+                    choice, reason = best_id, "prefix"
+                else:
+                    # least loaded; ties break on replica id for
+                    # determinism
+                    choice = min(sorted(loads), key=lambda r: loads[r])
+                    reason = "load"
             self._routed += 1
-            if reason == "prefix":
+            if reason in ("prefix", "session"):
                 self._routed_prefix += 1
             _ROUTED.inc(reason=reason)
             _PREFIX_RATE.set(self._routed_prefix / self._routed)
             _IMBALANCE.set(float(max(loads.values()) - min_load))
+        if session_rate is not None:
+            # outside the lock: the first set() imports the llm metrics
+            # leaf through its package __init__, which must not stall
+            # every concurrent route behind the router lock
+            _session_rate_gauge().set(session_rate)
         return choice, reason
 
     def stats(self) -> dict:
@@ -179,6 +253,11 @@ class PrefixAffinityRouter:
                     if self._routed else 0.0),
                 "indexed_chains": {r: len(i)
                                    for r, i in self._index.items()},
+                "sessions_pinned": len(self._sessions),
+                "session_routed": self._session_routed,
+                "session_affinity_rate": (
+                    round(self._session_hits / self._session_routed, 4)
+                    if self._session_routed else 0.0),
             }
 
 
@@ -194,7 +273,8 @@ class RoundRobinRouter:
         self._routed = 0
         self._lock = threading.Lock()
 
-    def observe(self, replica_id: str, tokens: Sequence[int]) -> None:
+    def observe(self, replica_id: str, tokens: Sequence[int],
+                session: Optional[str] = None) -> None:
         pass
 
     def forget(self, replica_id: str) -> None:
@@ -203,8 +283,11 @@ class RoundRobinRouter:
     def match_len(self, replica_id: str, tokens: Sequence[int]) -> int:
         return 0
 
-    def choose(self, tokens: Sequence[int],
-               loads: Dict[str, int]) -> Tuple[Optional[str], str]:
+    def session_replica(self, session: str) -> Optional[str]:
+        return None
+
+    def choose(self, tokens: Sequence[int], loads: Dict[str, int],
+               session: Optional[str] = None) -> Tuple[Optional[str], str]:
         if not loads:
             return None, "empty"
         with self._lock:
@@ -218,4 +301,6 @@ class RoundRobinRouter:
     def stats(self) -> dict:
         with self._lock:
             return {"routed_total": self._routed, "routed_by_prefix": 0,
-                    "prefix_route_rate": 0.0, "indexed_chains": {}}
+                    "prefix_route_rate": 0.0, "indexed_chains": {},
+                    "sessions_pinned": 0, "session_routed": 0,
+                    "session_affinity_rate": 0.0}

@@ -180,7 +180,9 @@ class GatewayService:
                  deadline_s: Optional[float] = None,
                  greedy: Optional[bool] = None,
                  tenant: Optional[str] = None,
-                 priority: Optional[int] = None) -> dict:
+                 priority: Optional[int] = None,
+                 session: Optional[str] = None,
+                 stream=None) -> dict:
         """Blocking generate over the fleet; same contract as the single
         engine's RPC surface plus route metadata (``replica``,
         ``routed_by``, ``failovers``) in the reply. Backpressure is
@@ -191,39 +193,61 @@ class GatewayService:
         deterministic — on the retry replica too). ``tenant``/``priority``
         are the SLO identity (docstring of :meth:`_resolve_tenant`);
         tenant-scoped refusals raise ``QuotaExceeded`` with a per-tenant
-        ``retry_after_s``."""
+        ``retry_after_s``.
+
+        ``session`` is a stable conversation id: the router pins it to
+        the replica whose RadixCache holds the conversation's earlier
+        steps (``routed_by: "session"``), within the load-imbalance
+        bound. ``stream`` (a ``channels.token_stream.TokenStreamChannel``)
+        receives tokens incrementally as the engine emits them; the
+        stream position IS the failover fence, so a mid-stream replica
+        death resumes the channel byte-identically (``resumptions``
+        ticks, the token sequence does not change). The channel is
+        closed with the request's terminal status before this method
+        returns — or failed before it raises IF any tokens were
+        published; an exception that never touched the stream leaves it
+        open for the caller's retry policy."""
         subject = self._auth(token)
         from lzy_tpu.rpc.core import Unavailable
 
-        tenant = self._resolve_tenant(subject, tenant)
-        prompt = any_to_tokens(prompt)
-        self._check_prompt_len(prompt, int(max_new_tokens))
-        policy = self._slo_admit(tenant, prompt)
-        if policy is not None:
-            priority = policy.effective_priority(priority)
-        if self._draining:
-            raise self._shed_error(
-                Unavailable, "gateway is draining; retry another endpoint",
-                reason="draining", retry_after_s=None)
-        if not self._waiters.acquire(blocking=False):
-            raise self._shed_error(
-                Unavailable,
-                "all gateway waiter threads are busy; retry later",
-                reason="waiters_busy", retry_after_s=0.25)
-        with self._lock:
-            self._inflight += 1
         try:
-            return self._generate(prompt,
-                                  int(max_new_tokens),
-                                  timeout_s=timeout_s or 120.0,
-                                  deadline_s=deadline_s,
-                                  greedy=greedy,
-                                  tenant=tenant,
-                                  priority=priority)
-        finally:
+            tenant = self._resolve_tenant(subject, tenant)
+            prompt = any_to_tokens(prompt)
+            self._check_prompt_len(prompt, int(max_new_tokens))
+            policy = self._slo_admit(tenant, prompt)
+            if policy is not None:
+                priority = policy.effective_priority(priority)
+            if self._draining:
+                raise self._shed_error(
+                    Unavailable,
+                    "gateway is draining; retry another endpoint",
+                    reason="draining", retry_after_s=None)
+            if not self._waiters.acquire(blocking=False):
+                raise self._shed_error(
+                    Unavailable,
+                    "all gateway waiter threads are busy; retry later",
+                    reason="waiters_busy", retry_after_s=0.25)
             with self._lock:
-                self._inflight -= 1
-            self._waiters.release()
+                self._inflight += 1
+            try:
+                return self._generate(prompt,
+                                      int(max_new_tokens),
+                                      timeout_s=timeout_s or 120.0,
+                                      deadline_s=deadline_s,
+                                      greedy=greedy,
+                                      tenant=tenant,
+                                      priority=priority,
+                                      session=session,
+                                      stream=stream)
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+                self._waiters.release()
+        except BaseException as e:
+            from lzy_tpu.channels.token_stream import fail_if_touched
+
+            fail_if_touched(stream, e)
+            raise
 
     def _shed_error(self, exc_type, msg: str, *, reason: str,
                     retry_after_s: Optional[float]):
@@ -238,7 +262,9 @@ class GatewayService:
                   timeout_s: float, deadline_s: Optional[float],
                   greedy: Optional[bool] = None,
                   tenant: str = DEFAULT_TENANT,
-                  priority: Optional[int] = None) -> dict:
+                  priority: Optional[int] = None,
+                  session: Optional[str] = None,
+                  stream=None) -> dict:
         from lzy_tpu.rpc.core import Unavailable
 
         t0 = time.monotonic()
@@ -262,6 +288,8 @@ class GatewayService:
                 # retry replica would only cancel anyway
                 if fence is not None:
                     fence.on_complete(emitted)
+                if stream is not None:
+                    stream.close("cancelled")
                 _REQUESTS.inc(status="cancelled")
                 with self._lock:
                     self._finished += 1
@@ -277,8 +305,15 @@ class GatewayService:
                 effective_prompt, remaining,
                 t0=t0, deadline_s=deadline_s,
                 exclude=tried_after_failure, greedy=greedy,
-                tenant=tenant, priority=priority)
+                tenant=tenant, priority=priority, session=session)
             route = (replica.id, routed_by)
+            if stream is not None:
+                # the fence is the stream position: this attempt's tokens
+                # land at len(emitted) + i, so a resumed attempt continues
+                # the channel exactly where the dead one stopped
+                from lzy_tpu.channels.token_stream import attach_request
+
+                attach_request(stream, req, len(emitted))
             if not req.wait(timeout=max(0.0,
                                         wall_deadline - time.monotonic())):
                 req.cancel()
@@ -305,6 +340,11 @@ class GatewayService:
                 emitted.extend(req.tokens)
                 if fence is not None:
                     fence.on_failover(emitted, prompt + emitted)
+                if stream is not None:
+                    # tokens already published up to the fence; the retry
+                    # attempt re-attaches at len(emitted) and the channel
+                    # continues byte-identically
+                    stream.note_resumption()
                 if not req.error.startswith(_CAPACITY_ERRORS):
                     self.fleet.health.record_failure(replica.id)
                     self.router.forget(replica.id)
@@ -340,6 +380,18 @@ class GatewayService:
             if fence is not None:
                 fence.on_complete(emitted)
             status = req.status or "ok"
+            self._note_result(req)
+            if session is not None:
+                # index the conversation TAIL (prompt + response) on the
+                # serving replica: step N+1's prompt extends exactly
+                # this sequence, so both the session pin and the chunk
+                # chains predict the next step's cache locality. An
+                # expectation is never authority — a stale one costs one
+                # redundant prefill, never a wrong token.
+                self.router.observe(replica.id, prompt + emitted,
+                                    session=session)
+            if stream is not None:
+                stream.close(status)
             with self._lock:
                 self._finished += 1
             _REQUESTS.inc(status=status)
@@ -360,6 +412,8 @@ class GatewayService:
         # on the boundary): the stream is complete
         if fence is not None:
             fence.on_complete(emitted)
+        if stream is not None:
+            stream.close("ok")
         with self._lock:
             self._finished += 1
         _REQUESTS.inc(status="ok")
@@ -386,7 +440,8 @@ class GatewayService:
                        t0: float, deadline_s: Optional[float],
                        exclude: set, greedy: Optional[bool] = None,
                        tenant: str = DEFAULT_TENANT,
-                       priority: Optional[int] = None):
+                       priority: Optional[int] = None,
+                       session: Optional[str] = None):
         """Route + submit with per-replica admission fallback: a replica
         refusing admission (full queue, closed engine) drops out of the
         candidate set and the next-best one is tried; only an empty set
@@ -401,7 +456,8 @@ class GatewayService:
                  if rid not in exclude}
         last_err: Optional[Exception] = None
         while loads:
-            rid, reason = self.router.choose(prompt, loads)
+            rid, reason = self.router.choose(prompt, loads,
+                                             session=session)
             replica = self.fleet.get(rid)
             # try_route CLAIMS a half-open breaker's single probe — at
             # dispatch, not during enumeration, so listing passes that
@@ -446,7 +502,7 @@ class GatewayService:
                 # claim must not outlive the attempt
                 self.fleet.health.release_probe(rid)
                 raise
-            self.router.observe(rid, prompt)
+            self.router.observe(rid, prompt, session=session)
             return replica, reason, req
         # fleet-wide refusal: shed with the most informative hint we
         # have — an engine's own queue estimate, else the soonest
@@ -483,6 +539,12 @@ class GatewayService:
         stages KV here — bounded by the request's REMAINING deadline,
         queued under the request's tenant)."""
         return True
+
+    def _note_result(self, req) -> None:
+        """Hook: the terminal request of a (possibly failed-over)
+        generate, observed before the reply is built — subclasses read
+        request-side provenance off it (the disagg gateway records which
+        prefill pool's KV the final attempt actually used)."""
 
     def _reply_extras(self) -> dict:
         """Extra route metadata merged into every reply — subclasses

@@ -1,0 +1,52 @@
+"""Workflow-native LLM inference (``lzy_tpu.llm``).
+
+The bridge between the dataflow product (``@op`` + workflows + channels
++ whiteboards) and the serving stack (engines, gateway, disagg,
+tenancy): ``llm.generate`` is an op factory whose results flow through
+the graph as typed :class:`Generation` values, with conversation
+prefix-affinity, token streaming, op-cache semantics, and whiteboard
+provenance. See ``docs/serving.md`` ("Workflow-native inference").
+
+Typical use::
+
+    from lzy_tpu import llm
+
+    llm.configure(gateway_service)          # once per process
+    conv = llm.Conversation("support-123")
+    with lzy.workflow("agent") as wf:
+        g1 = llm.generate(prompt, greedy=True, conversation=conv)
+        p2 = build_followup(g1)             # a plain @op
+        g2 = llm.generate(p2, greedy=True, conversation=conv)
+        llm.record_generation(wf, g2, conversation=conv)
+"""
+
+from lzy_tpu.llm.backend import (
+    EngineBackend, LlmBackendError, ServiceBackend, configure,
+    model_digest_for, resolve_backend)
+from lzy_tpu.llm.op import (
+    Conversation, DISPATCH_RETRIES_POLICY, Generation, LLM_OP_NAME,
+    LlmDispatchError, generate, generate_batch, llm_generate,
+    llm_generate_batch)
+from lzy_tpu.llm.wb import (
+    GENERATION_WB_NAME, GenerationRecord, record_generation)
+
+__all__ = [
+    "Conversation",
+    "DISPATCH_RETRIES_POLICY",
+    "EngineBackend",
+    "GENERATION_WB_NAME",
+    "Generation",
+    "GenerationRecord",
+    "LLM_OP_NAME",
+    "LlmBackendError",
+    "LlmDispatchError",
+    "ServiceBackend",
+    "configure",
+    "generate",
+    "generate_batch",
+    "llm_generate",
+    "llm_generate_batch",
+    "model_digest_for",
+    "record_generation",
+    "resolve_backend",
+]

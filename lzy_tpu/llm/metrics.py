@@ -1,0 +1,50 @@
+"""``lzy_llm_*`` metrics: the workflow-native inference surface.
+
+Deliberately a leaf module (imports only the metrics registry): the
+counters are shared by layers that must not import each other — the
+``llm`` op body, the token-stream channel (resumptions), the gateway
+router (conversation affinity), and the workflow service (cache drops of
+``llm_generate`` tasks) — so everyone lazy-imports THIS module and no
+cycle can form.
+"""
+
+from __future__ import annotations
+
+from lzy_tpu.utils.metrics import REGISTRY
+
+#: generations executed through the llm op surface, by terminal status
+#: (a cache hit never reaches the op body and therefore never counts
+#: here — it counts under ``lzy_llm_cached_hits_total`` instead)
+GENERATIONS = REGISTRY.counter(
+    "lzy_llm_generations_total",
+    "llm_op generations executed against the serving plane, by status")
+
+#: tokens produced through the llm op surface
+GENERATED_TOKENS = REGISTRY.counter(
+    "lzy_llm_generated_tokens_total",
+    "tokens generated through the llm_op surface")
+
+#: llm_op calls satisfied from the workflow result cache — the fleet was
+#: never touched
+CACHED_HITS = REGISTRY.counter(
+    "lzy_llm_cached_hits_total",
+    "llm_op calls satisfied from the op result cache (no fleet dispatch)")
+
+#: token streams resumed at the fence after a mid-stream replica death
+STREAM_RESUMPTIONS = REGISTRY.counter(
+    "lzy_llm_stream_resumptions_total",
+    "token streams resumed byte-identically after a mid-stream failover")
+
+#: share of PINNED session routes that landed on the conversation's
+#: pinned replica (the RadixCache that holds its prior steps); a
+#: conversation's first step has no pin yet and does not count
+CONVERSATION_AFFINITY_RATE = REGISTRY.gauge(
+    "lzy_llm_conversation_affinity_hit_rate",
+    "cumulative share of pinned conversation routes that kept their "
+    "pinned replica (first steps, which cannot hit, are not counted)")
+
+#: retries of the llm dispatch boundary (chaos point ``llm.dispatch``
+#: and real transient gateway refusals both land here)
+DISPATCH_RETRIES = REGISTRY.counter(
+    "lzy_llm_dispatch_retries_total",
+    "llm_op dispatch attempts retried after a transient dispatch error")

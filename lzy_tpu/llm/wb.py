@@ -1,0 +1,64 @@
+"""Whiteboard integration: generations as versioned, queryable records.
+
+A generation that mattered (the final answer of an agent pipeline, a
+labeled eval sample) should outlive the workflow that produced it — the
+platform's answer to that is whiteboards (``lzy_tpu/whiteboards``):
+storage-native manifests with time/name/tag indexes. This module gives
+generations a canonical schema and a one-call recorder; every record is
+one more *version* under the ``llm_generation`` name, queryable by
+conversation/step tags (``lzy.whiteboards(name=..., tags=[...])``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from lzy_tpu.llm.op import Conversation, Generation
+from lzy_tpu.whiteboards.decl import whiteboard
+
+#: the durable whiteboard name every generation records under
+GENERATION_WB_NAME = "llm_generation"
+
+
+@whiteboard(GENERATION_WB_NAME)
+@dataclasses.dataclass
+class GenerationRecord:
+    """One generation, versioned: the inputs that determine it (prompt,
+    params, model digest), the output token ids, and the per-step
+    provenance (replica, routing reason, failovers, KV producer,
+    conversation/step, latency)."""
+
+    prompt: list
+    params: dict
+    model: str
+    model_digest: str
+    tokens: list
+    provenance: dict
+
+
+def record_generation(workflow, generation: Generation, *,
+                      tags: Sequence[str] = (),
+                      conversation: Optional[Conversation] = None):
+    """Record ``generation`` (a :class:`Generation` or its lazy proxy —
+    touching a proxy materializes it, which is correct here: recording
+    happens at most once per run and the workflow exit barrier would
+    force it anyway) as a finalized whiteboard. Conversation/step tags
+    are added automatically so versions of one conversation are one
+    query. Returns the writable whiteboard (its ``id`` is the version
+    handle)."""
+    tags = list(tags)
+    conv_id = conversation.id if conversation is not None \
+        else generation.conversation_id
+    if conv_id:
+        tags.append(f"conversation:{conv_id}")
+    if generation.step is not None:
+        tags.append(f"step:{generation.step}")
+    wb = workflow.create_whiteboard(GenerationRecord, tags=tags)
+    wb.prompt = list(generation.prompt)
+    wb.params = dict(generation.params)
+    wb.model = generation.model
+    wb.model_digest = generation.model_digest
+    wb.tokens = list(generation.tokens)
+    wb.provenance = generation.provenance()
+    return wb

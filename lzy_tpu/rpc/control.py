@@ -348,6 +348,7 @@ class ControlPlaneServer:
                     greedy=p.get("greedy"),
                     tenant=p.get("tenant"),
                     priority=p.get("priority"),
+                    session=p.get("session"),
                     token=p.get("token")),
                 "InferStats": lambda p: _infer_svc().stats(
                     token=p.get("token")),
@@ -764,7 +765,8 @@ class RpcInferenceClient:
                  deadline_s: Optional[float] = None,
                  greedy: Optional[bool] = None,
                  tenant: Optional[str] = None,
-                 priority: Optional[int] = None) -> dict:
+                 priority: Optional[int] = None,
+                 session: Optional[str] = None) -> dict:
         """``prompt``: list of token ids. Returns ``{"request_id",
         "tokens", "status", "ttft_ms", "model"}`` (generated ids only, no
         echo). ``deadline_s`` is the engine-side client deadline: past it
@@ -786,6 +788,7 @@ class RpcInferenceClient:
             "greedy": greedy,
             "tenant": tenant,
             "priority": priority,
+            "session": session,
             "token": _token_value(self._token),
         }, timeout_s=rpc_timeout)
 

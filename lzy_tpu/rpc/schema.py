@@ -272,15 +272,21 @@ REQUESTS: Dict[str, Schema] = {
     # it), "routed_by" ("prefix" | "load" | "round_robin"), and
     # "failovers" (mid-stream resubmissions, 0 on the happy path). A
     # disaggregated plane (--disagg) additionally carries "prefilled_by"
-    # (the prefill replica whose KV blocks were STAGED for the serving
-    # attempt — the decode engine folds staged blocks in opportunistically
-    # and a refused import degrades to local re-prefill; null when the
-    # transfer was skipped or fell back), "kv_transfer_ms"
+    # (the prefill replica whose KV blocks the serving attempt actually
+    # USED — its imported blocks matched at prefill; null when the
+    # request re-prefilled locally or the prompt was sub-block),
+    # "kv_staged_by" (the replica whose KV was STAGED for the attempt —
+    # the decode engine folds imports in opportunistically, so staged
+    # may exceed used), "kv_transfer_ms"
     # (prefill wait + transport + import-queue latency),
     # "kv_transfer_skipped" (decode replica already held the prefix) and
     # "reprefills" (prefill-pool/transfer failures absorbed by local
     # re-prefill) — unknown reply fields are preserved by older clients
-    # (proto3 rule). "greedy" is the per-request sampling override
+    # (proto3 rule). "session" is a stable conversation id: a
+    # gateway-fronted plane pins it to the replica whose radix cache
+    # holds the conversation's earlier steps ("routed_by": "session");
+    # single-engine planes accept and ignore it.
+    # "greedy" is the per-request sampling override
     # (true → argmax decoding for this request even on a sampling
     # engine, which also makes it eligible for speculative decoding
     # under serve.py --serve-spec; absent/null → engine default).
@@ -300,7 +306,8 @@ REQUESTS: Dict[str, Schema] = {
         "deadline_s": f(float, int),
         "greedy": f(bool),
         "tenant": f(str),
-        "priority": f(int), **_TOKEN}),
+        "priority": f(int),
+        "session": f(str), **_TOKEN}),
     "InferStats": Schema("InferStatsRequest", {**_TOKEN}),
     # gateway-only: per-replica fleet breakdown (serve.py --gateway). On
     # a disaggregated plane each row carries "pool" ("prefill"|"decode")

@@ -34,3 +34,21 @@ class Runtime(abc.ABC):
     @abc.abstractmethod
     def abort(self, workflow: "LzyWorkflow") -> None:
         """Teardown after a failed workflow; running tasks are stopped."""
+
+    def auth_context(self) -> dict:
+        """The identity this runtime executes as — ``{"user": ...}`` for
+        an authenticated remote session, ``{}`` locally. Call factories
+        that thread identity into their op inputs (``llm.generate``
+        resolves the serving tenant from it) read this at registration
+        time, in the client's thread, where the workflow is active."""
+        return {}
+
+    def in_process(self) -> bool:
+        """True when op bodies run in the CLIENT's process: live
+        (unserializable) objects registered here — token-stream
+        channels, the process-global llm backend — are visible to them.
+        Call factories use this to reject wiring that silently goes
+        nowhere on a multi-process runtime (a live channel object cannot
+        travel; only its id does, and a worker resolving that id gets a
+        fresh channel in ITS process)."""
+        return False

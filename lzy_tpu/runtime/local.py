@@ -16,6 +16,7 @@ from __future__ import annotations
 import traceback
 from typing import TYPE_CHECKING, Any, Dict, List, Sequence, Set
 
+from lzy_tpu.core.call import result_cacheable
 from lzy_tpu.core.workflow import RemoteCallError
 from lzy_tpu.runtime.api import Runtime
 from lzy_tpu.utils.log import get_logger, logging_context
@@ -28,6 +29,9 @@ _LOG = get_logger(__name__)
 
 
 class LocalRuntime(Runtime):
+    def in_process(self) -> bool:
+        return True
+
     def start(self, workflow: "LzyWorkflow") -> None:
         _LOG.info("local execution started")
 
@@ -74,6 +78,17 @@ class LocalRuntime(Runtime):
 
         if call.cache_settings.cache and self._cache_hit(workflow, call):
             _LOG.info("cache hit, skipping op %s", call.op_name)
+            # ops that care about being skipped (llm_generate counts a
+            # fleet-free cached generation) opt in via a function attr —
+            # the hook must never fail the hit it is reporting
+            hook = getattr(call.signature.func, "__lzy_on_cache_hit__",
+                           None)
+            if hook is not None:
+                try:
+                    hook()
+                except Exception:  # noqa: BLE001 — observability only
+                    _LOG.exception("on-cache-hit hook failed for %s",
+                                   call.op_name)
             return
 
         args = tuple(snapshot.get(eid) for eid in call.arg_entry_ids)
@@ -102,8 +117,11 @@ class LocalRuntime(Runtime):
             )
             self._store_exception(workflow, call, e)
             raise RemoteCallError(call.op_name, e) from e
+        cacheable = True
+        if call.cache_settings.cache:
+            cacheable = result_cacheable(call.signature.func, result)
         for eid, value in zip(call.result_entry_ids, outputs):
-            snapshot.put(eid, value)
+            snapshot.put(eid, value, cacheable=cacheable)
 
     @staticmethod
     def _cache_hit(workflow: "LzyWorkflow", call: "LzyCall") -> bool:

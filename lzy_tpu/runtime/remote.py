@@ -53,6 +53,11 @@ class RemoteRuntime(Runtime):
         self._executions: Dict[str, str] = {}   # workflow exec id (client side = server side)
         self._printed_logs: Dict[str, int] = {}
 
+    def auth_context(self) -> dict:
+        """The session identity (never the credential: tokens stay out
+        of op inputs and therefore out of snapshot storage)."""
+        return {"user": self._user}
+
     # -- Runtime ---------------------------------------------------------------
 
     def start(self, workflow: "LzyWorkflow") -> None:

@@ -77,7 +77,9 @@ class InferenceService:
                  deadline_s: Optional[float] = None,
                  greedy: Optional[bool] = None,
                  tenant: Optional[str] = None,
-                 priority: Optional[int] = None) -> dict:
+                 priority: Optional[int] = None,
+                 session: Optional[str] = None,
+                 stream=None) -> dict:
         """Blocking generate: admit, wait, return generated token ids.
         Backpressure (full queue OR all waiter threads busy) surfaces as
         ``Unavailable`` BEFORE any work happens — safe for the caller to
@@ -93,7 +95,13 @@ class InferenceService:
         when IAM is wired); tenant-scoped refusals raise
         ``QuotaExceeded`` (RESOURCE_EXHAUSTED on the wire) with a
         per-tenant ``retry_after_s``; over-long prompts raise
-        ``PromptTooLong`` (INVALID_ARGUMENT) at admission."""
+        ``PromptTooLong`` (INVALID_ARGUMENT) at admission. ``session``
+        is accepted for surface parity with the gateway (a routing hint
+        is meaningless with one engine); ``stream`` (a
+        ``channels.token_stream.TokenStreamChannel``) receives tokens
+        incrementally and is closed before this returns — or failed
+        before it raises if any tokens were published (a never-touched
+        stream is left open for the caller's retry policy)."""
         subject = self._auth(token)
         from lzy_tpu.rpc.core import Unavailable
 
@@ -137,6 +145,10 @@ class InferenceService:
                     Unavailable, str(e), reason="admission",
                     retry_after_s=getattr(e, "retry_after_s", None),
                 ) from None
+            if stream is not None:
+                from lzy_tpu.channels.token_stream import attach_request
+
+                attach_request(stream, req, 0)
             if not req.wait(timeout=timeout_s or 120.0):
                 req.cancel()
                 raise TimeoutError(
@@ -145,6 +157,13 @@ class InferenceService:
             if req.error and req.status != "cancelled":
                 raise RuntimeError(f"request {req.id} failed: {req.error}")
             tokens = list(req.tokens)
+            if stream is not None:
+                stream.close(req.status or "ok")
+        except BaseException as e:
+            from lzy_tpu.channels.token_stream import fail_if_touched
+
+            fail_if_touched(stream, e)
+            raise
         finally:
             self._waiters.release()
         ttft_ms = None
@@ -303,6 +322,13 @@ def build_gateway_service(
     except BaseException:
         service.close()
         raise
+    # cache identity for llm_op: what this plane serves, honestly keyed
+    # on config + weight provenance (llm/backend.model_digest_for)
+    from lzy_tpu.llm.backend import model_digest_for
+
+    service.model_digest = model_digest_for(model, cfg,
+                                            checkpoint=checkpoint,
+                                            seed=seed)
     if start:
         service.start()
     return service
@@ -414,6 +440,11 @@ def build_disagg_gateway_service(
     except BaseException:
         service.close()
         raise
+    from lzy_tpu.llm.backend import model_digest_for
+
+    service.model_digest = model_digest_for(model, cfg,
+                                            checkpoint=checkpoint,
+                                            seed=seed)
     if start:
         service.start()
     return service
@@ -487,4 +518,10 @@ def build_inference_service(
         from lzy_tpu.serving.tenancy import SloLimiter
 
         slo = SloLimiter(tenants)
-    return InferenceService(engine, model_name=model, slo=slo)
+    service = InferenceService(engine, model_name=model, slo=slo)
+    from lzy_tpu.llm.backend import model_digest_for
+
+    service.model_digest = model_digest_for(model, cfg,
+                                            checkpoint=checkpoint,
+                                            seed=seed)
+    return service

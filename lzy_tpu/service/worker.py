@@ -373,8 +373,11 @@ class WorkerAgent:
                         spill_with_vote(self._storage, ref.uri, value)
                 return
 
+            from lzy_tpu.core.call import result_cacheable
+
+            cacheable = result_cacheable(func, result)
             for ref, value in zip(task.outputs, outputs):
-                self._write_entry(ref, value)
+                self._write_entry(ref, value, cacheable=cacheable)
                 self._channels.transfer_completed(ref.id)
 
     # -- environment assembly (execution-env parity) ---------------------------
@@ -492,7 +495,8 @@ class WorkerAgent:
         finally:
             src.close()
 
-    def _write_entry(self, ref, value: Any) -> None:
+    def _write_entry(self, ref, value: Any, *,
+                     cacheable: bool = True) -> None:
         import json
 
         from lzy_tpu.channels.sharded_spill import is_global_array
@@ -532,14 +536,18 @@ class WorkerAgent:
         from lzy_tpu.utils import hashing
 
         scheme = serializer.data_scheme(value)
+        doc = {
+            "hash": hashing.hash_bytes(data),
+            "data_format": scheme.data_format,
+            "schema_content": scheme.schema_content,
+            "meta": scheme.meta,
+        }
+        if not cacheable:
+            # op vetoed caching this result (result_cacheable): stored
+            # for this execution's consumers, never a future cache hit
+            doc["cacheable"] = False
         self._storage.write_bytes(
-            ref.uri + ".meta",
-            json.dumps({
-                "hash": hashing.hash_bytes(data),
-                "data_format": scheme.data_format,
-                "schema_content": scheme.schema_content,
-                "meta": scheme.meta,
-            }).encode("utf-8"),
+            ref.uri + ".meta", json.dumps(doc).encode("utf-8"),
         )
 
     def _write_global_entry(self, ref, value: Any) -> None:

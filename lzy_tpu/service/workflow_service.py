@@ -350,6 +350,16 @@ class WorkflowService:
         dropped = {t.id for t in graph.tasks} - {t.id for t in remaining}
         if dropped:
             _LOG.info("cache drops %d/%d tasks", len(dropped), len(graph.tasks))
+            llm_drops = sum(1 for t in graph.tasks if t.id in dropped
+                            and t.name in ("llm_generate",
+                                           "llm_generate_batch"))
+            if llm_drops:
+                # a cache-dropped generation never touches the fleet —
+                # the llm metrics module owns the counter (leaf import,
+                # no cycle)
+                from lzy_tpu.llm.metrics import CACHED_HITS
+
+                CACHED_HITS.inc(llm_drops)
 
         # CreateChannels: every entry of the remaining tasks gets a channel;
         # channels for inputs that already exist in storage open completed
@@ -373,10 +383,21 @@ class WorkflowService:
         return graph_op_id
 
     def _cached(self, task) -> bool:
-        return all(
-            self._storage.exists(o.uri) and self._storage.exists(o.uri + ".meta")
-            for o in task.outputs
-        )
+        import json
+
+        for o in task.outputs:
+            if not (self._storage.exists(o.uri)
+                    and self._storage.exists(o.uri + ".meta")):
+                return False
+            try:
+                doc = json.loads(self._storage.read_bytes(o.uri + ".meta"))
+            except Exception:  # noqa: BLE001 — unreadable meta: no hit
+                return False
+            # an op-vetoed result (e.g. a deadline-truncated generation)
+            # is stored but must never satisfy a cache check
+            if doc.get("cacheable", True) is False:
+                return False
+        return True
 
     def graph_status(self, execution_id: str, graph_op_id: str, *,
                      token: Optional[str] = None) -> Dict[str, Any]:

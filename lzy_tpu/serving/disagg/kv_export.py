@@ -128,6 +128,9 @@ def import_kv(engine, export: KVBlockExport) -> int:
         _LOG.warning("kv import failed (%s: %s); falling back to local "
                      "prefill", type(e).__name__, e)
         return 0
-    engine.kv.insert(tokens, blocks)
+    # provenance rides the tree: requests whose prefix match hits these
+    # nodes record which prefill replica really produced their KV
+    engine.kv.insert(tokens, blocks,
+                     origin=getattr(export, "prefilled_by", None))
     engine.kv.release(blocks)         # stays cached-unreferenced in the tree
     return n

@@ -944,6 +944,17 @@ class InferenceEngine:
         length limit. ``active`` distinguishes a slot-resident request
         (needs freeing) from one still mid-insertion."""
         req.tokens.append(token)
+        sink = req.token_sink
+        if sink is not None:
+            # token streaming (channels/token_stream): deliver while the
+            # request is still decoding. Guarded — a consumer bug must
+            # cost the consumer its stream, never the engine its loop
+            try:
+                sink(req)
+            except Exception:  # noqa: BLE001 — stream-side failure
+                _LOG.exception("token sink failed for %s; detaching",
+                               req.id)
+                req.token_sink = None
         self._tokens_out += 1
         _TOKENS.inc()
         TENANT_TOKENS.inc(tenant=req.tenant)
@@ -1381,6 +1392,11 @@ class PagedInferenceEngine(InferenceEngine):
         # least one real token remains to forward (logits for the first
         # generated token must come from an actual prefill position)
         blocks, matched = self.kv.match(prompt[:-1])
+        # provenance: if any matched block arrived via a KV import, the
+        # prefill pool that produced it really served this prefix — the
+        # disagg gateway reports it as `prefilled_by` (used, not staged)
+        req.kv_prefilled_by = (
+            self.kv.chain_origin(prompt[:matched]) if matched else None)
         suffix = prompt[matched:]
         plan = prefill_plan(len(suffix), self.prefill_chunk,
                             self.cfg.max_seq_len - matched)

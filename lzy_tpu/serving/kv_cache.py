@@ -139,7 +139,8 @@ class BlockPool:
 class _Node:
     """One radix-tree node: a full block whose edge key is its token chunk."""
 
-    __slots__ = ("chunk", "block", "children", "parent", "last_access")
+    __slots__ = ("chunk", "block", "children", "parent", "last_access",
+                 "origin")
 
     def __init__(self, chunk: Optional[Tuple[int, ...]], block: Optional[int],
                  parent: Optional["_Node"]):
@@ -148,6 +149,10 @@ class _Node:
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.parent = parent
         self.last_access = 0
+        # provenance: which remote producer (disagg prefill replica) this
+        # block's KV came from; None = computed locally. Read by
+        # chain_origin so replies can say who REALLY produced the KV.
+        self.origin: Optional[str] = None
 
 
 class RadixCache:
@@ -242,11 +247,15 @@ class RadixCache:
         eviction order."""
         return len(self._walk(tokens)) * self.page_size
 
-    def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> int:
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int],
+               origin: Optional[str] = None) -> int:
         """Register full-chunk ``blocks`` (one per ``page_size`` chunk of
         ``tokens``) in the tree; returns how many nodes were newly created.
         Chunks that already have a node keep the existing block — the
-        caller's duplicate block simply stays private to its request."""
+        caller's duplicate block simply stays private to its request.
+        ``origin`` tags NEWLY created nodes with the remote producer of
+        their KV (a disagg prefill replica id); existing nodes keep their
+        provenance (whoever computed the resident bytes)."""
         self._clock += 1
         node = self._root
         created = 0
@@ -254,6 +263,7 @@ class RadixCache:
             child = node.children.get(chunk)
             if child is None:
                 child = _Node(chunk, block, node)
+                child.origin = origin
                 node.children[chunk] = child
                 self._node_of[block] = child
                 created += 1
@@ -261,6 +271,16 @@ class RadixCache:
             node = child
         self._update_gauges()
         return created
+
+    def chain_origin(self, tokens: Sequence[int]) -> Optional[str]:
+        """Remote producer of the cached prefix covering ``tokens``, if
+        any node in the matched chain was imported (first imported node
+        wins — the deepest local extension rides on that producer's
+        prefix). Read-only: no refs, no metrics, no LRU bump."""
+        for child in self._walk(tokens):
+            if child.origin is not None:
+                return child.origin
+        return None
 
     # -- allocation / eviction ----------------------------------------------
 

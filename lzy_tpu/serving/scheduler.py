@@ -207,6 +207,17 @@ class Request:
             if deadline_s is not None else None)
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        #: optional per-token hook (``channels.token_stream.attach_request``
+        #: wires a stream here): called by the engine loop after every
+        #: emission with this request; the engine guards it — a consumer
+        #: bug must never kill the decode loop. None costs one attribute
+        #: load per emitted token.
+        self.token_sink = None
+        #: provenance: the prefill-pool replica whose imported KV blocks
+        #: this request's prefix match actually HIT (None: locally
+        #: prefilled, dense engine, or no match) — set by the paged
+        #: engine at prefill staging, read by the disagg gateway's reply
+        self.kv_prefilled_by: Optional[str] = None
         self._done = threading.Event()
         # WFQ bookkeeping (owned by RequestQueue): virtual start/finish
         # tags, arrival sequence, and the queued flag

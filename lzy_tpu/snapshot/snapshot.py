@@ -95,10 +95,18 @@ class Snapshot:
         with self._lock:
             self._entries[entry_id].storage_uri = uri
 
-    def put(self, entry_id: str, value: Any) -> SnapshotEntry:
+    def put(self, entry_id: str, value: Any, *,
+            cacheable: bool = True) -> SnapshotEntry:
         """Serialize into a spooled temp stream (spills to disk past 64 MB),
         then stream to storage while hashing — a checkpoint-sized value never
-        holds more than one serialized copy in RAM."""
+        holds more than one serialized copy in RAM.
+
+        ``cacheable=False`` stores the object normally (downstream
+        consumers of THIS execution read it) but poisons it for cache
+        hits: a later execution's :meth:`try_restore_entry` returns False
+        and the op re-runs. Ops veto caching of a specific result (e.g. a
+        deadline-truncated generation) via the ``__lzy_result_cacheable__``
+        function hook the runtimes consult."""
         entry = self.get_entry(entry_id)
         serializer = self._serializers.find_by_instance(value)
         with tempfile.SpooledTemporaryFile(max_size=64 << 20) as tmp:
@@ -108,7 +116,7 @@ class Snapshot:
             self._client.write(entry.storage_uri, reader)
             entry.hash = reader.hexdigest()
         entry.data_scheme = serializer.data_scheme(value)
-        self._write_meta(entry)
+        self._write_meta(entry, cacheable=cacheable)
         return entry
 
     def get(self, entry_id: str) -> Any:
@@ -137,20 +145,27 @@ class Snapshot:
     # and the content hash — hashes feed downstream cache keys, which must be
     # stable across runs (SURVEY.md §5.4).
 
-    def _write_meta(self, entry: SnapshotEntry) -> None:
+    def _write_meta(self, entry: SnapshotEntry, *,
+                    cacheable: bool = True) -> None:
         doc = {
             "hash": entry.hash,
             "data_format": entry.data_scheme.data_format if entry.data_scheme else None,
             "schema_content": entry.data_scheme.schema_content if entry.data_scheme else None,
             "meta": entry.data_scheme.meta if entry.data_scheme else {},
         }
+        if not cacheable:
+            doc["cacheable"] = False
         self._client.write_bytes(
             entry.storage_uri + ".meta", json.dumps(doc).encode("utf-8")
         )
 
     def try_restore_entry(self, entry_id: str) -> bool:
         """Rehydrate scheme+hash from the sidecar for an entry whose object
-        already exists in storage (cache hit). Returns False if absent."""
+        already exists in storage (cache hit). Returns False if absent —
+        or if the stored object was marked non-cacheable (a result its op
+        vetoed, e.g. a deadline-truncated generation): scheme and hash
+        are still restored so same-execution consumers can read it, but
+        the False verdict makes a cache check re-run the op."""
         entry = self.get_entry(entry_id)
         meta_uri = entry.storage_uri + ".meta"
         if not self._client.exists(entry.storage_uri) or not self._client.exists(meta_uri):
@@ -163,7 +178,7 @@ class Snapshot:
                 schema_content=doc.get("schema_content") or "",
                 meta=doc.get("meta") or {},
             )
-        return True
+        return doc.get("cacheable", True) is not False
 
     def _resolve_serializer(self, entry: SnapshotEntry):
         if entry.data_scheme is not None:

@@ -326,18 +326,24 @@ class TestDisaggParity:
             assert res["prefilled_by"] is None
             pf = prefill_fleet.replicas()[0]
             assert pf.engine.stats().requests_finished == 0
-            # first long prompt: transferred
+            # first long prompt: transferred (staged AND used — the
+            # decode engine's prefix match hit the imported blocks)
             shared = list(range(16))
             first = gw.generate(shared + [40, 41], max_new_tokens=3,
                                 timeout_s=120)
             assert first["prefilled_by"] is not None
-            # repeat of the shared prefix: affinity-routed, transfer skipped
+            assert first["kv_staged_by"] == first["prefilled_by"]
+            # repeat of the shared prefix: affinity-routed, transfer
+            # skipped — nothing newly staged, but the KV actually used
+            # still credits the pool that produced it (provenance
+            # follows the blocks, not the transfer)
             again = gw.generate(shared + [50], max_new_tokens=3,
                                 timeout_s=120)
             assert again["tokens"] == _oracle_tokens(
                 cfg, params, shared + [50], 3)
             assert again["kv_transfer_skipped"] is True
-            assert again["prefilled_by"] is None
+            assert again["kv_staged_by"] is None
+            assert again["prefilled_by"] == first["prefilled_by"]
             assert again["replica"] == first["replica"]
             s = gw.stats()
             assert s["kv_transfer_skipped_by_cache"] == 1

@@ -51,6 +51,9 @@ def registry_metrics():
     # requests (lzy_chaos_* / lzy_breaker_* / lzy_shed_*)
     import lzy_tpu.chaos.faults  # noqa: F401
     import lzy_tpu.gateway.health  # noqa: F401
+    # workflow-native inference: generations, cached hits, stream
+    # resumptions, conversation affinity (lzy_llm_*)
+    import lzy_tpu.llm.metrics  # noqa: F401
     from lzy_tpu.utils.metrics import Counter, Gauge, Histogram, REGISTRY
 
     kinds = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
