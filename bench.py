@@ -660,12 +660,23 @@ def paged_decode_measurement(jax, cfg, params, *, batch_size: int,
                              prompt_len: int, new_tokens: int,
                              page_size: int):
     """Best-effort paged-serving point: decode throughput through the
-    PAGED attention path (gather KV blocks by page table — the hot loop
-    of serving.PagedInferenceEngine), measured next to the dense
-    ``decode_tokens_per_s`` so the per-step cost of the block gather is a
-    number, not a guess. Pure-throughput shape: identity page tables, the
-    cache index parked at ``prompt_len`` (step cost does not depend on
-    what the K/V bytes contain). Two extra compiles, wrapped so a hiccup
+    PAGED attention path (the hot loop of serving.PagedInferenceEngine),
+    measured next to the dense ``decode_tokens_per_s`` so the per-step
+    cost of paging is a number, not a guess. Three variants per round so
+    the trajectory separates kernel wins from config drift:
+
+    - the NATIVE path (ops/paged_attention: pallas on TPU, the lax
+      oracle elsewhere) is the headline ``paged_decode_tokens_per_s``;
+    - the LEGACY gather-back-to-dense path rides along as
+      ``paged_decode_legacy_tokens_per_s`` (the pre-PR-9 number);
+    - the native path over an int8-quantized pool
+      (``paged_decode_quant_tokens_per_s``) shows what halved KV bytes
+      cost/buy per step at identical shapes.
+
+    ``kernel_path`` (pallas/lax/legacy) and ``kv_quant`` are recorded in
+    the row. Pure-throughput shape: identity page tables, the cache
+    index parked at ``prompt_len`` (step cost does not depend on what
+    the K/V bytes contain). A few extra compiles, wrapped so a hiccup
     never loses the headline metric."""
     try:
         import dataclasses
@@ -676,48 +687,100 @@ def paged_decode_measurement(jax, cfg, params, *, batch_size: int,
         from lzy_tpu.models.generate import (
             _set_cache_index, decode_config, init_cache)
         from lzy_tpu.models.llama import Llama
+        from lzy_tpu.ops.paged_attention import default_kernel
 
         pages_per_seq = cfg.max_seq_len // page_size
         n_pages = batch_size * pages_per_seq + 1
-        dcfg = dataclasses.replace(
-            decode_config(cfg), decode_paged=True, kv_page_size=page_size,
-            kv_pages=n_pages)
-        model = Llama(dcfg)
         pt = jnp.arange(
             1, batch_size * pages_per_seq + 1, dtype=jnp.int32
         ).reshape(batch_size, pages_per_seq)
-        _log("paged decode: compiling...")
-        cache = init_cache(lambda: model.init(
-            jax.random.PRNGKey(0), jnp.zeros((batch_size, 1), jnp.int32),
-            page_table=pt))
-        cache = _set_cache_index(cache, prompt_len)
+        native_kernel = default_kernel()
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def step(cache, params, tok, pt):
-            logits, updated = model.apply(
-                {"params": params, "cache": cache}, tok, page_table=pt,
-                mutable=["cache"])
-            return (updated["cache"],
-                    jnp.argmax(logits[:, -1], -1).astype(jnp.int32))
+        def time_variant(tag, **over):
+            dcfg = dataclasses.replace(
+                decode_config(cfg), decode_paged=True,
+                kv_page_size=page_size, kv_pages=n_pages, **over)
+            model = Llama(dcfg)
+            _log(f"paged decode[{tag}]: compiling...")
+            cache = init_cache(lambda: model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((batch_size, 1), jnp.int32), page_table=pt))
+            cache = _set_cache_index(cache, prompt_len)
 
-        cur = jnp.zeros((batch_size,), jnp.int32)
-        # two warm steps — same second-layout reasoning as the dense probe
-        cache, cur = step(cache, params, cur[:, None], pt)  # compile+warmup
-        cache, cur = step(cache, params, cur[:, None], pt)
-        cur.block_until_ready()
-        _log(f"paged decode: timing {new_tokens} steps x "
-             f"batch {batch_size}...")
-        t0 = time.perf_counter()
-        for _ in range(new_tokens):
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(cache, params, tok, pt):
+                logits, updated = model.apply(
+                    {"params": params, "cache": cache}, tok,
+                    page_table=pt, mutable=["cache"])
+                return (updated["cache"],
+                        jnp.argmax(logits[:, -1], -1).astype(jnp.int32))
+
+            cur = jnp.zeros((batch_size,), jnp.int32)
+            # two warm steps — same second-layout reasoning as the dense
+            # probe
             cache, cur = step(cache, params, cur[:, None], pt)
-        cur.block_until_ready()
-        dt = time.perf_counter() - t0
-        tps = batch_size * new_tokens / dt
-        _log(f"paged decode: {1000 * dt / new_tokens:.2f} ms/step, "
-             f"{tps:.1f} tok/s (page {page_size})")
-        return {"paged_decode_tokens_per_s": round(tps, 1),
-                "paged_decode_step_ms": round(1000 * dt / new_tokens, 3),
-                "paged_decode_page_size": page_size}
+            cache, cur = step(cache, params, cur[:, None], pt)
+            cur.block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(new_tokens):
+                cache, cur = step(cache, params, cur[:, None], pt)
+            cur.block_until_ready()
+            dt = time.perf_counter() - t0
+            tps = batch_size * new_tokens / dt
+            _log(f"paged decode[{tag}]: {1000 * dt / new_tokens:.2f} "
+                 f"ms/step, {tps:.1f} tok/s (page {page_size})")
+            _free_buffers(cache)
+            return tps, 1000 * dt / new_tokens
+
+        # legacy FIRST: the variant proven green on every pre-PR-9 round
+        # is banked before the native path gets a chance to hiccup, so
+        # the headline can fall back to it instead of vanishing
+        out = {"paged_decode_page_size": page_size,
+               "paged_decode_kv_quant": "off"}
+        tps_legacy = step_ms_legacy = None
+        try:
+            tps_legacy, step_ms_legacy = time_variant("legacy")
+            out["paged_decode_legacy_tokens_per_s"] = round(tps_legacy, 1)
+        except Exception as e:  # noqa: BLE001 — variant is optional
+            _log(f"paged decode legacy variant skipped: "
+                 f"{type(e).__name__}: {e}")
+        try:
+            tps, step_ms = time_variant(
+                native_kernel, paged_attention_native=True,
+                paged_kernel=native_kernel)
+            out["paged_decode_kernel_path"] = native_kernel
+        except Exception as e:  # noqa: BLE001 — fall back to legacy
+            if tps_legacy is None:
+                raise
+            _log(f"paged decode native variant failed "
+                 f"({type(e).__name__}: {e}); legacy headline")
+            tps, step_ms = tps_legacy, step_ms_legacy
+            out["paged_decode_kernel_path"] = "legacy"
+        out["paged_decode_tokens_per_s"] = round(tps, 1)
+        out["paged_decode_step_ms"] = round(step_ms, 3)
+        try:
+            tps_quant, _ = time_variant(
+                f"{native_kernel}+int8", paged_attention_native=True,
+                paged_kernel=native_kernel, kv_quant="int8")
+            out["paged_decode_quant_tokens_per_s"] = round(tps_quant, 1)
+            out["paged_decode_quant_mode"] = "int8"
+            # observed quantizer error on a representative KV sample
+            # (feeds the lzy_kernel_dequant_error_ewma gauge; the timing
+            # loop's pool holds zeros, whose error would read as 0.0)
+            from lzy_tpu.ops.paged_attention import (
+                dequantize_kv, note_dequant_error, quantize_kv)
+
+            sample = jax.random.normal(
+                jax.random.PRNGKey(0), (1024, cfg.head_dim), jnp.float32)
+            qs, ss, zs = quantize_kv(sample)
+            err = float(jnp.mean(jnp.abs(
+                dequantize_kv(qs, ss, zs, jnp.float32) - sample)))
+            out["paged_decode_dequant_err_mean"] = round(
+                note_dequant_error(err), 6)
+        except Exception as e:  # noqa: BLE001 — variant is optional
+            _log(f"paged decode quant variant skipped: "
+                 f"{type(e).__name__}: {e}")
+        return out
     except Exception as e:  # noqa: BLE001 — diagnostics only
         _log(f"paged decode skipped: {type(e).__name__}: {e}")
         return {}
@@ -804,21 +867,16 @@ def spec_decode_measurement(jax, cfg, params, *, slots: int,
         predicted = round(sum(s for s, _ in scored[:slots]) / slots, 2)
 
         # -- raw verify loop (methodology twin of paged_decode) ----------
+        # runs the NATIVE paged-attention path (pallas on TPU, lax
+        # elsewhere): the stream-equals-generate() assertion below then
+        # re-proves the native verify's bit-identity on every bench round
+        from lzy_tpu.ops.paged_attention import default_kernel
+
+        native_kernel = default_kernel()
         B, gamma, width = slots, spec_tokens, spec_tokens + 1
         pages_per_seq = cfg.max_seq_len // page_size
-        dcfg = dataclasses.replace(
-            decode_config(cfg), decode_paged=True, kv_page_size=page_size,
-            kv_pages=B * pages_per_seq + 1)
-        model = Llama(dcfg)
         pt = jnp.arange(1, B * pages_per_seq + 1, dtype=jnp.int32).reshape(
             B, pages_per_seq)
-
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def chunk_step(cache, params, toks, pt):
-            logits, upd = model.apply(
-                {"params": params, "cache": cache}, toks, page_table=pt,
-                mutable=["cache"])
-            return upd["cache"], jnp.argmax(logits, -1).astype(jnp.int32)
 
         def set_index_rows(cache, pos):
             vals = np.asarray(pos, np.int32)
@@ -830,27 +888,61 @@ def spec_decode_measurement(jax, cfg, params, *, slots: int,
                     getattr(p, "key", None) == "index" for p in path)
                 else leaf, cache)
 
+        def build_and_warm(native: bool):
+            dcfg = dataclasses.replace(
+                decode_config(cfg), decode_paged=True,
+                kv_page_size=page_size, kv_pages=B * pages_per_seq + 1,
+                paged_attention_native=native,
+                paged_kernel=native_kernel if native else "lax")
+            model = Llama(dcfg)
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def chunk_step(cache, params, toks, pt):
+                logits, upd = model.apply(
+                    {"params": params, "cache": cache}, toks,
+                    page_table=pt, mutable=["cache"])
+                return upd["cache"], jnp.argmax(logits, -1).astype(
+                    jnp.int32)
+
+            cache = init_cache(lambda: model.init(
+                jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32),
+                page_table=pt))
+            # real prefill (acceptance depends on real logits, unlike
+            # the content-independent paged probe): one [B, prompt_len]
+            # chunk
+            cache, am = chunk_step(cache, params,
+                                   jnp.asarray(prompts, jnp.int32), pt)
+            am = np.asarray(am)
+            # two warm verify calls (fresh-input layout, then committed
+            # jit-output layout — distinct compilations under sharded
+            # params); any native-path compile failure surfaces HERE,
+            # before the timing loop, where the fallback can catch it
+            pos0 = np.full((B,), prompt_len, np.int64)
+            toks0 = np.zeros((B, width), np.int32)
+            cache, _ = chunk_step(set_index_rows(cache, pos0), params,
+                                  jnp.asarray(toks0), pt)
+            cache, warm = chunk_step(set_index_rows(cache, pos0), params,
+                                     jnp.asarray(toks0), pt)
+            warm.block_until_ready()
+            return chunk_step, cache, am
+
+        # native-first with the same legacy fallback as the paged probe:
+        # a kernel hiccup must cost the kernel win, never the whole
+        # spec trajectory
         _log("spec decode: compiling + prefill...")
-        cache = init_cache(lambda: model.init(
-            jax.random.PRNGKey(0), jnp.zeros((B, 1), jnp.int32),
-            page_table=pt))
-        # real prefill (acceptance depends on real logits, unlike the
-        # content-independent paged probe): one [B, prompt_len] chunk
-        cache, am = chunk_step(cache, params,
-                               jnp.asarray(prompts, jnp.int32), pt)
+        kernel_path = native_kernel
+        try:
+            chunk_step, cache, am = build_and_warm(True)
+        except Exception as e:  # noqa: BLE001 — fall back to legacy
+            _log(f"spec decode native path failed ({type(e).__name__}: "
+                 f"{e}); legacy kernel")
+            kernel_path = "legacy"
+            chunk_step, cache, am = build_and_warm(False)
         # per-row incremental n-gram index (what the engine keeps per
         # slot); its .seq doubles as the row's emitted history
         rows = [proposer.index(list(p) + [int(am[r, -1])])
                 for r, p in enumerate(prompts)]
         pos = np.full((B,), prompt_len, np.int64)
-        # two warm verify calls (fresh-input layout, then committed
-        # jit-output layout — distinct compilations under sharded params)
-        toks0 = np.zeros((B, width), np.int32)
-        cache, _ = chunk_step(set_index_rows(cache, pos), params,
-                              jnp.asarray(toks0), pt)
-        cache, am = chunk_step(set_index_rows(cache, pos), params,
-                               jnp.asarray(toks0), pt)
-        am.block_until_ready()
         emitted = np.ones((B,), np.int64)   # the prefill's argmax token
         rounds = proposed = accepted = 0
         _log(f"spec decode: predicted {predicted} tok/step; timing "
@@ -905,7 +997,8 @@ def spec_decode_measurement(jax, cfg, params, *, slots: int,
         def drive(g: int):
             eng = PagedInferenceEngine(
                 cfg, params, slots=slots, page_size=page_size,
-                max_queue=2 * slots + 2, spec_tokens=g)
+                max_queue=2 * slots + 2, spec_tokens=g,
+                native_attention=kernel_path != "legacy")
             try:
                 # two warm requests: layout reasoning as above
                 for i in (7, 9):
@@ -932,6 +1025,8 @@ def spec_decode_measurement(jax, cfg, params, *, slots: int,
                 "spec_acceptance_rate": acc,
                 "spec_tokens_per_step": tok_step,
                 "spec_gamma": spec_tokens,
+                "spec_decode_kernel_path": kernel_path,
+                "spec_decode_kv_quant": "off",
                 "spec_engine_decode_tokens_per_s": round(eng_on, 1),
                 "spec_engine_off_decode_tokens_per_s": round(eng_off, 1)}
     except Exception as e:  # noqa: BLE001 — diagnostics only

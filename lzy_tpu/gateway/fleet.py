@@ -109,6 +109,7 @@ class ReplicaFleet:
             "requests_finished": 0, "tokens_generated": 0,
             "prefix_hit_tokens": 0, "prefix_lookup_tokens": 0,
             "spec_proposed_tokens": 0, "spec_accepted_tokens": 0,
+            "spec_draft_truncated": 0,
             "decode_steps": 0, "decode_rows": 0, "decode_tokens": 0}
         # per-tenant twin of the banked totals (terminal counters only —
         # live gauges like queue depth die with the replica)
@@ -236,6 +237,8 @@ class ReplicaFleet:
                     s.tokens_generated
                 for key, attr in (("spec_proposed_tokens", "spec_proposed"),
                                   ("spec_accepted_tokens", "spec_accepted"),
+                                  ("spec_draft_truncated",
+                                   "spec_draft_truncated"),
                                   ("decode_steps", "decode_steps"),
                                   ("decode_rows", "decode_rows"),
                                   ("decode_tokens", "decode_tokens")):
@@ -336,6 +339,8 @@ class ReplicaFleet:
             agg["tokens_generated"] += s.tokens_generated
             for key, attr in (("spec_proposed_tokens", "spec_proposed"),
                               ("spec_accepted_tokens", "spec_accepted"),
+                              ("spec_draft_truncated",
+                               "spec_draft_truncated"),
                               ("decode_steps", "decode_steps"),
                               ("decode_rows", "decode_rows"),
                               ("decode_tokens", "decode_tokens")):

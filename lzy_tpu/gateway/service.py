@@ -747,6 +747,7 @@ class GatewayService:
             "spec_accepted_tokens": agg["spec_accepted_tokens"],
             "spec_acceptance_rate": round(spec_rate, 4),
             "spec_tokens_per_step": round(spec_tps, 4),
+            "spec_draft_truncated": agg["spec_draft_truncated"],
             # per-tenant breakdown (operator view only — this branch)
             "tenants": self.fleet.aggregate_tenants(),
         }

@@ -80,6 +80,22 @@ class LlamaConfig:
     decode_paged: bool = False
     kv_page_size: int = 16
     kv_pages: int = 0
+    # native paged-attention read path (ops/paged_attention.py): attention
+    # reads K/V directly through the page table — the dense [B, L, ...]
+    # copy of the pool is never materialized. False keeps the legacy
+    # gather-back-to-dense path (bit-identical to the dense engine, and
+    # the oracle the native kernels are tested against).
+    paged_attention_native: bool = False
+    # which native kernel under paged_attention_native: "lax" (portable
+    # gather-attention, bit-identical to the legacy path by construction)
+    # or "pallas" (fused block-walk kernel; interpreted off-TPU)
+    paged_kernel: str = "lax"
+    # int8 per-block KV quantization (paged cache only): pooled K/V are
+    # stored int8 with per-position/per-head scale+zero-point sidecars
+    # riding next to the pool — half the payload bytes, so ~2x resident
+    # blocks at fixed HBM. Output is intentionally NOT bit-identical to
+    # fp (bounded divergence; see ops/paged_attention.quantize_kv).
+    kv_quant: Optional[str] = None
     # logits-free loss: the model returns (features, head) and the loss uses
     # chunked_cross_entropy — saves the [B,T,V] activation (ops/chunked_ce.py)
     fused_ce: bool = False
@@ -283,23 +299,48 @@ class Attention(nn.Module):
         is shared with the dense path, which is what keeps the two paths
         bit-identical (the paged gather reproduces the dense layout
         exactly; garbage in padded/unwritten slots is masked to a 0.0
-        softmax weight the same way in both)."""
+        softmax weight the same way in both). With
+        ``cfg.paged_attention_native`` the read side skips the dense
+        gather entirely and computes attention THROUGH the page table
+        (``ops/paged_attention``; kernel per ``cfg.paged_kernel``), and
+        with ``cfg.kv_quant`` the pools store int8 with scale/zero-point
+        sidecar cache leaves (quantize on scatter-write, dequantize on
+        read — every read path uses the same formula)."""
         cfg = self.cfg
         h, kv_heads, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
         L = cfg.max_seq_len
         t = q.shape[1]
+        quant = cfg.kv_quant is not None
+        if quant and cfg.kv_quant != "int8":
+            raise ValueError(
+                f"unknown kv_quant {cfg.kv_quant!r}; known: int8")
+        if (quant or cfg.paged_attention_native) and not cfg.decode_paged:
+            raise ValueError(
+                "kv_quant / paged_attention_native require decode_paged "
+                "(the dense cache has no page table to read through)")
+        quant_side = None
         if cfg.decode_paged:
             if cfg.kv_pages < 2 or L % cfg.kv_page_size:
                 raise ValueError(
                     f"decode_paged needs kv_pages >= 2 and max_seq_len "
                     f"({L}) divisible by kv_page_size ({cfg.kv_page_size})")
             page = cfg.kv_page_size
+            kv_store = jnp.int8 if quant else cfg.dtype
             cache_k = self.variable(
                 "cache", "k", jnp.zeros,
-                (cfg.kv_pages, page, kv_heads, d), cfg.dtype)
+                (cfg.kv_pages, page, kv_heads, d), kv_store)
             cache_v = self.variable(
                 "cache", "v", jnp.zeros,
-                (cfg.kv_pages, page, kv_heads, d), cfg.dtype)
+                (cfg.kv_pages, page, kv_heads, d), kv_store)
+            if quant:
+                # per-position/per-head scale+zero-point sidecars riding
+                # next to the int8 pools, scattered through the SAME
+                # (block row, offset) addressing as the payload
+                quant_side = [
+                    self.variable("cache", name, jnp.zeros,
+                                  (cfg.kv_pages, page, kv_heads),
+                                  jnp.float32)
+                    for name in ("k_scale", "k_zp", "v_scale", "v_zp")]
             index = self.variable(
                 "cache", "index", lambda: jnp.zeros((b,), jnp.int32))
         else:
@@ -333,10 +374,24 @@ class Attention(nn.Module):
                 rows = jnp.take_along_axis(page_table, pos // page, axis=1)
                 offs = (pos % page).reshape(-1)
                 rows = rows.reshape(-1)
-                cache_k.value = cache_k.value.at[rows, offs].set(
-                    k.astype(cfg.dtype).reshape(b * t, kv_heads, d))
-                cache_v.value = cache_v.value.at[rows, offs].set(
-                    v.astype(cfg.dtype).reshape(b * t, kv_heads, d))
+                flat_k = k.astype(cfg.dtype).reshape(b * t, kv_heads, d)
+                flat_v = v.astype(cfg.dtype).reshape(b * t, kv_heads, d)
+                if quant:
+                    # quantize on scatter-write: the pool stores int8 of
+                    # EXACTLY what the fp path would have stored (the
+                    # cfg.dtype-rounded K/V), so divergence is purely the
+                    # int8 step, never a dtype-path difference
+                    from lzy_tpu.ops.paged_attention import quantize_kv
+
+                    qk, sk, zk = quantize_kv(flat_k)
+                    qv, sv, zv = quantize_kv(flat_v)
+                    cache_k.value = cache_k.value.at[rows, offs].set(qk)
+                    cache_v.value = cache_v.value.at[rows, offs].set(qv)
+                    for var, vals in zip(quant_side, (sk, zk, sv, zv)):
+                        var.value = var.value.at[rows, offs].set(vals)
+                else:
+                    cache_k.value = cache_k.value.at[rows, offs].set(flat_k)
+                    cache_v.value = cache_v.value.at[rows, offs].set(flat_v)
             elif i.ndim:
                 # per-row positions: each batch row lands at its own start
                 row_write = jax.vmap(
@@ -356,11 +411,43 @@ class Attention(nn.Module):
             index.value = i + t
 
         if cfg.decode_paged:
-            # gather the row's blocks back into position order: [B, P, page,
-            # KV, D] → [B, L, KV, D] — the dense layout, so everything below
-            # is literally the dense code path (bit-identical numerics)
-            keys = cache_k.value[page_table].reshape(b, L, kv_heads, d)
-            vals = cache_v.value[page_table].reshape(b, L, kv_heads, d)
+            from lzy_tpu.ops.paged_attention import (
+                KVQuant, dequantize_kv, paged_attention)
+
+            kvq = None
+            if quant:
+                kvq = KVQuant(*(var.value for var in quant_side))
+            if cfg.paged_attention_native:
+                # native read path: attention computed THROUGH the page
+                # table (ops/paged_attention) — decode, prefill chunks
+                # and the [B, gamma+1] speculative verify all run this
+                # one fused program; the dense [B, L, ...] copy of the
+                # pool below never exists. "lax" is bit-identical to the
+                # legacy gather by construction; "pallas" is the fused
+                # kernel (tested bit-exact against lax in interpret
+                # mode). int8 pools dequantize inside the kernel's block
+                # loop.
+                out = paged_attention(
+                    q, cache_k.value, cache_v.value, page_table, pos,
+                    kernel=cfg.paged_kernel, dtype=cfg.dtype, quant=kvq)
+                return self._o_proj(out.reshape(b, t, h * d))
+            # legacy path: gather the row's blocks back into position
+            # order: [B, P, page, KV, D] → [B, L, KV, D] — the dense
+            # layout, so everything below is literally the dense code
+            # path (bit-identical numerics); int8 pools dequantize right
+            # after the gather (same per-element formula as the native
+            # kernels, so quantized output is kernel-independent)
+            keys = cache_k.value[page_table]
+            vals = cache_v.value[page_table]
+            if quant:
+                keys = dequantize_kv(
+                    keys, kvq.k_scale[page_table], kvq.k_zp[page_table],
+                    cfg.dtype)
+                vals = dequantize_kv(
+                    vals, kvq.v_scale[page_table], kvq.v_zp[page_table],
+                    cfg.dtype)
+            keys = keys.reshape(b, L, kv_heads, d)
+            vals = vals.reshape(b, L, kv_heads, d)
         else:
             keys, vals = cache_k.value, cache_v.value
 

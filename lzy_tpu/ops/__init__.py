@@ -1,4 +1,7 @@
 from lzy_tpu.ops.attention import chunked_attention
 from lzy_tpu.ops.flash_attention import flash_attention
+from lzy_tpu.ops.paged_attention import (
+    dequantize_kv, paged_attention, quantize_kv)
 
-__all__ = ["chunked_attention", "flash_attention"]
+__all__ = ["chunked_attention", "flash_attention", "paged_attention",
+           "quantize_kv", "dequantize_kv"]

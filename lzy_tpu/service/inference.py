@@ -225,6 +225,20 @@ def _build_engine_parts(model: str, *, checkpoint: Optional[str],
     return cfg, params
 
 
+def _check_paged_only(paged: bool, *, kv_quant, native_attention,
+                      kernel, kv_pool_bytes=None) -> None:
+    """The dense engine has no page table to read through: silently
+    building it while the caller asked for quantization or the native
+    kernel would serve dense fp attention with no error and no stats
+    signal (kv_quant/kernel_path are None-filtered out of the wire doc).
+    serve.py validates its flags; the library surface must too."""
+    if not paged and (kv_quant is not None or native_attention
+                      or kernel != "auto" or kv_pool_bytes is not None):
+        raise ValueError(
+            "kv_quant / native_attention / kernel / kv_pool_bytes "
+            "require paged=True")
+
+
 def build_gateway_service(
     model: str,
     *,
@@ -238,6 +252,10 @@ def build_gateway_service(
     paged: bool = False,
     page_size: int = 16,
     kv_blocks: Optional[int] = None,
+    kv_pool_bytes: Optional[int] = None,
+    kv_quant: Optional[str] = None,
+    native_attention: bool = False,
+    kernel: str = "auto",
     routing: str = "prefix",
     allocator=None,
     pool_label: str = "cpu-small",
@@ -277,6 +295,9 @@ def build_gateway_service(
         raise ValueError(f"replicas must be >= 1, got {replicas}")
     if routing not in ("prefix", "rr"):
         raise ValueError(f"unknown routing {routing!r}; use prefix or rr")
+    _check_paged_only(paged, kv_quant=kv_quant,
+                      native_attention=native_attention, kernel=kernel,
+                      kv_pool_bytes=kv_pool_bytes)
     cfg, params = _build_engine_parts(model, checkpoint=checkpoint,
                                       seed=seed)
     common = dict(slots=slots, max_queue=max_queue, eos_token=eos_token,
@@ -288,6 +309,8 @@ def build_gateway_service(
         if paged:
             engine = PagedInferenceEngine(
                 cfg, params, page_size=page_size, kv_blocks=kv_blocks,
+                kv_pool_bytes=kv_pool_bytes, kv_quant=kv_quant,
+                native_attention=native_attention, kernel=kernel,
                 **common)
         else:
             engine = InferenceEngine(cfg, params, **common)
@@ -347,6 +370,10 @@ def build_disagg_gateway_service(
     prefill_chunk: int = 64,
     page_size: int = 16,
     kv_blocks: Optional[int] = None,
+    kv_pool_bytes: Optional[int] = None,
+    kv_quant: Optional[str] = None,
+    native_attention: bool = False,
+    kernel: str = "auto",
     routing: str = "prefix",
     allocator=None,
     pool_label: str = "cpu-small",
@@ -385,9 +412,16 @@ def build_disagg_gateway_service(
         raise ValueError(f"unknown routing {routing!r}; use prefix or rr")
     cfg, params = _build_engine_parts(model, checkpoint=checkpoint,
                                       seed=seed)
+    # kv_quant/kernel apply to BOTH pools: the transfer payload is the
+    # raw cache leaves, so a quantized decode pool needs the prefill
+    # pool producing int8 blocks + sidecars of the same shape (a
+    # mismatch degrades safely — import_kv fails closed and the prompt
+    # re-prefills locally — but transfers nothing)
     common = dict(slots=slots, max_queue=max_queue,
                   prefill_chunk=prefill_chunk, seed=seed,
                   page_size=page_size, kv_blocks=kv_blocks,
+                  kv_pool_bytes=kv_pool_bytes, kv_quant=kv_quant,
+                  native_attention=native_attention, kernel=kernel,
                   prefill_budget=prefill_budget, tenants=tenants)
 
     def decode_factory():
@@ -462,6 +496,10 @@ def build_inference_service(
     paged: bool = False,
     page_size: int = 16,
     kv_blocks: Optional[int] = None,
+    kv_pool_bytes: Optional[int] = None,
+    kv_quant: Optional[str] = None,
+    native_attention: bool = False,
+    kernel: str = "auto",
     spec_tokens: int = 0,
     warm_start: bool = False,
     start: bool = True,
@@ -480,6 +518,11 @@ def build_inference_service(
     ``page_size`` tokens shared by all slots (default: the dense
     equivalent — size it below that to overcommit HBM, above to grow the
     prefix cache; docs/serving.md has the tradeoffs).
+    ``native_attention=True`` reads KV through the page table in one
+    fused program (``kernel``: pallas/lax/auto) instead of gathering
+    blocks back to the dense layout; ``kv_quant="int8"`` halves pooled
+    KV bytes (~2x blocks at fixed HBM, boundedly-divergent output) —
+    docs/serving.md "Native paged attention & KV quantization".
 
     ``spec_tokens`` > 0 enables draft-free speculative decoding
     (``serving/spec.py``): up to that many prompt-lookup draft tokens
@@ -498,6 +541,9 @@ def build_inference_service(
     """
     from lzy_tpu.serving import InferenceEngine, PagedInferenceEngine
 
+    _check_paged_only(paged, kv_quant=kv_quant,
+                      native_attention=native_attention, kernel=kernel,
+                      kv_pool_bytes=kv_pool_bytes)
     cfg, params = _build_engine_parts(model, checkpoint=checkpoint,
                                       seed=seed)
     common = dict(slots=slots, max_queue=max_queue, eos_token=eos_token,
@@ -506,7 +552,9 @@ def build_inference_service(
                   tenants=tenants)
     if paged:
         engine: InferenceEngine = PagedInferenceEngine(
-            cfg, params, page_size=page_size, kv_blocks=kv_blocks, **common)
+            cfg, params, page_size=page_size, kv_blocks=kv_blocks,
+            kv_pool_bytes=kv_pool_bytes, kv_quant=kv_quant,
+            native_attention=native_attention, kernel=kernel, **common)
     else:
         engine = InferenceEngine(cfg, params, **common)
     if warm_start:

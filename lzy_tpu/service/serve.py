@@ -105,6 +105,33 @@ def main(argv=None) -> int:
                              "(default: the dense equivalent; smaller "
                              "overcommits HBM, larger grows the prefix "
                              "cache)")
+    parser.add_argument("--serve-kv-pool-mb", type=int, default=None,
+                        help="size the KV block pool by payload byte "
+                             "budget instead of --serve-kv-blocks: "
+                             "blocks = budget // per-block bytes "
+                             "(kv_cache.blocks_for_bytes) — at a fixed "
+                             "budget --serve-kv-quant int8 holds 2x "
+                             "the blocks")
+    parser.add_argument("--serve-kv-quant", choices=("int8",),
+                        default=None,
+                        help="KV-block quantization under --serve-paged: "
+                             "int8 stores pooled K/V at half the bytes "
+                             "(~2x resident blocks at fixed HBM; output "
+                             "boundedly diverges from fp — docs/"
+                             "serving.md 'Native paged attention & KV "
+                             "quantization')")
+    parser.add_argument("--serve-native-attention", action="store_true",
+                        help="native paged-attention read path under "
+                             "--serve-paged: attention reads K/V through "
+                             "the page table in one fused program "
+                             "instead of gathering blocks back to the "
+                             "dense layout each step")
+    parser.add_argument("--serve-kernel",
+                        choices=("auto", "pallas", "lax"), default="auto",
+                        help="kernel under --serve-native-attention: "
+                             "pallas (fused, TPU), lax (portable, "
+                             "bit-identical to the legacy gather), auto "
+                             "picks by platform")
     parser.add_argument("--serve-spec", action="store_true",
                         help="draft-free speculative decoding: n-gram "
                              "prompt lookup proposes up to --spec-tokens "
@@ -204,9 +231,24 @@ def main(argv=None) -> int:
         parser.error("--disagg requires --serve-model")
     if args.disagg and args.gateway:
         parser.error("--disagg IS a gateway mode; pass one or the other")
+    if (args.serve_kv_quant or args.serve_native_attention
+            or args.serve_kernel != "auto"
+            or args.serve_kv_pool_mb is not None) \
+            and not (args.serve_paged or args.disagg):
+        parser.error("--serve-kv-quant/--serve-native-attention/"
+                     "--serve-kernel/--serve-kv-pool-mb need the paged "
+                     "cache (--serve-paged or --disagg)")
+    if args.serve_kernel != "auto" and not args.serve_native_attention:
+        parser.error("--serve-kernel picks the --serve-native-attention "
+                     "kernel; without it the legacy path serves")
+    if args.serve_kv_pool_mb is not None and args.serve_kv_blocks is not None:
+        parser.error("pass --serve-kv-blocks or --serve-kv-pool-mb, "
+                     "not both")
 
     warm_start = bool(args.serve_model) and not args.no_warm_start
     spec_tokens = args.spec_tokens if args.serve_spec else 0
+    kv_pool_bytes = (args.serve_kv_pool_mb * (1 << 20)
+                     if args.serve_kv_pool_mb is not None else None)
     prefill_budget = args.serve_prefill_budget or None
     tenants = None
     slo_on = args.serve_slo or any(
@@ -253,6 +295,10 @@ def main(argv=None) -> int:
                 checkpoint=args.model_checkpoint,
                 page_size=args.serve_page_size,
                 kv_blocks=args.serve_kv_blocks,
+                kv_pool_bytes=kv_pool_bytes,
+                kv_quant=args.serve_kv_quant,
+                native_attention=args.serve_native_attention,
+                kernel=args.serve_kernel,
                 routing=args.gateway_routing,
                 allocator=cluster.allocator,
                 pool_label=args.gateway_pool,
@@ -280,6 +326,10 @@ def main(argv=None) -> int:
                 paged=args.serve_paged,
                 page_size=args.serve_page_size,
                 kv_blocks=args.serve_kv_blocks,
+                kv_pool_bytes=kv_pool_bytes,
+                kv_quant=args.serve_kv_quant,
+                native_attention=args.serve_native_attention,
+                kernel=args.serve_kernel,
                 routing=args.gateway_routing,
                 allocator=cluster.allocator,
                 pool_label=args.gateway_pool,
@@ -300,6 +350,10 @@ def main(argv=None) -> int:
             paged=args.serve_paged,
             page_size=args.serve_page_size,
             kv_blocks=args.serve_kv_blocks,
+            kv_pool_bytes=kv_pool_bytes,
+            kv_quant=args.serve_kv_quant,
+            native_attention=args.serve_native_attention,
+            kernel=args.serve_kernel,
             spec_tokens=spec_tokens,
             warm_start=warm_start,
             prefill_budget=prefill_budget,

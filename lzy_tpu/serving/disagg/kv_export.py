@@ -112,15 +112,34 @@ def import_kv(engine, export: KVBlockExport) -> int:
         return 0
     ids = jnp.asarray(blocks, jnp.int32)
     try:
+        # the payload must describe EXACTLY this pool's cache leaves: a
+        # quantized export carries int8 payloads + scale/zero-point
+        # sidecar leaves an fp pool does not have (and vice versa), and
+        # silently ignoring the difference would scatter quantization
+        # CODES into a pool that reads them as KV VALUES — garbage
+        # served with no error anywhere. Mismatched kv_quant between
+        # disagg pools therefore fails closed here (local re-prefill).
+        flat, _ = jax.tree_util.tree_flatten_with_path(engine._cache)
+        expected = {jax.tree_util.keystr(path)
+                    for path, _ in flat if not _is_index(path)}
+        if set(export.leaves) != expected:
+            odd = sorted(set(export.leaves) ^ expected)
+            raise ValueError(
+                f"kv payload leaves do not match the pool's cache leaves "
+                f"(off by {odd[:4]}...) — mismatched kv_quant between "
+                f"the exporting and importing pools?")
+
         def put(path, leaf):
             if _is_index(path):
                 return leaf
             data = export.leaves[jax.tree_util.keystr(path)]
-            if data.shape[0] != n or data.shape[1:] != leaf.shape[1:]:
+            if (data.shape[0] != n or data.shape[1:] != leaf.shape[1:]
+                    or data.dtype != leaf.dtype):
                 raise ValueError(
-                    f"kv leaf shape {data.shape} does not fit pool leaf "
-                    f"{leaf.shape}")
-            return leaf.at[ids].set(jnp.asarray(data, leaf.dtype))
+                    f"kv leaf {data.shape}/{data.dtype} does not fit "
+                    f"pool leaf {leaf.shape}/{leaf.dtype} (mismatched "
+                    f"kv_quant?)")
+            return leaf.at[ids].set(jnp.asarray(data))
 
         engine._cache = jax.tree_util.tree_map_with_path(put, engine._cache)
     except Exception as e:  # noqa: BLE001 — a bad payload must not leak

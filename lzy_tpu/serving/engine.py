@@ -69,7 +69,8 @@ from lzy_tpu.serving.scheduler import (
 from lzy_tpu.serving.tenancy import (
     TENANT_KV_BLOCKS, TENANT_REQUESTS, TENANT_TOKENS, TENANT_TTFT)
 from lzy_tpu.serving.spec import (
-    ACCEPT_RATE as _SPEC_RATE, ACCEPTED as _SPEC_ACCEPTED, NgramProposer,
+    ACCEPT_RATE as _SPEC_RATE, ACCEPTED as _SPEC_ACCEPTED,
+    DRAFT_TRUNCATED as _SPEC_TRUNCATED, NgramProposer,
     PROPOSED as _SPEC_PROPOSED, TOKENS_PER_STEP as _SPEC_TPS,
     VERIFY_STEPS as _SPEC_STEPS)
 from lzy_tpu.utils.log import get_logger
@@ -179,6 +180,15 @@ class EngineStats:
     spec_acceptance_rate: Optional[float] = None
     spec_verify_steps: Optional[int] = None
     spec_tokens_per_step: Optional[float] = None
+    # drafts truncated by _grow_for_spec's NoFreeBlocks backstop (paged
+    # engines; a silent perf cliff until it was counted — a pool sized
+    # too tight quietly degrades speculation to 1-token steps)
+    spec_draft_truncated: Optional[int] = None
+    # native paged-attention fields (PagedInferenceEngine only): which
+    # kernel the decode/verify/prefill programs read KV through
+    # (pallas/lax/legacy) and the active KV quantization mode
+    kernel_path: Optional[str] = None
+    kv_quant: Optional[str] = None
 
     def doc(self) -> dict:
         return {k: v for k, v in dataclasses.asdict(self).items()
@@ -286,6 +296,7 @@ class InferenceEngine:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_steps = 0
+        self.spec_draft_truncated = 0   # paged: drafts cut by NoFreeBlocks
         self.decode_steps = 0     # decode rounds (normal + verify)
         self.decode_rows = 0      # cumulative active rows over rounds
         self.decode_tokens = 0    # tokens emitted by decode rounds
@@ -1150,6 +1161,7 @@ class InferenceEngine:
                 spec_acceptance_rate=round(rate, 4),
                 spec_verify_steps=self.spec_steps,
                 spec_tokens_per_step=round(tps, 4),
+                spec_draft_truncated=self.spec_draft_truncated,
             )
         return s
 
@@ -1208,17 +1220,67 @@ class PagedInferenceEngine(InferenceEngine):
         slots: int = 4,
         page_size: int = 16,
         kv_blocks: Optional[int] = None,
+        kv_pool_bytes: Optional[int] = None,
+        kv_quant: Optional[str] = None,
+        native_attention: bool = False,
+        kernel: str = "auto",
         **kwargs,
     ):
-        from lzy_tpu.serving.kv_cache import RadixCache
+        from lzy_tpu.ops.paged_attention import (
+            DISPATCHES, QUANT_BLOCKS_RESIDENT, default_kernel)
+        from lzy_tpu.serving.kv_cache import RadixCache, blocks_for_bytes
 
         base = decode_config(cfg)
         if page_size < 1 or base.max_seq_len % page_size:
             raise ValueError(
                 f"page_size ({page_size}) must divide max_seq_len "
                 f"({base.max_seq_len})")
+        if kv_quant not in (None, "int8"):
+            raise ValueError(
+                f"unknown kv_quant {kv_quant!r}; known: int8")
+        if kernel not in ("auto", "lax", "pallas"):
+            raise ValueError(
+                f"unknown kernel {kernel!r}; known: auto, lax, pallas")
         self._page = page_size
         self._pages_per_seq = base.max_seq_len // page_size
+        self._kv_quant = kv_quant
+        # kernel selection ladder (docs/serving.md): the fused Pallas
+        # program where the hardware has one, the lax gather-attention
+        # (bit-identical oracle) elsewhere, and "legacy" — the original
+        # gather-back-to-dense read — when native_attention is off
+        self._native = bool(native_attention)
+        if not self._native:
+            if kernel != "auto":
+                # an explicit kernel choice that would be silently
+                # ignored is a misconfiguration, not a preference
+                raise ValueError(
+                    f"kernel={kernel!r} requires native_attention=True "
+                    f"(without it the legacy gather path serves)")
+            self.kernel_path = "legacy"
+        else:
+            self.kernel_path = default_kernel() if kernel == "auto" \
+                else kernel
+        self._dispatches = DISPATCHES
+        # the resident gauge is process-global and this process may run
+        # several quantized pools (disagg: prefill + decode); each engine
+        # contributes its own delta so the exported value is the SUM, and
+        # close() withdraws the contribution (no stale reading after a
+        # drain)
+        self._quant_resident = QUANT_BLOCKS_RESIDENT
+        self._quant_resident_seen = 0
+        self._quant_resident_lock = threading.Lock()
+        if kv_pool_bytes is not None:
+            if kv_blocks is not None:
+                raise ValueError(
+                    "pass kv_blocks or kv_pool_bytes, not both")
+            # size the pool by its HBM payload budget: int8 blocks are
+            # half the bytes of bf16 blocks, so the same budget holds
+            # ~2x the blocks — the whole point of kv_quant
+            kv_blocks = blocks_for_bytes(
+                kv_pool_bytes, page_size=page_size,
+                n_kv_heads=base.n_kv_heads, head_dim=base.head_dim,
+                n_layers=base.n_layers, dtype=base.dtype,
+                kv_quant=kv_quant)
         if kv_blocks is None:
             # dense-equivalent HBM by default (+1 scratch); pass less to
             # overcommit, more to grow the prefix cache's working set
@@ -1241,7 +1303,10 @@ class PagedInferenceEngine(InferenceEngine):
     def _build_decode_path(self, base: LlamaConfig) -> None:
         pcfg = dataclasses.replace(
             base, decode_paged=True, kv_page_size=self._page,
-            kv_pages=self._kv_blocks)
+            kv_pages=self._kv_blocks,
+            paged_attention_native=self._native,
+            paged_kernel=self.kernel_path if self._native else "lax",
+            kv_quant=self._kv_quant)
         slots, pages = self.slots, self._pages_per_seq
         self._model = Llama(pcfg)
         dummy_pt = jnp.zeros((slots, pages), jnp.int32)
@@ -1444,11 +1509,18 @@ class PagedInferenceEngine(InferenceEngine):
             if job.tokens_dev is None:
                 job.tokens_dev = jnp.asarray(
                     [req.prompt[job.matched:]], jnp.int32)
-            cache, finished = self._run_prefill_chunks(
-                job, cache, job.tokens_dev,
-                lambda c, tokens, take: self._prefill_step(
+
+            def run_chunk(c, tokens, take):
+                # one program dispatch per CHUNK (a budgeted round may
+                # run several) — the dispatch counter must agree with
+                # the decode/verify paths' one-inc-per-program rule
+                self._dispatches.inc(path=self.kernel_path)
+                return self._prefill_step(
                     c, self.params, tokens, pt,
-                    jnp.asarray(take - 1, jnp.int32)))
+                    jnp.asarray(take - 1, jnp.int32))
+
+            cache, finished = self._run_prefill_chunks(
+                job, cache, job.tokens_dev, run_chunk)
             if not finished:
                 self._merge_prefill(cache, job.slot, 0)
                 self._index_aliased = True
@@ -1531,11 +1603,13 @@ class PagedInferenceEngine(InferenceEngine):
 
     def _run_decode_step(self, tokens, greedy_mask):
         pt = jnp.asarray(self._tables)
+        self._dispatches.inc(path=self.kernel_path)
         return self._decode_step(self._cache, self.params, tokens, pt,
                                  greedy_mask, self._rng)
 
     def _run_verify_step(self, tokens, greedy_mask):
         pt = jnp.asarray(self._tables)
+        self._dispatches.inc(path=self.kernel_path)
         return self._verify_step(self._cache, self.params, tokens, pt,
                                  greedy_mask, self._rng)
 
@@ -1557,7 +1631,15 @@ class PagedInferenceEngine(InferenceEngine):
         if not plan:
             return plan
         for slot in list(plan):
-            covered = self._grow_for_spec(slot, len(plan[slot]))
+            want = len(plan[slot])
+            covered = self._grow_for_spec(slot, want)
+            if covered < want:
+                # the NoFreeBlocks backstop fired — count it: a pool
+                # sized too tight silently degrades speculation toward
+                # 1-token steps, and until this counter existed the only
+                # symptom was a mysteriously low tokens-per-step
+                self.spec_draft_truncated += 1
+                _SPEC_TRUNCATED.inc()
             plan[slot] = plan[slot][:covered]
             if not plan[slot]:
                 del plan[slot]
@@ -1624,6 +1706,10 @@ class PagedInferenceEngine(InferenceEngine):
     def stats(self) -> EngineStats:
         s = super().stats()
         ks = self.kv.stats()
+        if self._kv_quant is not None:
+            # blocks currently holding int8 data: everything usable that
+            # is not on the free list (slot-resident + radix-cached)
+            self._note_quant_resident(ks.blocks_total - ks.blocks_free)
         return dataclasses.replace(
             s,
             kv_page_size=self._page,
@@ -1633,7 +1719,26 @@ class PagedInferenceEngine(InferenceEngine):
             kv_evictions=ks.evictions,
             prefix_hit_rate=round(ks.hit_rate, 4),
             prefill_tokens_saved=ks.prefill_tokens_saved,
+            kernel_path=self.kernel_path,
+            kv_quant=self._kv_quant,
         )
+
+    def _note_quant_resident(self, resident: int) -> None:
+        with self._quant_resident_lock:
+            if self._closed:
+                # a stats() call racing (or arriving after) close() must
+                # not re-inflate the process gauge the close withdrew —
+                # a closed engine's contribution is pinned at zero
+                resident = 0
+            delta = resident - self._quant_resident_seen
+            self._quant_resident_seen = resident
+        if delta:
+            self._quant_resident.add(float(delta))
+
+    def close(self, timeout: float = 10.0) -> None:
+        super().close(timeout)
+        if self._kv_quant is not None:
+            self._note_quant_resident(0)
 
     def stats_by_tenant(self) -> dict:
         out = super().stats_by_tenant()

@@ -384,3 +384,47 @@ class RadixCache:
 def blocks_for(n_tokens: int, page_size: int) -> int:
     """Blocks needed to hold ``n_tokens`` cache positions."""
     return -(-n_tokens // page_size)
+
+
+def kv_block_bytes(*, page_size: int, n_kv_heads: int, head_dim: int,
+                   n_layers: int = 1, dtype="bfloat16",
+                   kv_quant: Optional[str] = None) -> int:
+    """HBM payload bytes ONE pool block commits across the model: K + V
+    arrays for every layer (each decoder layer owns a pool of the same
+    block-id space, so a block allocation pins a row in all of them).
+    ``kv_quant="int8"`` stores one byte per element — exactly half of
+    bf16, which is what doubles resident block count at fixed pool
+    bytes. Quantization sidecars (per-position scale/zero-point,
+    :func:`kv_quant_sidecar_bytes`) are metadata accounted OUTSIDE the
+    payload budget, like the page tables themselves."""
+    import numpy as np
+
+    elem = 1 if kv_quant == "int8" else np.dtype(dtype).itemsize
+    return 2 * n_layers * page_size * n_kv_heads * head_dim * elem
+
+
+def kv_quant_sidecar_bytes(*, page_size: int, n_kv_heads: int,
+                           n_layers: int = 1,
+                           kv_quant: Optional[str] = None) -> int:
+    """Bytes of quantization metadata riding next to one block: an f32
+    scale and zero-point per written position per head, for K and for V,
+    per layer (``ops/paged_attention.KVQuant``). Zero without
+    quantization. ~``8 / head_dim`` of the int8 payload — small, but
+    reported so capacity planning can be honest about it."""
+    if kv_quant is None:
+        return 0
+    return 2 * n_layers * page_size * n_kv_heads * 2 * 4
+
+
+def blocks_for_bytes(pool_bytes: int, *, page_size: int, n_kv_heads: int,
+                     head_dim: int, n_layers: int = 1, dtype="bfloat16",
+                     kv_quant: Optional[str] = None) -> int:
+    """Pool size (block count, scratch included) a payload byte budget
+    buys — the sizing rule behind ``PagedInferenceEngine(kv_pool_bytes=)``
+    and ``--serve-kv-pool-mb``. At a fixed budget, ``kv_quant="int8"``
+    yields 2x the blocks of bf16 — directly multiplying radix-cache
+    working set and decode-growth headroom."""
+    per = kv_block_bytes(page_size=page_size, n_kv_heads=n_kv_heads,
+                         head_dim=head_dim, n_layers=n_layers,
+                         dtype=dtype, kv_quant=kv_quant)
+    return max(2, pool_bytes // per)

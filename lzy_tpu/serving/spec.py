@@ -55,6 +55,11 @@ VERIFY_STEPS = REGISTRY.counter(
 ACCEPT_RATE = REGISTRY.gauge(
     "lzy_spec_acceptance_rate",
     "cumulative accepted / proposed speculative tokens")
+DRAFT_TRUNCATED = REGISTRY.counter(
+    "lzy_spec_draft_truncated_total",
+    "speculative drafts cut short because the KV pool's free list could "
+    "not back every proposed position (NoFreeBlocks — speculation never "
+    "evicts cached blocks or preempts for a draft)")
 TOKENS_PER_STEP = REGISTRY.gauge(
     "lzy_spec_tokens_per_step",
     "mean generated tokens per decode step (1.0 = no speculation win)")

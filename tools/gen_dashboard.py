@@ -34,8 +34,12 @@ def registry_metrics():
     import lzy_tpu.serving.engine  # noqa: F401
     import lzy_tpu.serving.kv_cache  # noqa: F401
     import lzy_tpu.serving.scheduler  # noqa: F401
-    # speculative decoding: proposed/accepted, acceptance rate, tok/step
+    # speculative decoding: proposed/accepted, acceptance rate, tok/step,
+    # draft truncations
     import lzy_tpu.serving.spec  # noqa: F401
+    # native paged-attention kernels: dispatches by path, quantized
+    # blocks resident, dequant-error EWMA (lzy_kernel_*)
+    import lzy_tpu.ops.paged_attention  # noqa: F401
     # multi-tenant SLO: per-tenant requests/tokens/TTFT, queue depth,
     # KV blocks, rate-bucket levels, sheds (lzy_tenant_*)
     import lzy_tpu.serving.tenancy  # noqa: F401
