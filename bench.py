@@ -475,6 +475,14 @@ def run() -> None:
     if extra:
         detail.update(extra)
         emit()
+    extra = stream_measurement(
+        jax, cfg, params,
+        slots=4 if is_tpu else 2,
+        prompt_len=64 if is_tpu else 16,
+        new_tokens=64 if is_tpu else 32)
+    if extra:
+        detail.update(extra)
+        emit()
     if platform in ("tpu", "axon"):
         # each extra pass builds a whole second model+optimizer: evict the
         # previous one (buffers AND compiled executables) first or OOM
@@ -1426,6 +1434,67 @@ def llm_op_pipeline_measurement(jax, cfg, params, *, replicas: int,
                 "llm_op_steps": steps}
     except Exception as e:  # noqa: BLE001 — diagnostics only
         _log(f"llm_op pipeline skipped: {type(e).__name__}: {e}")
+        return {}
+
+
+def stream_measurement(jax, cfg, params, *, slots: int, prompt_len: int,
+                       new_tokens: int):
+    """Best-effort streaming-delivery point (docs/serving.md "Streaming
+    delivery"): TTFT (open → first frame) and inter-token p99 over the
+    chunked long-poll surface (``serving/streams``) — the exact
+    open/poll/ack path ``InferStream`` serves over gRPC, minus the wire,
+    so the number isolates the session layer's delivery cadence next to
+    the engine's own decode rate. Rides the CPU-fallback path like
+    every serving probe."""
+    try:
+        import numpy as np
+
+        from lzy_tpu.serving import InferenceEngine
+        from lzy_tpu.service.inference import InferenceService
+
+        engine = InferenceEngine(cfg, params, slots=slots).start()
+        svc = InferenceService(engine, model_name="bench")
+        try:
+            rng = np.random.default_rng(3)
+            prompt = [int(t) for t in rng.integers(
+                1, cfg.vocab_size, prompt_len)]
+            _log("stream: warming the decode path...")
+            svc.generate(prompt, max_new_tokens=4, greedy=True,
+                         timeout_s=600)
+            _log(f"stream: timing long-poll delivery of {new_tokens} "
+                 f"tokens...")
+            t_open = time.perf_counter()
+            opened = svc.streams.open(prompt, max_new_tokens=new_tokens,
+                                      greedy=True, timeout_s=600)
+            rid = opened["request_id"]
+            arrivals = []
+            pos = 0
+            ttft = None
+            while True:
+                frame = svc.streams.poll(rid, pos, wait_s=0.5)
+                now = time.perf_counter()
+                n = len(frame["tokens"])
+                if n and ttft is None:
+                    ttft = now - t_open
+                arrivals.extend([now] * n)
+                pos += n
+                if frame["done"]:
+                    break
+            gaps = (np.diff(np.asarray(arrivals))
+                    if len(arrivals) > 1 else np.asarray([0.0]))
+            p99 = float(np.quantile(gaps, 0.99))
+            _log(f"stream: ttft {1000 * (ttft or 0):.1f} ms, "
+                 f"inter-token p99 {1000 * p99:.2f} ms over {pos} "
+                 f"tokens")
+            return {
+                "stream_ttft_ms": round(1000 * (ttft or 0.0), 3),
+                "stream_inter_token_p99_ms": round(1000 * p99, 3),
+                "stream_tokens": pos,
+            }
+        finally:
+            svc.close()
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        _log(f"stream skipped: {type(e).__name__}: {e}")
         return {}
 
 

@@ -75,6 +75,18 @@ class TokenStreamChannel:
         self._status: Optional[str] = None
         self._error: Optional[str] = None
         self._resumptions = 0
+        # consumer progress: the highest position the consumer has
+        # ACKNOWLEDGED (a streaming front's poll cursor, or read()'s
+        # start). The producer side reads consumer_lag off it to apply
+        # its backpressure-or-shed policy to slow consumers; a plain
+        # in-process consumer that never acks simply reports full lag.
+        self._acked = 0
+        #: the serving Request currently publishing into this channel
+        #: (set by :func:`attach_request`): lets the channel's OWNER —
+        #: a streaming session — cancel the producing request or read
+        #: its phase for keepalive frames without threading the request
+        #: through every service signature
+        self.attached_request = None
 
     # -- producer side -------------------------------------------------------
 
@@ -164,6 +176,26 @@ class TokenStreamChannel:
         with self._cv:
             return self._resumptions
 
+    @property
+    def acked(self) -> int:
+        with self._cv:
+            return self._acked
+
+    @property
+    def consumer_lag(self) -> int:
+        """Published-but-unacknowledged tokens — what a bounded-buffer
+        policy measures a slow consumer by."""
+        with self._cv:
+            return len(self._tokens) - self._acked
+
+    def ack(self, position: int) -> None:
+        """Record consumer progress up to ``position`` (monotonic: a
+        re-read of an already-delivered range — a wire resume — never
+        rewinds it)."""
+        with self._cv:
+            self._acked = min(max(self._acked, int(position)),
+                              len(self._tokens))
+
     def tokens(self) -> List[int]:
         """Snapshot of everything published so far."""
         with self._cv:
@@ -190,7 +222,31 @@ class TokenStreamChannel:
             if self._error is not None:
                 raise StreamFailed(
                     f"stream {self.id} failed: {self._error}")
-            return list(self._tokens[start:])
+            self._acked = max(self._acked, start)
+            out = list(self._tokens[start:])
+            self._acked = max(self._acked, start + len(out))
+            return out
+
+    def wait_past(self, start: int, timeout_s: float) -> dict:
+        """Frame-oriented bounded wait (the streaming front's long-poll
+        primitive): block until the stream moves past ``start`` or
+        terminates, for at most ``timeout_s``. NEVER raises on timeout or
+        failure — returns a frame dict ``{"tokens", "closed", "status",
+        "error"}`` where an empty ``tokens`` with ``closed: False`` is a
+        keepalive (the producer is alive but produced nothing yet) and a
+        failed stream reports its error in-band (the poll reply owns the
+        error format)."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._cv:
+            while len(self._tokens) <= start and not self._closed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            return {"tokens": list(self._tokens[start:]),
+                    "closed": self._closed,
+                    "status": self._status,
+                    "error": self._error}
 
     def __iter__(self) -> Iterator[int]:
         """Yield tokens one at a time as they arrive, until the stream
@@ -202,6 +258,7 @@ class TokenStreamChannel:
                     self._cv.wait(1.0)
                 if len(self._tokens) > pos:
                     tok = self._tokens[pos]
+                    self._acked = max(self._acked, pos + 1)
                 else:
                     if self._error is not None:
                         raise StreamFailed(
@@ -255,6 +312,11 @@ def attach_request(channel: TokenStreamChannel, req,
             state["sent"] = n
 
     req.token_sink = sink
+    # the channel's owner (a streaming session) may need the producing
+    # request: to cancel it mid-stream, or to name its phase in a
+    # keepalive frame. After a failover the RETRY attempt's request
+    # replaces the dead one — latest attached wins.
+    channel.attached_request = req
     sink()           # flush anything emitted before the attach
     return sink
 

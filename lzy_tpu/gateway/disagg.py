@@ -154,7 +154,8 @@ class DisaggGatewayService(GatewayService):
 
     def _pre_submit(self, replica, prompt: List[int],
                     deadline_s: Optional[float] = None,
-                    tenant: str = "default") -> bool:
+                    tenant: str = "default",
+                    liveness=None) -> bool:
         """Parent routing loop's staging hook: probe the decode replica's
         admission gate FIRST — staging KV for a replica that cannot admit
         would waste a whole prefill + transfer and park imported blocks on
@@ -163,11 +164,17 @@ class DisaggGatewayService(GatewayService):
         before any scheduling round can admit the request.
         ``deadline_s`` is the request's REMAINING client deadline (a
         failover re-stages with what is left, not a fresh window): it
-        caps the prefill wait and rides on the prefill-pool submit."""
+        caps the prefill wait and rides on the prefill-pool submit.
+        A client ``liveness`` already reports gone skips the staging
+        entirely (a prefill + transfer for a request the decode engine
+        will reap on arrival is pure waste) — the submit still goes
+        through, and the engine's reaper does the terminal accounting."""
         engine = replica.engine
         if getattr(engine, "closed", False) or \
                 engine.queue.depth() >= engine.queue.max_depth:
             return False
+        if liveness is not None and self._client_gone(liveness):
+            return True
         self._stage_kv(replica, prompt, deadline_s=deadline_s,
                        tenant=tenant)
         return True

@@ -109,6 +109,11 @@ class GatewayService:
         #: failover fence and completion is reported for the monotonicity
         #: audit; None (production) costs one attribute check
         self.fence_auditor = None
+        #: streaming front (InferStream/InferStreamPoll/InferCancel):
+        #: the fence the failover path maintains IS the wire position
+        from lzy_tpu.serving.streams import StreamSessionManager
+
+        self.streams = StreamSessionManager(self)
 
     # -- request surface -----------------------------------------------------
 
@@ -182,7 +187,7 @@ class GatewayService:
                  tenant: Optional[str] = None,
                  priority: Optional[int] = None,
                  session: Optional[str] = None,
-                 stream=None) -> dict:
+                 stream=None, liveness=None) -> dict:
         """Blocking generate over the fleet; same contract as the single
         engine's RPC surface plus route metadata (``replica``,
         ``routed_by``, ``failovers``) in the reply. Backpressure is
@@ -206,7 +211,11 @@ class GatewayService:
         closed with the request's terminal status before this method
         returns — or failed before it raises IF any tokens were
         published; an exception that never touched the stream leaves it
-        open for the caller's retry policy."""
+        open for the caller's retry policy. ``liveness`` is the reply
+        channel's client probe, carried into every replica submission
+        (and checked between failover attempts): a disconnected or
+        cancelled client terminates the request within one decode round
+        wherever it sits."""
         subject = self._auth(token)
         from lzy_tpu.rpc.core import Unavailable
 
@@ -222,7 +231,13 @@ class GatewayService:
                     Unavailable,
                     "gateway is draining; retry another endpoint",
                     reason="draining", retry_after_s=None)
-            if not self._waiters.acquire(blocking=False):
+            # streaming session workers (liveness is not None) bypass
+            # the waiter cap: they are dedicated threads bounded by the
+            # session manager's max_sessions, and gating them here
+            # would cap streams at the waiter count while starving
+            # unary callers for each stream's whole lifetime
+            gated = liveness is None
+            if gated and not self._waiters.acquire(blocking=False):
                 raise self._shed_error(
                     Unavailable,
                     "all gateway waiter threads are busy; retry later",
@@ -238,11 +253,13 @@ class GatewayService:
                                       tenant=tenant,
                                       priority=priority,
                                       session=session,
-                                      stream=stream)
+                                      stream=stream,
+                                      liveness=liveness)
             finally:
                 with self._lock:
                     self._inflight -= 1
-                self._waiters.release()
+                if gated:
+                    self._waiters.release()
         except BaseException as e:
             from lzy_tpu.channels.token_stream import fail_if_touched
 
@@ -264,7 +281,7 @@ class GatewayService:
                   tenant: str = DEFAULT_TENANT,
                   priority: Optional[int] = None,
                   session: Optional[str] = None,
-                  stream=None) -> dict:
+                  stream=None, liveness=None) -> dict:
         from lzy_tpu.rpc.core import Unavailable
 
         t0 = time.monotonic()
@@ -280,6 +297,29 @@ class GatewayService:
             remaining = max_new_tokens - len(emitted)
             if remaining <= 0:
                 break
+            if failovers and liveness is not None and self._client_gone(
+                    liveness):
+                # the client cancelled or vanished BETWEEN attempts
+                # (mid-failover): finish with the cancelled contract —
+                # fenced partials readable — instead of resubmitting a
+                # request the retry replica would only reap anyway
+                from lzy_tpu.serving.streams import CANCELS
+
+                CANCELS.inc(phase="failover")
+                if fence is not None:
+                    fence.on_complete(emitted)
+                if stream is not None:
+                    stream.close("cancelled")
+                _REQUESTS.inc(status="cancelled")
+                with self._lock:
+                    self._finished += 1
+                return {
+                    "request_id": None, "tokens": emitted,
+                    "status": "cancelled", "ttft_ms": first_ttft_ms,
+                    "model": self.model_name,
+                    "replica": route[0] if route else None,
+                    "routed_by": route[1] if route else None,
+                    "failovers": failovers, **self._reply_extras()}
             deadline_left = self._remaining_deadline(t0, deadline_s)
             if deadline_left is not None and deadline_left <= 0:
                 # the client deadline ran out between attempts: finish
@@ -305,7 +345,8 @@ class GatewayService:
                 effective_prompt, remaining,
                 t0=t0, deadline_s=deadline_s,
                 exclude=tried_after_failure, greedy=greedy,
-                tenant=tenant, priority=priority, session=session)
+                tenant=tenant, priority=priority, session=session,
+                liveness=liveness)
             route = (replica.id, routed_by)
             if stream is not None:
                 # the fence is the stream position: this attempt's tokens
@@ -425,6 +466,15 @@ class GatewayService:
                 **self._reply_extras()}
 
     @staticmethod
+    def _client_gone(liveness) -> bool:
+        """Guarded liveness probe (a broken probe must not cancel a
+        healthy request — same contract as ``Request.client_dead``)."""
+        try:
+            return not liveness()
+        except Exception:  # noqa: BLE001 — treat a broken probe as alive
+            return False
+
+    @staticmethod
     def _remaining_deadline(t0: float,
                             deadline_s: Optional[float]) -> Optional[float]:
         """The client deadline is absolute from first submission
@@ -441,7 +491,8 @@ class GatewayService:
                        exclude: set, greedy: Optional[bool] = None,
                        tenant: str = DEFAULT_TENANT,
                        priority: Optional[int] = None,
-                       session: Optional[str] = None):
+                       session: Optional[str] = None,
+                       liveness=None):
         """Route + submit with per-replica admission fallback: a replica
         refusing admission (full queue, closed engine) drops out of the
         candidate set and the next-best one is tried; only an empty set
@@ -468,7 +519,7 @@ class GatewayService:
             if not self._pre_submit(
                     replica, prompt,
                     deadline_s=self._remaining_deadline(t0, deadline_s),
-                    tenant=tenant):
+                    tenant=tenant, liveness=liveness):
                 # claimed but never dispatched: release, or the replica
                 # would sit probe-blocked for another open_s
                 self.fleet.health.release_probe(rid)
@@ -485,7 +536,8 @@ class GatewayService:
                 req = replica.engine.submit(
                     prompt, max_new_tokens=max_new_tokens,
                     deadline_s=engine_deadline, greedy=greedy,
-                    tenant=tenant, priority=priority)
+                    tenant=tenant, priority=priority,
+                    liveness=liveness)
             except PromptTooLong:
                 # permanent, request-scoped: it would fail identically
                 # on every replica — no fallback, no health damage
@@ -531,13 +583,15 @@ class GatewayService:
 
     def _pre_submit(self, replica, prompt: List[int],
                     deadline_s: Optional[float] = None,
-                    tenant: str = DEFAULT_TENANT) -> bool:
+                    tenant: str = DEFAULT_TENANT,
+                    liveness=None) -> bool:
         """Hook between routing and submission; False drops the replica
         from this request's candidate set. Subclasses use it for
         per-replica staging work that must not be wasted on a replica
         that cannot admit (the disagg gateway probes the queue and then
         stages KV here — bounded by the request's REMAINING deadline,
-        queued under the request's tenant)."""
+        queued under the request's tenant, and skipped entirely for a
+        client ``liveness`` already reports gone)."""
         return True
 
     def _note_result(self, req) -> None:
@@ -668,6 +722,7 @@ class GatewayService:
 
     def close(self) -> None:
         self._stop.set()
+        self.streams.close()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None

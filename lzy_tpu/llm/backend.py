@@ -109,10 +109,11 @@ class ServiceBackend:
         if kwargs.get("token") is None:
             kwargs["token"] = self.token
         # None-valued extension kwargs are dropped UP FRONT: a surface
-        # that takes session but not stream/token (RpcInferenceClient —
-        # it carries its own credential) must still receive the session
-        # hint, not be forced onto the degraded path by a None it cannot
-        # accept
+        # that takes session but not token (RpcInferenceClient — it
+        # carries its own credential; since the streaming PR it DOES
+        # take stream, long-polling InferStream frames into the channel
+        # incrementally) must still receive the session hint, not be
+        # forced onto the degraded path by a None it cannot accept
         for opt in ("token", "session", "stream"):
             if kwargs.get(opt) is None:
                 kwargs.pop(opt, None)

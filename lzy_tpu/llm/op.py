@@ -22,7 +22,11 @@ What riding the workflow buys a generation, for free:
   N+1 lands on the replica whose RadixCache holds steps 1..N.
 - **streaming**: a ``channels.token_stream.TokenStreamChannel`` receives
   tokens as the engine emits them; the gateway's fenced-token failover
-  makes a mid-stream replica death invisible to the channel.
+  makes a mid-stream replica death invisible to the channel. Against a
+  REMOTE plane (``LZY_LLM_ENDPOINT``) the same channel is fed by the
+  ``InferStream`` chunked long-poll (``rpc/schema.py``): tokens arrive
+  incrementally over the wire, and a dropped worker connection resumes
+  at the fence position byte-identically.
 - **provenance**: ``record_generation`` versions the result (prompt,
   params, model digest, token ids, routing/KV provenance) as whiteboard
   fields queryable after the run.

@@ -336,6 +336,17 @@ class ControlPlaneServer:
                         "inference service is still booting; retry")
                 return svc
 
+            def _streams():
+                # streaming front (serving/streams.py); a surface
+                # without one (a custom service predating streaming)
+                # answers NOT_FOUND — the honest capability signal the
+                # client's degradation ladder keys on
+                streams = getattr(_infer_svc(), "streams", None)
+                if streams is None:
+                    raise KeyError(
+                        "this plane's serving surface does not stream")
+                return streams
+
             handlers.update({
                 # inference surface (serving plane; serve.py --serve-model):
                 # blocking generate rides the same gRPC stack — deadlines,
@@ -350,6 +361,27 @@ class ControlPlaneServer:
                     priority=p.get("priority"),
                     session=p.get("session"),
                     token=p.get("token")),
+                # streaming delivery: open / long-poll frames / cancel
+                # (wire contract in rpc/schema.py; the long-poll rides
+                # the same unary gRPC stack, so deadlines, status codes
+                # and IAM all apply per frame)
+                "InferStream": lambda p: _streams().open(
+                    p["prompt"],
+                    max_new_tokens=int(p.get("max_new_tokens", 64)),
+                    timeout_s=p.get("timeout_s"),
+                    deadline_s=p.get("deadline_s"),
+                    greedy=p.get("greedy"),
+                    tenant=p.get("tenant"),
+                    priority=p.get("priority"),
+                    session=p.get("session"),
+                    token=p.get("token")),
+                "InferStreamPoll": lambda p: _streams().poll(
+                    p["request_id"],
+                    int(p.get("position", 0)),
+                    wait_s=float(p.get("wait_s", 5.0)),
+                    token=p.get("token")),
+                "InferCancel": lambda p: _streams().cancel(
+                    p["request_id"], token=p.get("token")),
                 "InferStats": lambda p: _infer_svc().stats(
                     token=p.get("token")),
             })
@@ -766,7 +798,8 @@ class RpcInferenceClient:
                  greedy: Optional[bool] = None,
                  tenant: Optional[str] = None,
                  priority: Optional[int] = None,
-                 session: Optional[str] = None) -> dict:
+                 session: Optional[str] = None,
+                 stream=None) -> dict:
         """``prompt``: list of token ids. Returns ``{"request_id",
         "tokens", "status", "ttft_ms", "model"}`` (generated ids only, no
         echo). ``deadline_s`` is the engine-side client deadline: past it
@@ -778,7 +811,22 @@ class RpcInferenceClient:
         ``tenant``/``priority``: SLO identity (see the wire-schema note —
         under IAM the tenant is the bearer token's subject, and the
         field may only restate it). Tenant-scoped refusals raise
-        ``serving.scheduler.QuotaExceeded`` with ``retry_after_s``."""
+        ``serving.scheduler.QuotaExceeded`` with ``retry_after_s``.
+
+        ``stream`` (a ``channels.token_stream.TokenStreamChannel``)
+        switches to the server-streamed path: the call opens an
+        ``InferStream`` and long-polls position-tagged frames into the
+        channel as the engine produces them, transparently resuming at
+        the fence position across dropped connections (the reply is
+        assembled from the frames and byte-identical to the unary one).
+        Against an older plane without the streaming surface it degrades
+        to unary delivery with one terminal flush into the channel."""
+        if stream is not None:
+            return self._generate_streamed(
+                prompt, max_new_tokens=max_new_tokens,
+                timeout_s=timeout_s, deadline_s=deadline_s,
+                greedy=greedy, tenant=tenant, priority=priority,
+                session=session, stream=stream)
         rpc_timeout = (timeout_s or 120.0) + 30.0   # server waits first
         return self._client.call("InferGenerate", {
             "prompt": list(prompt),
@@ -791,6 +839,147 @@ class RpcInferenceClient:
             "session": session,
             "token": _token_value(self._token),
         }, timeout_s=rpc_timeout)
+
+    # -- streaming delivery (InferStream / InferStreamPoll / InferCancel) ------
+
+    def stream_open(self, prompt, *, max_new_tokens: int = 64,
+                    timeout_s: Optional[float] = None,
+                    deadline_s: Optional[float] = None,
+                    greedy: Optional[bool] = None,
+                    tenant: Optional[str] = None,
+                    priority: Optional[int] = None,
+                    session: Optional[str] = None) -> dict:
+        """Open a server-streamed generation; returns ``{"request_id",
+        "position": 0, "model"}`` — the resume token. Admission
+        refusals keep their unary wire statuses (UNAVAILABLE /
+        RESOURCE_EXHAUSTED / INVALID_ARGUMENT) and nothing is opened."""
+        return self._client.call("InferStream", {
+            "prompt": list(prompt),
+            "max_new_tokens": int(max_new_tokens),
+            "timeout_s": timeout_s,
+            "deadline_s": deadline_s,
+            "greedy": greedy,
+            "tenant": tenant,
+            "priority": priority,
+            "session": session,
+            "token": _token_value(self._token),
+        })
+
+    def stream_poll(self, request_id: str, position: int = 0, *,
+                    wait_s: float = 5.0) -> dict:
+        """One long-poll frame (wire contract in ``rpc/schema.py``):
+        every token from ``position`` on, or a keepalive after
+        ``wait_s``. Idempotent — re-polling the same position after a
+        lost reply reads a byte-identical continuation, which is the
+        whole resume story. Safe to retry bare (it is a READ)."""
+        return self._client.call("InferStreamPoll", {
+            "request_id": request_id,
+            "position": int(position),
+            "wait_s": wait_s,
+            "token": _token_value(self._token),
+        }, timeout_s=wait_s + 30.0, retry=True)
+
+    def cancel(self, request_id: str) -> dict:
+        """Cancel a streamed generation mid-flight; the stream
+        terminates with ``status: "cancelled"`` and the server frees
+        the request's slot and KV blocks within one decode round.
+        Idempotent (a second cancel reports the terminal status)."""
+        return self._client.call("InferCancel", {
+            "request_id": request_id,
+            "token": _token_value(self._token),
+        }, retry=True)
+
+    def iter_stream(self, request_id: str, position: int = 0, *,
+                    wait_s: float = 5.0, deadline_s: float = 180.0,
+                    max_poll_failures: int = 8):
+        """Generator over a stream's frames from ``position`` — ALSO the
+        resume surface: after a client crash or connection death, a new
+        client iterates from the last position it durably consumed and
+        the frames are byte-identical. Transient poll failures
+        (UNAVAILABLE, deadline) re-poll the same position; only
+        ``max_poll_failures`` CONSECUTIVE failures give up."""
+        from lzy_tpu.rpc.core import Unavailable
+
+        pos = int(position)
+        failures = 0
+        deadline = time.time() + deadline_s
+        while True:
+            try:
+                frame = self.stream_poll(request_id, pos, wait_s=wait_s)
+                failures = 0
+            except (Unavailable, TimeoutError):
+                failures += 1
+                if failures > max_poll_failures or time.time() > deadline:
+                    raise
+                continue
+            yield frame
+            pos += len(frame.get("tokens", ()))
+            if frame.get("done"):
+                return
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"stream {request_id} not finished within "
+                    f"{deadline_s}s")
+
+    def _generate_streamed(self, prompt, *, max_new_tokens: int,
+                           timeout_s: Optional[float],
+                           deadline_s: Optional[float],
+                           greedy: Optional[bool],
+                           tenant: Optional[str],
+                           priority: Optional[int],
+                           session: Optional[str], stream) -> dict:
+        """The unary-compatible reply assembled from streamed frames;
+        tokens land in ``stream`` incrementally at their wire position
+        (the channel's fence verification applies — a diverging resume
+        raises instead of splicing)."""
+        from lzy_tpu.channels.token_stream import fail_if_touched
+
+        try:
+            try:
+                opened = self.stream_open(
+                    prompt, max_new_tokens=max_new_tokens,
+                    timeout_s=timeout_s, deadline_s=deadline_s,
+                    greedy=greedy, tenant=tenant, priority=priority,
+                    session=session)
+            except (NotImplementedError, KeyError):
+                # no streaming on this plane: an older server answers
+                # UNIMPLEMENTED (method unregistered), a NEW server
+                # fronting a custom surface without a session manager
+                # answers NOT_FOUND — both degrade to unary delivery
+                # with one terminal flush (the consumer sees the whole
+                # generation at once — late, never wrong)
+                reply = self.generate(
+                    prompt, max_new_tokens=max_new_tokens,
+                    timeout_s=timeout_s, deadline_s=deadline_s,
+                    greedy=greedy, tenant=tenant, priority=priority,
+                    session=session)
+                stream.publish(0, reply.get("tokens", []))
+                stream.close(reply.get("status", "ok"))
+                return reply
+            rid = opened["request_id"]
+            tokens: List[int] = []
+            budget = (timeout_s or 120.0) + 30.0
+            for frame in self.iter_stream(rid, 0, deadline_s=budget):
+                new = frame.get("tokens", [])
+                if new:
+                    stream.publish(len(tokens), new)
+                    tokens.extend(int(t) for t in new)
+                if frame.get("done"):
+                    status = frame.get("status") or "ok"
+                    if status == "error":
+                        raise RuntimeError(
+                            f"stream {rid} failed: {frame.get('error')}")
+                    stream.close(status)
+                    reply = dict(frame.get("reply") or {})
+                    reply.setdefault("request_id", rid)
+                    reply.setdefault("model", opened.get("model"))
+                    reply["status"] = status
+                    reply["tokens"] = tokens
+                    return reply
+            raise RuntimeError(f"stream {rid} ended without a done frame")
+        except BaseException as e:
+            fail_if_touched(stream, e)
+            raise
 
     def stats(self) -> dict:
         return self._client.call("InferStats", {

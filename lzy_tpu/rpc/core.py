@@ -199,4 +199,9 @@ def _to_exception(e: grpc.RpcError) -> BaseException:
         return TimeoutError(detail)
     if code == grpc.StatusCode.INVALID_ARGUMENT:
         return ValueError(detail)
+    if code == grpc.StatusCode.UNIMPLEMENTED:
+        # the method is not registered on this plane at all (an older
+        # server) — a typed capability signal clients degrade on (the
+        # streaming client falls back to unary InferGenerate)
+        return NotImplementedError(detail)
     return RuntimeError(detail)

@@ -308,6 +308,45 @@ REQUESTS: Dict[str, Schema] = {
         "tenant": f(str),
         "priority": f(int),
         "session": f(str), **_TOKEN}),
+    # streaming delivery (chunked long-poll; docs/serving.md "Streaming
+    # delivery"). InferStream admits a generation and returns the resume
+    # token's birth: {"request_id", "position": 0, "model"} — fast
+    # admission refusals (full queue, quota, over-long prompt) keep
+    # their unary wire statuses. InferStreamPoll(request_id, position)
+    # long-polls one position-tagged frame: {"position", "tokens"
+    # (everything from position on), "done", "keepalive", "phase"
+    # (queued|prefill|decode — a long prefill is not a stalled engine),
+    # "resumptions"}; done frames add {"status", "error", "reply"
+    # (route metadata sans tokens)}. Frames are IDEMPOTENT reads: the
+    # same (request_id, position) always returns a byte-identical
+    # continuation, so a dropped connection, a lost reply, or a gateway
+    # failover all resume by re-polling the last position — the
+    # failover fence IS the wire position. Polling past the fence is
+    # INVALID_ARGUMENT (a corrupt resume token must not splice).
+    # Polls ARE the client's liveness: a stream not polled within the
+    # plane's liveness window is reaped (queued requests popped in
+    # place, slot-resident ones evicted with KV blocks released within
+    # one decode round), and a consumer lagging past the ack window for
+    # longer than the grace is shed — the plane never buffers
+    # unboundedly for a consumer that stopped reading.
+    # InferCancel(request_id) propagates mid-stream through gateway →
+    # disagg → engine; the stream terminates with status "cancelled"
+    # and the tokens emitted so far.
+    "InferStream": Schema("InferStreamRequest", {
+        "prompt": f(list, required=True),
+        "max_new_tokens": f(int),
+        "timeout_s": f(float, int),
+        "deadline_s": f(float, int),
+        "greedy": f(bool),
+        "tenant": f(str),
+        "priority": f(int),
+        "session": f(str), **_TOKEN}),
+    "InferStreamPoll": Schema("InferStreamPollRequest", {
+        "request_id": f(str, required=True),
+        "position": f(int),
+        "wait_s": f(float, int), **_TOKEN}),
+    "InferCancel": Schema("InferCancelRequest", {
+        "request_id": f(str, required=True), **_TOKEN}),
     "InferStats": Schema("InferStatsRequest", {**_TOKEN}),
     # gateway-only: per-replica fleet breakdown (serve.py --gateway). On
     # a disaggregated plane each row carries "pool" ("prefill"|"decode")

@@ -47,11 +47,17 @@ class InferenceService:
                  max_waiters: int = 8, slo=None):
         import threading
 
+        from lzy_tpu.serving.streams import StreamSessionManager
+
         self.engine = engine
         self.model_name = model_name
         self.iam = iam        # harness wires the cluster's IAM in here
         self.slo = slo
         self._waiters = threading.BoundedSemaphore(max_waiters)
+        #: streaming front (InferStream/InferStreamPoll/InferCancel):
+        #: chunked long-poll token delivery with liveness reaping,
+        #: bounded consumer buffers, and mid-stream cancellation
+        self.streams = StreamSessionManager(self)
 
     def _auth(self, token: Optional[str]):
         if self.iam is not None:
@@ -79,7 +85,7 @@ class InferenceService:
                  tenant: Optional[str] = None,
                  priority: Optional[int] = None,
                  session: Optional[str] = None,
-                 stream=None) -> dict:
+                 stream=None, liveness=None) -> dict:
         """Blocking generate: admit, wait, return generated token ids.
         Backpressure (full queue OR all waiter threads busy) surfaces as
         ``Unavailable`` BEFORE any work happens — safe for the caller to
@@ -101,7 +107,10 @@ class InferenceService:
         ``channels.token_stream.TokenStreamChannel``) receives tokens
         incrementally and is closed before this returns — or failed
         before it raises if any tokens were published (a never-touched
-        stream is left open for the caller's retry policy)."""
+        stream is left open for the caller's retry policy). ``liveness``
+        (a zero-arg callable) is the reply channel's client probe: once
+        it returns False the engine reaps the request wherever it sits
+        — queued, staged, or slot-resident — within one decode round."""
         subject = self._auth(token)
         from lzy_tpu.rpc.core import Unavailable
 
@@ -110,7 +119,15 @@ class InferenceService:
         if self.slo is not None:
             policy = self.slo.admit(tenant, len(prompt))
             priority = policy.effective_priority(priority)
-        if not self._waiters.acquire(blocking=False):
+        # the waiter cap protects the SHARED gRPC handler pool from
+        # parking in req.wait(); a streaming session's worker (the only
+        # caller passing liveness) is a dedicated thread whose
+        # concurrency is already bounded by the session manager's
+        # max_sessions — gating it here would silently cap streams at
+        # the waiter count AND starve unary traffic for the lifetime of
+        # every long-lived stream
+        gated = liveness is None
+        if gated and not self._waiters.acquire(blocking=False):
             raise Unavailable(
                 "all inference waiter threads are busy; retry later")
         try:
@@ -121,7 +138,8 @@ class InferenceService:
                     deadline_s=deadline_s,
                     greedy=greedy,
                     tenant=tenant,
-                    priority=priority)
+                    priority=priority,
+                    liveness=liveness)
             except PromptTooLong:
                 # permanent rejection keeps its INVALID_ARGUMENT wire
                 # status — not the generic capacity Unavailable below
@@ -165,7 +183,8 @@ class InferenceService:
             fail_if_touched(stream, e)
             raise
         finally:
-            self._waiters.release()
+            if gated:
+                self._waiters.release()
         ttft_ms = None
         if req.first_token_at is not None:
             ttft_ms = round(1000 * (req.first_token_at - req.submitted_at), 3)
@@ -199,6 +218,7 @@ class InferenceService:
         return self.engine.drain(timeout_s)
 
     def close(self) -> None:
+        self.streams.close()
         self.engine.close()
 
 

@@ -177,6 +177,26 @@ def main(argv=None) -> int:
                              "{tenant: {priority, weight, requests_per_s, "
                              "prompt_tokens_per_s, kv_block_quota, "
                              "max_queued, burst_s}}")
+    parser.add_argument("--stream-ack-window", type=int, default=1024,
+                        help="streaming delivery: max tokens a consumer "
+                             "may lag the producer before it counts as "
+                             "stalled (bounded buffer; docs/serving.md "
+                             "'Streaming delivery')")
+    parser.add_argument("--stream-stall-grace-s", type=float, default=5.0,
+                        help="streaming delivery: continuous stall beyond "
+                             "the ack window tolerated before the slow "
+                             "consumer is shed (request cancelled, slot "
+                             "and KV blocks freed)")
+    parser.add_argument("--stream-liveness-s", type=float, default=15.0,
+                        help="streaming delivery: a stream not polled for "
+                             "this long counts as a disconnected client — "
+                             "its request is reaped from the queue in "
+                             "place or evicted from its slot within one "
+                             "decode round")
+    parser.add_argument("--stream-max-sessions", type=int, default=64,
+                        help="streaming delivery: concurrent stream "
+                             "sessions before opens shed with a retry "
+                             "hint (each session pins a worker thread)")
     parser.add_argument("--drain-timeout-s", type=float, default=30.0,
                         help="graceful-shutdown budget on SIGTERM/SIGINT: "
                              "the serving plane stops admitting, finishes "
@@ -386,6 +406,16 @@ def main(argv=None) -> int:
         inference_service=inference_service,
         inference_factory=inference_factory,
     )
+    # streaming-delivery knobs (the session manager is built with
+    # library defaults; the flags are the deployment's word)
+    serving_now = cluster.inference_service or inference_service
+    if serving_now is not None and hasattr(serving_now, "streams"):
+        streams = serving_now.streams
+        streams.ack_window = args.stream_ack_window
+        streams.stall_grace_s = args.stream_stall_grace_s
+        streams.liveness_timeout_s = args.stream_liveness_s
+        streams.max_sessions = args.stream_max_sessions
+
     server = cluster.serve(args.port)
     model = f", model={args.serve_model}" if args.serve_model else ""
     if args.gateway:

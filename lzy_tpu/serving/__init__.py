@@ -26,6 +26,11 @@ most TPU serving throughput: single-pass prefill and continuous batching).
   at admission, weighted fair queueing in the scheduler, chunked-prefill
   interleaving in the engines so one tenant's 32k-token prompt cannot
   starve another tenant's token stream.
+- ``streams``: server-streamed delivery over the RPC plane — chunked
+  long-poll frames whose position IS the gateway failover fence, with
+  poll-cadence liveness (disconnected clients reaped within one decode
+  round), bounded consumer buffers with backpressure-or-shed, and
+  mid-stream ``InferCancel``.
 
 Expose over the control plane with ``lzy_tpu.service.inference`` (the
 ``--serve-model`` flag of ``lzy_tpu.service.serve``).
@@ -38,6 +43,7 @@ from lzy_tpu.serving.kv_cache import (
 from lzy_tpu.serving.scheduler import (
     AdmissionError, PromptTooLong, QuotaExceeded, Request, RequestQueue)
 from lzy_tpu.serving.spec import NgramProposer
+from lzy_tpu.serving.streams import StreamSession, StreamSessionManager
 from lzy_tpu.serving.tenancy import (
     SloLimiter, TenantPolicy, TenantTable, TokenBucket)
 from lzy_tpu.serving.disagg import (
@@ -60,6 +66,8 @@ __all__ = [
     "Request",
     "RequestQueue",
     "SloLimiter",
+    "StreamSession",
+    "StreamSessionManager",
     "TenantPolicy",
     "TenantTable",
     "TokenBucket",

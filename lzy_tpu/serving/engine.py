@@ -405,7 +405,8 @@ class InferenceEngine:
                deadline_s: Optional[float] = None,
                greedy: Optional[bool] = None,
                tenant: str = "default",
-               priority: Optional[int] = None) -> Request:
+               priority: Optional[int] = None,
+               liveness=None) -> Request:
         """Admit a request (raises ``AdmissionError`` under backpressure,
         ``PromptTooLong`` if it can never fit the cache). Returns the
         :class:`Request`; wait with ``request.result(timeout)``.
@@ -416,7 +417,11 @@ class InferenceEngine:
         and with it speculation eligibility — on a sampling engine; None
         follows the engine-wide temperature). ``tenant``/``priority``:
         SLO identity — the WFQ subqueue and fairness tier the request
-        queues under (quotas and rate limits key on the tenant)."""
+        queues under (quotas and rate limits key on the tenant).
+        ``liveness``: optional reply-channel probe (returns False once
+        the client is gone) — checked every scheduling round, so a
+        disconnected client's request is reaped from the queue in place
+        or evicted from its slot within one decode round."""
         if self._closed or self._draining:
             # fail fast instead of admitting into a queue no loop will ever
             # drain (shutdown stops the engine before the RPC server, so
@@ -445,7 +450,8 @@ class InferenceEngine:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         req = Request(prompt, max_new_tokens, request_id=request_id,
                       deadline_s=deadline_s, greedy=greedy,
-                      tenant=tenant, priority=priority)
+                      tenant=tenant, priority=priority,
+                      liveness=liveness)
         self.queue.submit(req)
         with self._outstanding_lock:
             self._outstanding = {r for r in self._outstanding
@@ -489,15 +495,18 @@ class InferenceEngine:
         return admitted or progressed or stepped
 
     def _reap_cancelled(self) -> None:
-        """Free slots whose waiter abandoned the request (client timeout)
-        or whose client deadline passed: decode steps are the scarce
-        resource, and spending them on tokens nobody will read starves
-        live requests. Either way the request terminates with the
-        ``cancelled`` status (partial tokens stay readable)."""
+        """Free slots whose waiter abandoned the request (client
+        timeout), whose client deadline passed, or whose reply channel
+        reports the client gone (``Request.client_dead`` — a streaming
+        consumer that disconnected or stalled past its bounded buffer):
+        decode steps are the scarce resource, and spending them on
+        tokens nobody will read starves live requests. Either way the
+        request terminates with the ``cancelled`` status (partial
+        tokens stay readable)."""
         for req in self.queue.reap_dead():
             self._finish_cancelled(req)
         for job in list(self._prefill_jobs):
-            if job.req.cancelled or job.req.expired:
+            if job.req.reapable:
                 # a mid-prefill abandon releases everything staged (the
                 # paged engine returns the job's blocks to the pool)
                 self._abort_prefill_job(job)
@@ -505,7 +514,7 @@ class InferenceEngine:
         for slot, req in enumerate(self._active):
             if req is None:
                 continue
-            if req.cancelled or req.expired:
+            if req.reapable:
                 # free BEFORE finishing: finish() wakes the waiter, and a
                 # client that sees its request done must also see the
                 # slot/blocks released (stats read-your-writes)
@@ -517,8 +526,20 @@ class InferenceEngine:
         TENANT_REQUESTS.inc(tenant=req.tenant, status="cancelled")
         self._tenant_count(req.tenant, "requests_cancelled")
         self._cancelled += 1
-        why = "cancelled: deadline exceeded" if req.expired and \
-            not req.cancelled else "cancelled"
+        if req.cancelled:
+            why = "cancelled"
+        elif req.expired:
+            why = "cancelled: deadline exceeded"
+        else:
+            why = "cancelled: client disconnected"
+        if req.liveness is not None:
+            # stream-delivered request: count the cancel under the phase
+            # it was reaped in (queued / prefill / decode) — the
+            # observable difference between "the queue absorbed it" and
+            # "a slot was burned first"
+            from lzy_tpu.serving.streams import CANCELS
+
+            CANCELS.inc(phase=req.phase)
         req.finish(error=why, status="cancelled")
 
     def _tenant_count(self, tenant: str, key: str, n: int = 1) -> None:
@@ -562,7 +583,7 @@ class InferenceEngine:
                 break
             rescan = False
             for req in self.queue.candidates():
-                if req.cancelled or req.expired:
+                if req.reapable:
                     if self.queue.pop_request(req):
                         self._finish_cancelled(req)
                     rescan = True
@@ -573,6 +594,7 @@ class InferenceEngine:
                 if verdict == "wait":
                     break
                 self.queue.pop_request(req)
+                req.phase = "prefill"
                 try:
                     job = self._stage_prefill(slot, req)
                 except PoolCorruption:
@@ -625,7 +647,7 @@ class InferenceEngine:
             self._next_prefill = 0
         job = self._prefill_jobs[self._next_prefill]
         req = job.req
-        if req.cancelled or req.expired:
+        if req.reapable:
             self._abort_prefill_job(job)
             self._finish_cancelled(req)
             return True
@@ -721,6 +743,7 @@ class InferenceEngine:
     def _finish_prefill(self, slot: int, req: Request, first: int) -> None:
         """Shared prefill tail: record TTFT, emit the first token, and
         either free the slot (one-token request) or activate it."""
+        req.phase = "decode"
         now = time.monotonic()
         req.first_token_at = now
         _TTFT.observe(now - req.submitted_at)

@@ -190,7 +190,8 @@ class Request:
                  deadline_s: Optional[float] = None,
                  greedy: Optional[bool] = None,
                  tenant: str = DEFAULT_TENANT,
-                 priority: Optional[int] = None):
+                 priority: Optional[int] = None,
+                 liveness=None):
         self.id = request_id or f"req-{next(_ids)}"
         self.prompt: List[int] = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -213,6 +214,19 @@ class Request:
         #: bug must never kill the decode loop. None costs one attribute
         #: load per emitted token.
         self.token_sink = None
+        #: optional reply-channel liveness probe (a streaming session's
+        #: ``alive`` — ``serving.streams``): the engines call it every
+        #: scheduling round via :meth:`client_dead`, so a client that
+        #: disconnected (stopped polling) or stalled past the bounded
+        #: buffer is reaped wherever the request sits — queued, staged,
+        #: or slot-resident — within one decode round. None (unary
+        #: callers) costs one attribute load per reap sweep.
+        self.liveness = liveness
+        #: scheduling phase, maintained by the engine: ``queued`` →
+        #: ``prefill`` (staged) → ``decode`` (slot-resident). Read by
+        #: streaming keepalive frames (a long prefill is not a stalled
+        #: engine) and by the cancel-by-phase accounting.
+        self.phase = "queued"
         #: provenance: the prefill-pool replica whose imported KV blocks
         #: this request's prefix match actually HIT (None: locally
         #: prefilled, dense engine, or no match) — set by the paged
@@ -237,6 +251,29 @@ class Request:
     def expired(self) -> bool:
         """Client deadline passed (the engine reaps these like cancels)."""
         return self.deadline is not None and time.monotonic() > self.deadline
+
+    @property
+    def client_dead(self) -> bool:
+        """The reply channel's liveness says nobody is reading — the
+        engine reaps these like cancels (a dead client must never hold a
+        slot or KV blocks to the full deadline). A liveness probe that
+        RAISES is detached and treated as alive: a broken probe must not
+        cancel a healthy request, and the deadline still bounds it."""
+        probe = self.liveness
+        if probe is None:
+            return False
+        try:
+            return not probe()
+        except Exception:  # noqa: BLE001 — see docstring
+            self.liveness = None
+            return False
+
+    @property
+    def reapable(self) -> bool:
+        """Cancelled, past deadline, or abandoned by its client — the
+        one predicate every reap sweep (queue, staged prefill jobs,
+        slots) checks."""
+        return self.cancelled or self.expired or self.client_dead
 
     def finish(self, error: Optional[str] = None,
                status: Optional[str] = None) -> None:
@@ -477,13 +514,17 @@ class RequestQueue:
     # -- maintenance ---------------------------------------------------------
 
     def reap_dead(self) -> List[Request]:
-        """Remove every cancelled/expired request, wherever it sits in
-        the queue — a passed deadline must terminate promptly even while
-        every slot is busy, not when a slot finally frees."""
+        """Remove every cancelled/expired/client-dead request, wherever
+        it sits in the queue — a passed deadline must terminate promptly
+        even while every slot is busy, not when a slot finally frees,
+        and a request whose client disconnected while still QUEUED is
+        reaped in place (``Request.client_dead`` probes the reply
+        channel's liveness) instead of eventually wasting a slot on
+        tokens nobody will read."""
         dead: List[Request] = []
         with self._lock:
             for q in list(self._subq.values()):
-                dead.extend(r for r in q if r.cancelled or r.expired)
+                dead.extend(r for r in q if r.reapable)
             for r in dead:
                 self._remove_locked(r)
         return dead

@@ -1,0 +1,476 @@
+"""Server-streamed token delivery over the unary RPC plane.
+
+The serving front answered ``InferGenerate`` with one blob: a client
+that died mid-generation either burned a decode slot to the full
+deadline or lost every emitted token. This module is the missing wire
+layer between the engine's per-emission ``token_sink`` and the RPC
+surface — a **chunked long-poll** stream (``InferStream`` /
+``InferStreamPoll`` / ``InferCancel``) whose frames are position-tagged,
+so the gateway's fenced-token failover fence IS the wire position:
+
+- **open** admits the request and returns a stream id; the generation
+  runs in a session worker thread against the ordinary ``generate``
+  surface with a :class:`~lzy_tpu.channels.token_stream.TokenStreamChannel`
+  attached (fence verification, failover resumption and splice rejection
+  all come from the channel, not from new code here);
+- **poll(position)** is the resume token in action: it blocks until the
+  stream moves past ``position`` (or a keepalive interval passes) and
+  returns ``tokens[position:]`` — a reconnecting client, a gateway
+  retry, or a replica failover all re-poll at their last position and
+  read a byte-identical continuation. A poll at ``position`` also ACKS
+  everything below it (consumer progress for the backpressure policy);
+- **liveness is the poll cadence**: the session's ``alive`` callable
+  rides ``Request.liveness`` into the engines, which check it every
+  scheduling round — a client that stops polling is reaped from the
+  queue in place, or evicted from its slot (KV blocks released, tenant
+  counters reconciled) within one decode round;
+- **slow consumers are bounded**: past ``ack_window`` unacknowledged
+  tokens the session counts stall seconds, and past ``stall_grace_s``
+  of continuous stall it sheds the consumer (request cancelled, stream
+  failed with a typed message) instead of buffering without bound;
+- **keepalive frames** (empty ``tokens``, ``keepalive: true``) carry the
+  request's phase (``queued`` / ``prefill`` / ``decode``) so a client
+  can tell a long prefill from a stalled engine.
+
+``InferCancel`` propagates mid-stream: the session cancels the attached
+request (and flips its liveness), and the engine's reaper frees the slot
+and KV blocks within one decode round — same path, same invariants, as a
+deadline eviction. Cancels are counted by the phase the request was in.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from lzy_tpu.channels.token_stream import TokenStreamChannel
+from lzy_tpu.chaos.faults import CHAOS, DELAY, ERROR, SLOW
+from lzy_tpu.serving.scheduler import shed_error
+from lzy_tpu.utils.ids import gen_id
+from lzy_tpu.utils.log import get_logger
+from lzy_tpu.utils.metrics import REGISTRY
+
+_LOG = get_logger(__name__)
+
+FRAMES = REGISTRY.counter(
+    "lzy_stream_frames_total",
+    "streaming long-poll frames served, by kind (tokens/keepalive/done)")
+RESUMES = REGISTRY.counter(
+    "lzy_stream_resumes_total",
+    "polls that re-read an already-delivered range (a client resumed at "
+    "its fence position after a dropped connection or lost reply)")
+CANCELS = REGISTRY.counter(
+    "lzy_stream_cancels_total",
+    "streamed requests that terminated cancelled (explicit InferCancel, "
+    "client disconnect, deadline), by the phase they were reaped in")
+STALL_SECONDS = REGISTRY.counter(
+    "lzy_stream_consumer_stall_seconds_total",
+    "seconds stream consumers spent beyond the ack window (lagging the "
+    "producer by more than the bounded buffer)")
+SHED_SLOW = REGISTRY.counter(
+    "lzy_stream_shed_slow_consumers_total",
+    "stream consumers shed after stalling past the grace window "
+    "(request cancelled instead of buffering without bound)")
+SESSIONS = REGISTRY.gauge(
+    "lzy_stream_sessions", "live streaming sessions (not yet terminal)")
+
+
+class ConsumerGone(RuntimeError):
+    """The stream's consumer is gone (dead connection) or too slow for
+    the bounded buffer — the session's degradation path cancels the
+    producing request and frees its resources."""
+
+
+def _unavailable():
+    from lzy_tpu.rpc.core import Unavailable
+
+    return Unavailable
+
+
+# chaos boundaries. ``rpc.stream`` is the frame-serving path: error mode
+# is a dropped connection / lost frame — SURVIVABLE by contract, because
+# the client re-polls at its fence position and the continuation is
+# byte-identical (position-tagged frames are idempotent reads).
+# ``stream.consumer`` is the consumer side of the same boundary: delay /
+# slow simulate a lagging client (the ack-window policy must bound it),
+# error simulates the client dying mid-poll — the session marks itself
+# dead and the engines reap the request within one decode round.
+_FP_RPC_STREAM = CHAOS.register(
+    "rpc.stream", error=ConnectionError, modes=(ERROR, DELAY, SLOW),
+    doc="one streaming long-poll frame (drop/delay/connection death -> "
+        "client resumes byte-identically at the fence position)")
+_FP_CONSUMER = CHAOS.register(
+    "stream.consumer", error=ConsumerGone, modes=(ERROR, DELAY, SLOW),
+    doc="the consumer side of a stream poll (slow client -> ack-window "
+        "backpressure; dead client -> liveness reap within one round)")
+
+
+class StreamSession:
+    """One streamed generation: the channel (fence + buffer), the worker
+    thread driving the blocking ``generate`` surface, and the liveness /
+    backpressure state the engines consult per scheduling round."""
+
+    def __init__(self, manager: "StreamSessionManager", request_id: str,
+                 subject_id: Optional[str], tenant: Optional[str]):
+        self._manager = manager
+        self.id = request_id
+        self.subject_id = subject_id
+        self.tenant = tenant
+        self.channel = TokenStreamChannel(request_id)
+        self.reply: Optional[dict] = None
+        self.error: Optional[BaseException] = None
+        self.opened_at = time.monotonic()
+        self.last_poll = self.opened_at
+        self.finished = threading.Event()
+        self._cancelled = False
+        self._dead_reason: Optional[str] = None
+        self._stall_since: Optional[float] = None
+        #: polls currently BLOCKED in the long-poll wait: a parked poll
+        #: is a live connection, so the liveness window must not tick
+        #: against it (a client may legitimately wait_s longer than the
+        #: liveness timeout)
+        self._polling = 0
+        #: high-water mark of positions already SERVED in a frame
+        #: (position + len(tokens)); a poll below it means the client
+        #: lost the reply (or the connection) and resumed at its fence
+        self._served = 0
+        self._lock = threading.Lock()
+
+    # -- liveness / backpressure (called by the engines, every round) --------
+
+    def alive(self) -> bool:
+        """The reply channel's liveness, as the engines see it: False
+        once the client cancelled, disconnected (no poll within the
+        liveness window), or stalled past the bounded buffer's grace —
+        the engine then reaps the request like a passed deadline. Cheap
+        by design: it runs inside the engine's scheduling round (and
+        under the request queue's lock for queued requests)."""
+        now = time.monotonic()
+        lag = self.channel.consumer_lag
+        with self._lock:
+            if self._cancelled or self._dead_reason is not None:
+                return False
+            if self._polling == 0 and \
+                    now - self.last_poll > self._manager.liveness_timeout_s:
+                # no poll in the window AND none currently parked in the
+                # long-poll wait (a parked poll IS the live connection —
+                # wait_s may legitimately exceed the liveness timeout)
+                self._dead_reason = (
+                    f"client disconnected (no poll in "
+                    f"{self._manager.liveness_timeout_s:.1f}s)")
+                return False
+            if lag > self._manager.ack_window:
+                if self._stall_since is None:
+                    self._stall_since = now
+                elif now - self._stall_since > self._manager.stall_grace_s:
+                    self._dead_reason = (
+                        f"slow consumer shed: {lag} unacknowledged tokens "
+                        f"(> ack_window {self._manager.ack_window}) for "
+                        f"{now - self._stall_since:.1f}s")
+                    STALL_SECONDS.inc(now - self._stall_since)
+                    self._stall_since = None
+                    SHED_SLOW.inc()
+                    return False
+            elif self._stall_since is not None:
+                STALL_SECONDS.inc(now - self._stall_since)
+                self._stall_since = None
+            return True
+
+    @property
+    def dead_reason(self) -> Optional[str]:
+        with self._lock:
+            return self._dead_reason
+
+    def mark_dead(self, reason: str) -> None:
+        with self._lock:
+            if self._dead_reason is None:
+                self._dead_reason = reason
+
+    def touch(self) -> None:
+        with self._lock:
+            self.last_poll = time.monotonic()
+
+    @property
+    def phase(self) -> str:
+        """Where the request currently sits (for keepalive frames: a
+        client distinguishes a long prefill from a stalled engine).
+        ``queued`` until the engine attaches the request to the
+        channel."""
+        req = self.channel.attached_request
+        return getattr(req, "phase", "queued") if req is not None \
+            else "queued"
+
+    def cancel(self) -> str:
+        """Explicit mid-stream cancellation: flip liveness AND cancel
+        the attached request directly (covers both a queued request the
+        reaper pops in place and a slot-resident one evicted next
+        round); returns the session's current terminal status, or
+        ``"cancelling"`` while the engine unwinds it."""
+        with self._lock:
+            self._cancelled = True
+        req = self.channel.attached_request
+        if req is not None:
+            req.cancel()
+        if self.channel.closed:
+            return self.channel.status or "ok"
+        return "cancelling"
+
+    @property
+    def terminal(self) -> bool:
+        return self.channel.closed and self.finished.is_set()
+
+
+class StreamSessionManager:
+    """The streaming front over any blocking ``generate`` surface
+    (single-engine :class:`~lzy_tpu.service.inference.InferenceService`,
+    :class:`~lzy_tpu.gateway.service.GatewayService`, or the disagg
+    subclass — they all take ``stream=`` and ``liveness=``).
+
+    Session state is process-local by nature (the worker thread and the
+    channel live here); the resume token ``(request_id, position)`` is
+    what travels. Terminal sessions are kept for ``terminal_ttl_s`` so a
+    client whose final frame was lost can still re-poll it, then
+    garbage-collected lazily on the next open/poll."""
+
+    def __init__(self, service: Any, *, ack_window: int = 1024,
+                 stall_grace_s: float = 5.0,
+                 liveness_timeout_s: float = 15.0,
+                 max_sessions: int = 64,
+                 terminal_ttl_s: float = 60.0,
+                 max_frame_wait_s: float = 30.0):
+        self._service = service
+        self.ack_window = int(ack_window)
+        self.stall_grace_s = float(stall_grace_s)
+        self.liveness_timeout_s = float(liveness_timeout_s)
+        self.max_sessions = int(max_sessions)
+        self.terminal_ttl_s = float(terminal_ttl_s)
+        self.max_frame_wait_s = float(max_frame_wait_s)
+        self._sessions: Dict[str, StreamSession] = {}
+        self._lock = threading.Lock()
+
+    # -- auth scoping ---------------------------------------------------------
+
+    def _subject(self, token: Optional[str]):
+        auth = getattr(self._service, "_auth", None)
+        return auth(token) if auth is not None else None
+
+    def _check_owner(self, session: StreamSession,
+                     token: Optional[str]) -> None:
+        """A stream is the opener's: with IAM on, polls and cancels must
+        present a token for the same subject (or the operator's INTERNAL
+        role) — one tenant must not read or kill another's stream."""
+        subject = self._subject(token)
+        if subject is None:
+            return
+        from lzy_tpu.iam import INTERNAL, AuthError
+
+        if subject.id != session.subject_id and subject.role != INTERNAL:
+            raise AuthError(
+                f"subject {subject.id} does not own stream {session.id}")
+
+    # -- surface --------------------------------------------------------------
+
+    def open(self, prompt, *, max_new_tokens: int = 64,
+             timeout_s: Optional[float] = None,
+             deadline_s: Optional[float] = None,
+             greedy: Optional[bool] = None,
+             tenant: Optional[str] = None,
+             priority: Optional[int] = None,
+             session: Optional[str] = None,
+             token: Optional[str] = None) -> dict:
+        """Admit a streamed generation; returns ``{"request_id",
+        "position": 0, "model"}`` — the resume token's birth. Fast
+        admission failures (full queue, quota, bad prompt) surface HERE
+        with their usual wire status; anything slower rides the first
+        frame. Sessions beyond ``max_sessions`` shed with a retry hint
+        — each session pins a worker thread and a waiter slot, and an
+        unbounded session table is exactly the unbounded buffer this
+        layer exists to prevent."""
+        subject = self._subject(token)
+        self._gc()
+        sid = gen_id("stream")
+        sess = StreamSession(self, sid,
+                             subject.id if subject is not None else None,
+                             tenant)
+        with self._lock:
+            live = sum(1 for s in self._sessions.values()
+                       if not s.terminal)
+            if live >= self.max_sessions:
+                raise shed_error(
+                    _unavailable(),
+                    f"{live} streaming sessions already open "
+                    f"(max_sessions {self.max_sessions}); retry later",
+                    reason="stream_sessions", retry_after_s=0.5)
+            self._sessions[sid] = sess
+        SESSIONS.set(float(live + 1))
+
+        def run():
+            try:
+                sess.reply = self._service.generate(
+                    prompt, max_new_tokens=int(max_new_tokens),
+                    timeout_s=timeout_s, deadline_s=deadline_s,
+                    greedy=greedy, tenant=tenant, priority=priority,
+                    session=session, token=token,
+                    stream=sess.channel, liveness=sess.alive)
+            except BaseException as e:  # noqa: BLE001 — frame owns it
+                sess.error = e
+                # the service fails a TOUCHED stream itself; a virgin
+                # one (admission refusal, auth failure) is left open for
+                # the caller's retry policy — here the poller IS the
+                # caller, so terminate the channel for it
+                if not sess.channel.closed:
+                    sess.channel.fail(f"{type(e).__name__}: {e}")
+            finally:
+                sess.finished.set()
+                with self._lock:
+                    live_now = sum(1 for s in self._sessions.values()
+                                   if not s.terminal)
+                SESSIONS.set(float(live_now))
+
+        thread = threading.Thread(target=run, name=f"stream-{sid}",
+                                  daemon=True)
+        thread.start()
+        # fast-path errors (queue full, quota, over-long prompt, bad
+        # auth) surface on the open RPC with their own wire status
+        # instead of an opened-then-dead session — but only while the
+        # stream is virgin, so no delivered token is ever swallowed.
+        # The window is deliberately short (it is a constant tax on
+        # every open's TTFT); a slower failure rides the first frame.
+        if sess.finished.wait(0.02) and sess.error is not None \
+                and sess.channel.position == 0:
+            with self._lock:
+                self._sessions.pop(sid, None)
+            raise sess.error
+        return {"request_id": sid, "position": 0,
+                "model": getattr(self._service, "model_name", "custom")}
+
+    def poll(self, request_id: str, position: int = 0, *,
+             wait_s: float = 5.0, token: Optional[str] = None) -> dict:
+        """One long-poll frame: block until the stream moves past
+        ``position`` (or ``wait_s`` passes — a keepalive frame), and
+        return every token from ``position`` on. Idempotent by
+        construction: the same ``(request_id, position)`` always reads
+        the same byte-identical continuation, so a client that lost a
+        reply (or its whole connection) resumes by re-polling its last
+        position. Polling past the stream's fence is a splice violation
+        (INVALID_ARGUMENT) — the client claims tokens the stream never
+        delivered."""
+        sess = self._get(request_id)
+        self._check_owner(sess, token)
+        # chaos: the frame path (drop/delay/connection death) — raising
+        # here is exactly a dropped reply; the client re-polls the same
+        # position and reads the identical frame
+        try:
+            CHAOS.hit("rpc.stream")
+        except ConnectionError as e:
+            raise _unavailable()(str(e)) from None
+        # chaos: the consumer side — error is the client dying mid-poll:
+        # the session flips dead and the engines reap within one round
+        try:
+            CHAOS.hit("stream.consumer")
+        except ConsumerGone:
+            sess.mark_dead("injected consumer death (chaos)")
+            raise
+        pos = int(position)
+        ch = sess.channel
+        if pos > ch.position:
+            raise ValueError(
+                f"stream {request_id} poll at position {pos} is past the "
+                f"fence ({ch.position}); the resume token is corrupt")
+        with sess._lock:
+            if pos < sess._served:
+                # re-reading a range already served in a frame: the
+                # client lost that reply (or its whole connection) and
+                # resumed at its fence — the canonical wire resume
+                RESUMES.inc()
+            sess.last_poll = time.monotonic()
+            sess._polling += 1
+        try:
+            ch.ack(pos)      # everything below the poll cursor is acked
+            out = ch.wait_past(pos, min(max(0.0, float(wait_s)),
+                                        self.max_frame_wait_s))
+        finally:
+            with sess._lock:
+                sess._polling -= 1
+                # the liveness window restarts when the poll RETURNS —
+                # a client that waited out a long frame is not behind
+                sess.last_poll = time.monotonic()
+                sess._served = max(sess._served,
+                                   pos + len(out["tokens"]))
+        frame = {
+            "request_id": request_id,
+            "position": pos,
+            "tokens": out["tokens"],
+            "done": bool(out["closed"]),
+            "keepalive": not out["tokens"] and not out["closed"],
+            "resumptions": ch.resumptions,
+            "phase": sess.phase,
+        }
+        if out["closed"]:
+            # the worker sets reply/error right after the channel
+            # closes; wait for it so the done frame carries the final
+            # status + route metadata in one piece
+            sess.finished.wait(10.0)
+            status = out["status"] or "ok"
+            error = out["error"]
+            if error is None and sess.dead_reason is not None:
+                error = sess.dead_reason
+            reply = sess.reply or {}
+            frame.update({
+                "status": status,
+                "error": error,
+                "reply": {k: v for k, v in reply.items()
+                          if k != "tokens"},
+            })
+        FRAMES.inc(kind=("done" if frame["done"]
+                         else "keepalive" if frame["keepalive"]
+                         else "tokens"))
+        return frame
+
+    def cancel(self, request_id: str, *,
+               token: Optional[str] = None) -> dict:
+        """Explicit mid-stream cancellation; idempotent. The request is
+        reaped wherever it sits — queued (popped in place), prefilling
+        (staged resources released), decoding (slot + KV blocks freed
+        within one round), or mid-failover (the gateway short-circuits
+        instead of resubmitting) — and the stream terminates with
+        ``status: "cancelled"`` and the tokens emitted so far."""
+        sess = self._get(request_id)
+        self._check_owner(sess, token)
+        return {"request_id": request_id, "status": sess.cancel()}
+
+    # -- bookkeeping ----------------------------------------------------------
+
+    def _get(self, request_id: str) -> StreamSession:
+        with self._lock:
+            sess = self._sessions.get(request_id)
+        if sess is None:
+            raise KeyError(
+                f"unknown stream {request_id!r} (expired or never opened)")
+        return sess
+
+    def _gc(self) -> None:
+        """Drop terminal sessions past their ttl (lazy, on open): the
+        resume window for a lost final frame, not a leak."""
+        now = time.monotonic()
+        with self._lock:
+            stale = [sid for sid, s in self._sessions.items()
+                     if s.terminal
+                     and now - s.last_poll > self.terminal_ttl_s]
+            for sid in stale:
+                del self._sessions[sid]
+
+    def sessions(self) -> List[str]:
+        with self._lock:
+            return sorted(self._sessions)
+
+    def close(self) -> None:
+        """Cancel every live session (service shutdown)."""
+        with self._lock:
+            sessions = list(self._sessions.values())
+        for sess in sessions:
+            try:
+                sess.cancel()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass

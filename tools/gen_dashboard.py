@@ -43,6 +43,10 @@ def registry_metrics():
     # multi-tenant SLO: per-tenant requests/tokens/TTFT, queue depth,
     # KV blocks, rate-bucket levels, sheds (lzy_tenant_*)
     import lzy_tpu.serving.tenancy  # noqa: F401
+    # streaming delivery: frames by kind, wire resumes, cancels by
+    # phase, consumer-stall seconds, slow-consumer sheds, live sessions
+    # (lzy_stream_*)
+    import lzy_tpu.serving.streams  # noqa: F401
     # gateway: routing hit rate, failovers, autoscale, per-replica load
     import lzy_tpu.gateway.fleet  # noqa: F401
     import lzy_tpu.gateway.router  # noqa: F401
