@@ -20,6 +20,11 @@ The invariants:
 - **engine/slot consistency** (:func:`audit_engine`): an active slot's
   page table mirrors its block list, its position fits its allocated
   pages, and every held block is actually referenced.
+- **tiered-KV residency** (:func:`audit_kv_tier`): with a host tier
+  behind the pool, a block's payload lives in exactly ONE rung — a
+  host-tier chain must not also be radix-resident (double residency),
+  chains are whole-block and root-anchored, and the tier's byte
+  accounting matches its entries and budget.
 - **fleet lease accounting** (:func:`audit_fleet_leases`): no VM is
   leased to two replicas; with an allocator wired, every live replica's
   VMs exist and are RUNNING.
@@ -129,6 +134,7 @@ def audit_engine(engine) -> None:
         return
     audit_pool(kv)
     audit_radix(kv)
+    audit_kv_tier(kv, getattr(engine, "kv_tier", None))
     page = engine._page
     held: Dict[int, int] = {}
     for slot, req in enumerate(active):
@@ -184,6 +190,45 @@ def audit_engine(engine) -> None:
                 raise InvariantViolation(
                     f"prefill job for {job.req.id} holds free-list "
                     f"block {b}")
+
+
+def audit_kv_tier(kv, tier) -> None:
+    """Demoted-tier residency over a ``RadixCache`` + ``HostKVTier``
+    pair: the block-pool conservation audit says every pool block is
+    exactly one of {scratch, free, referenced, cached}; this extends
+    the partition with the demoted rung — a payload the host tier
+    holds must NOT also be a radix-resident chain (exactly one tier
+    owns it), every tier chain is whole-block, and the tier's byte sum
+    matches its own accounting and budget."""
+    if tier is None:
+        return
+    with tier._lock:
+        entries = list(tier._entries.values())
+        booked_bytes = tier._bytes
+    total = 0
+    for entry in entries:
+        chain = list(entry.chain)
+        if not chain or len(chain) % kv.page_size:
+            raise InvariantViolation(
+                f"tier entry chain of {len(chain)} tokens is not "
+                f"whole-block (page_size {kv.page_size})")
+        if not entry.leaves:
+            raise InvariantViolation(
+                f"tier entry for a {len(chain)}-token chain has no "
+                f"payload leaves")
+        if kv.match_len(chain) >= len(chain):
+            raise InvariantViolation(
+                f"chain of {len(chain)} tokens is resident in BOTH the "
+                f"radix tree and the host tier (double residency)")
+        total += entry.nbytes
+    if total != booked_bytes:
+        raise InvariantViolation(
+            f"host tier byte accounting drifted: entries sum to {total} "
+            f"but the tier books {booked_bytes}")
+    if booked_bytes > tier.budget_bytes:
+        raise InvariantViolation(
+            f"host tier over budget: {booked_bytes} > "
+            f"{tier.budget_bytes} bytes")
 
 
 # -- fleet ------------------------------------------------------------------

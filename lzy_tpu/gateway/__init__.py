@@ -34,6 +34,7 @@ from lzy_tpu.gateway.disagg import DisaggGatewayService
 from lzy_tpu.gateway.fleet import (
     DEAD, DRAINING, READY, STARTING, Replica, ReplicaFleet)
 from lzy_tpu.gateway.health import HealthPolicy, HealthTracker
+from lzy_tpu.gateway.kv_index import GlobalKVIndex
 from lzy_tpu.gateway.router import (
     PrefixAffinityRouter, RoundRobinRouter, chunk_hashes)
 from lzy_tpu.gateway.service import GatewayService
@@ -44,6 +45,7 @@ __all__ = [
     "DRAINING",
     "DisaggGatewayService",
     "GatewayService",
+    "GlobalKVIndex",
     "HealthPolicy",
     "HealthTracker",
     "PrefixAffinityRouter",

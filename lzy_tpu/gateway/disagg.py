@@ -128,12 +128,14 @@ class DisaggGatewayService(GatewayService):
         HIT — i.e. which prefill replica really produced the KV it
         decoded from. Staged-but-refused imports (pool pressure, lost
         payload) leave this None and the request re-prefilled locally."""
+        super()._note_result(req)
         self._meta()["kv_used_from"] = getattr(req, "kv_prefilled_by",
                                                None)
 
     def _reply_extras(self) -> dict:
         meta = self._meta()
-        return {
+        out = super()._reply_extras()
+        out.update({
             # the prefill replica whose KV the final serving attempt
             # actually USED (its imported blocks matched at prefill) —
             # None when the request re-prefilled locally, the prompt was
@@ -150,7 +152,8 @@ class DisaggGatewayService(GatewayService):
             "kv_transfer_ms": meta.get("kv_transfer_ms"),
             "kv_transfer_skipped": bool(meta.get("skipped", False)),
             "reprefills": int(meta.get("reprefills", 0)),
-        }
+        })
+        return out
 
     def _pre_submit(self, replica, prompt: List[int],
                     deadline_s: Optional[float] = None,

@@ -110,7 +110,10 @@ class ReplicaFleet:
             "prefix_hit_tokens": 0, "prefix_lookup_tokens": 0,
             "spec_proposed_tokens": 0, "spec_accepted_tokens": 0,
             "spec_draft_truncated": 0,
-            "decode_steps": 0, "decode_rows": 0, "decode_tokens": 0}
+            "decode_steps": 0, "decode_rows": 0, "decode_tokens": 0,
+            "kv_imports": 0, "kv_import_blocks": 0,
+            "kv_tier_demotions": 0, "kv_tier_promotions": 0,
+            "kv_tier_dropped": 0}
         # per-tenant twin of the banked totals (terminal counters only —
         # live gauges like queue depth die with the replica)
         self._retired_tenants: Dict[str, Dict[str, int]] = {}
@@ -241,7 +244,14 @@ class ReplicaFleet:
                                    "spec_draft_truncated"),
                                   ("decode_steps", "decode_steps"),
                                   ("decode_rows", "decode_rows"),
-                                  ("decode_tokens", "decode_tokens")):
+                                  ("decode_tokens", "decode_tokens"),
+                                  ("kv_imports", "kv_imports"),
+                                  ("kv_import_blocks", "kv_import_blocks"),
+                                  ("kv_tier_demotions",
+                                   "kv_tier_demotions"),
+                                  ("kv_tier_promotions",
+                                   "kv_tier_promotions"),
+                                  ("kv_tier_dropped", "kv_tier_dropped")):
                     self._retired_totals[key] += int(
                         getattr(replica.engine, attr, 0))
                 kv = getattr(replica.engine, "kv", None)
@@ -328,7 +338,7 @@ class ReplicaFleet:
         autoscaler and stats surface read)."""
         with self._lock:
             agg = {"replicas": 0, "queue_depth": 0, "busy": 0, "slots": 0,
-                   **self._retired_totals}
+                   "kv_host_tier_blocks": 0, **self._retired_totals}
         for replica in self.replicas() + self.replicas(state=DRAINING):
             s = replica.engine.stats()
             agg["replicas"] += 1
@@ -343,12 +353,20 @@ class ReplicaFleet:
                                "spec_draft_truncated"),
                               ("decode_steps", "decode_steps"),
                               ("decode_rows", "decode_rows"),
-                              ("decode_tokens", "decode_tokens")):
+                              ("decode_tokens", "decode_tokens"),
+                              ("kv_imports", "kv_imports"),
+                              ("kv_import_blocks", "kv_import_blocks"),
+                              ("kv_tier_demotions", "kv_tier_demotions"),
+                              ("kv_tier_promotions", "kv_tier_promotions"),
+                              ("kv_tier_dropped", "kv_tier_dropped")):
                 agg[key] += int(getattr(replica.engine, attr, 0))
             kv = getattr(replica.engine, "kv", None)
             if kv is not None:
                 agg["prefix_hit_tokens"] += kv.hit_tokens
                 agg["prefix_lookup_tokens"] += kv.lookup_tokens
+            # live occupancy (dies with the replica, not banked)
+            if s.kv_host_tier_blocks is not None:
+                agg["kv_host_tier_blocks"] += s.kv_host_tier_blocks
         return agg
 
     def aggregate_tenants(self) -> Dict[str, Dict[str, int]]:

@@ -80,10 +80,33 @@ class GatewayService:
         max_failovers: int = 3,
         tick_period_s: float = 1.0,
         slo=None,
+        kv_index=None,
+        kv_transport=None,
     ):
         self.fleet = fleet
         self.router = router if router is not None else PrefixAffinityRouter(
             page_size)
+        #: fleet-global tiered-KV prefix index (gateway/kv_index.py):
+        #: replicas advertise which chunk-hash prefixes they hold and at
+        #: which tier; a routed replica that would miss a prefix a
+        #: sibling holds gets the sibling's blocks imported over the
+        #: transport instead of re-prefilling. None = off (the default —
+        #: serve.py enables it with the tier flags).
+        self.kv_index = kv_index
+        if kv_index is not None and kv_transport is None:
+            from lzy_tpu.channels.kv_transfer import InMemoryKVTransport
+
+            kv_transport = InMemoryKVTransport()
+        self.kv_transport = kv_transport
+        self._kvtier_tls = threading.local()
+        self._kvtier_lock = threading.Lock()
+        self._kvtier_imports = 0
+        self._kvtier_import_bytes = 0
+        self._kvtier_fallbacks = 0
+        self._kvtier_seq = 0
+        # last advertisement object per replica (tick-loop only): the
+        # engine memoizes by cache version, so identity means unchanged
+        self._kvtier_last_adv: dict = {}
         self.autoscaler = autoscaler
         self.model_name = model_name
         self.iam = iam                 # harness wires the cluster's IAM in
@@ -216,6 +239,8 @@ class GatewayService:
         (and checked between failover attempts): a disconnected or
         cancelled client terminates the request within one decode round
         wherever it sits."""
+        if self.kv_index is not None:
+            self._kvtier_tls.meta = {}   # fresh per call (failovers restage)
         subject = self._auth(token)
         from lzy_tpu.rpc.core import Unavailable
 
@@ -591,21 +616,174 @@ class GatewayService:
         that cannot admit (the disagg gateway probes the queue and then
         stages KV here — bounded by the request's REMAINING deadline,
         queued under the request's tenant, and skipped entirely for a
-        client ``liveness`` already reports gone)."""
+        client ``liveness`` already reports gone). The base gateway's
+        staging work is the fleet-global tiered-KV import: a routed
+        replica about to miss a prefix a sibling advertises gets the
+        sibling's blocks queued for import first — AFTER the admission
+        probe (staging for a replica that cannot admit would waste a
+        whole export + transfer and park imported blocks where no
+        routed request will match them), bounded by the request's
+        remaining deadline, and skipped for a client already gone."""
+        if self.kv_index is None:
+            return True
+        # reset the PER-ATTEMPT staging meta up front: an attempt that
+        # skips staging (client gone, expired deadline, admission-probe
+        # drop) must not inherit — and report — the previous attempt's
+        # kv_import_staged_from/tier/ms
+        meta = self._kvtier_meta()
+        meta.pop("kv_import_staged_from", None)
+        meta.pop("kv_import_tier", None)
+        meta.pop("kv_import_ms", None)
+        engine = replica.engine
+        if getattr(engine, "closed", False) or \
+                engine.queue.depth() >= engine.queue.max_depth:
+            return False
+        if not (liveness is not None and self._client_gone(liveness)):
+            self._stage_kv_import(replica, prompt, deadline_s=deadline_s)
         return True
+
+    def _stage_kv_import(self, replica, prompt: List[int],
+                         deadline_s: Optional[float] = None) -> None:
+        """Best-effort cross-replica prefix import (the tiered-KV
+        tentpole): consult the global index for a sibling holding a
+        deeper whole-block prefix than the routed replica can cover
+        (radix tree + its own tiers), export from the sibling on ITS
+        scheduling thread, move the payload through the transport, and
+        queue it on the routed replica — whose next scheduling round
+        folds it in strictly before the request's admission. Never
+        raises: every failure (source retired mid-export, transport
+        death, the ``kvtier.import`` chaos fault) is one counted
+        fallback and the replica re-prefills locally. ``deadline_s``
+        is the request's REMAINING client deadline: the export wait is
+        capped by it (a request with 200 ms left must not park behind a
+        5 s sibling gather), and a nearly-expired request skips staging
+        entirely — re-prefill is then the cheaper bet."""
+        engine = replica.engine
+        kv = getattr(engine, "kv", None)
+        queue_import = getattr(engine, "queue_kv_import", None)
+        if kv is None or queue_import is None:
+            return
+        export_timeout = 5.0
+        if deadline_s is not None:
+            if deadline_s < 0.05:
+                return
+            export_timeout = min(export_timeout, deadline_s)
+        meta = self._kvtier_meta()       # attempt meta reset by caller
+        page = kv.page_size
+        n_full = (len(prompt) - 1) // page
+        if n_full == 0:
+            return
+        prefix = [int(t) for t in prompt[:n_full * page]]
+        # local coverage counts every rung the replica can promote from
+        # on its own — importing what the host tier already holds would
+        # waste a transfer
+        tier_probe = getattr(engine, "kv_tier_match_len", None)
+        local = (tier_probe(prefix) if tier_probe is not None
+                 else kv.match_len(prefix))
+        if local >= len(prefix):
+            return
+        holder = self.kv_index.best_holder(
+            prefix, exclude=(replica.id,), min_depth_tokens=local)
+        if holder is None:
+            return
+        t0 = time.monotonic()
+        try:
+            CHAOS.hit("kvtier.import")
+            src = self.fleet.get(holder.replica_id)
+            if src is None or getattr(src.engine, "request_kv_export",
+                                      None) is None:
+                raise LookupError(
+                    f"holder {holder.replica_id} retired mid-route")
+            export = src.engine.request_kv_export(
+                prefix[:holder.depth_tokens], timeout_s=export_timeout)
+            if export is None:
+                raise LookupError(
+                    f"holder {holder.replica_id} declined the export")
+            if export.prefilled_by is None:
+                # origin provenance rides the radix insert on the
+                # importer: replies can say whose KV really warmed them
+                export.prefilled_by = holder.replica_id
+            with self._kvtier_lock:
+                self._kvtier_seq += 1
+                key = f"kvtier-{self._kvtier_seq}"
+            ref = self.kv_transport.publish(key, export)
+            try:
+                fetched = self.kv_transport.fetch(ref)
+            finally:
+                try:
+                    self.kv_transport.discard(ref)
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
+            queue_import(fetched)
+        except Exception as e:  # noqa: BLE001 — import is advisory
+            from lzy_tpu.gateway.kv_index import IMPORT_FALLBACKS
+
+            with self._kvtier_lock:
+                self._kvtier_fallbacks += 1
+            IMPORT_FALLBACKS.inc()
+            _LOG.info("kvtier: cross-replica import from %s failed "
+                      "(%s: %s); %s will re-prefill locally",
+                      holder.replica_id, type(e).__name__, e, replica.id)
+            return
+        from lzy_tpu.gateway.kv_index import (
+            IMPORT_BYTES, IMPORT_SECONDS, IMPORTS)
+
+        dt = time.monotonic() - t0
+        with self._kvtier_lock:
+            self._kvtier_imports += 1
+            self._kvtier_import_bytes += fetched.nbytes
+        IMPORTS.inc(from_tier=holder.tier)
+        IMPORT_BYTES.inc(fetched.nbytes)
+        IMPORT_SECONDS.observe(dt)
+        meta["kv_import_staged_from"] = holder.replica_id
+        meta["kv_import_tier"] = holder.tier
+        meta["kv_import_ms"] = round(1000 * dt, 3)
+
+    def _kvtier_meta(self) -> dict:
+        meta = getattr(self._kvtier_tls, "meta", None)
+        if meta is None:
+            meta = self._kvtier_tls.meta = {}
+        return meta
 
     def _note_result(self, req) -> None:
         """Hook: the terminal request of a (possibly failed-over)
         generate, observed before the reply is built — subclasses read
         request-side provenance off it (the disagg gateway records which
-        prefill pool's KV the final attempt actually used)."""
+        prefill pool's KV the final attempt actually used). With the
+        global KV index on, the base gateway does the same for
+        cross-replica imports: ``kv_prefilled_by`` is set at
+        prefix-match time from the radix chain's origin, so it names
+        the sibling whose KV the attempt REALLY decoded from — an
+        import that was staged but skipped (pool too hot, mismatched
+        payload) leaves it None, matching the re-prefill that actually
+        happened."""
+        if self.kv_index is not None:
+            self._kvtier_meta()["kv_used_from"] = getattr(
+                req, "kv_prefilled_by", None)
 
     def _reply_extras(self) -> dict:
         """Extra route metadata merged into every reply — subclasses
         extend (the disagg gateway adds ``prefilled_by`` /
         ``kv_transfer_ms``); unknown reply fields are preserved by older
-        clients (proto3 rule)."""
-        return {}
+        clients (proto3 rule). With the global KV index on, replies
+        carry the cross-replica import provenance: ``kv_import_from``
+        is the sibling whose KV the serving attempt actually USED (its
+        imported blocks matched at prefill — None when the attempt hit
+        purely-local KV or re-prefilled), ``kv_import_staged_from`` the
+        holder whose export was STAGED for the attempt (staged ≠ used:
+        the engine folds imports in opportunistically and a refusal
+        under pool pressure silently re-prefills), ``kv_import_tier``
+        the rung the source exported from, and ``kv_import_ms`` the
+        staging latency."""
+        if self.kv_index is None:
+            return {}
+        meta = self._kvtier_meta()
+        return {
+            "kv_import_from": meta.get("kv_used_from"),
+            "kv_import_staged_from": meta.get("kv_import_staged_from"),
+            "kv_import_tier": meta.get("kv_import_tier"),
+            "kv_import_ms": meta.get("kv_import_ms"),
+        }
 
     def _note_failover(self) -> None:
         with self._lock:
@@ -621,8 +799,31 @@ class GatewayService:
         now = now if now is not None else time.time()
         for rid in self.fleet.check_health(now=now):
             self.router.forget(rid)
+            if self.kv_index is not None:
+                self.kv_index.forget(rid)
+                self._kvtier_last_adv.pop(rid, None)
         for rid in self.fleet.reap_drained():
             self.router.forget(rid)
+            if self.kv_index is not None:
+                self.kv_index.forget(rid)
+                self._kvtier_last_adv.pop(rid, None)
+        if self.kv_index is not None:
+            # refresh the fleet-global prefix index from each replica's
+            # advertisement (chains by tier); pull-based and advisory —
+            # a stale entry costs one pointless import attempt at worst.
+            # Engines memoize the advertisement by cache-structure
+            # version (unchanged cache → SAME object), so a quiet fleet
+            # skips the re-hash entirely tick after tick.
+            from lzy_tpu.gateway.kv_index import chains_of
+
+            for replica in self.fleet.replicas():
+                chains = chains_of(replica.engine)
+                if not chains:
+                    continue
+                if self._kvtier_last_adv.get(replica.id) is chains:
+                    continue
+                self.kv_index.update_replica(replica.id, chains)
+                self._kvtier_last_adv[replica.id] = chains
         if self.autoscaler is None:
             return None
         ready = len(self.fleet.replicas())
@@ -778,7 +979,7 @@ class GatewayService:
             fo, fin = self._failovers, self._finished
             ups, downs = self._scale_ups, self._scale_downs
             shed = self._shed
-        return {
+        doc = {
             "model": self.model_name,
             "gateway": True,
             "replicas": agg["replicas"],
@@ -806,6 +1007,21 @@ class GatewayService:
             # per-tenant breakdown (operator view only — this branch)
             "tenants": self.fleet.aggregate_tenants(),
         }
+        if self.kv_index is not None:
+            with self._kvtier_lock:
+                doc.update({
+                    "kvtier": True,
+                    "kvtier_imports": self._kvtier_imports,
+                    "kvtier_import_bytes": self._kvtier_import_bytes,
+                    "kvtier_reprefill_fallbacks": self._kvtier_fallbacks,
+                })
+            doc.update({
+                "kvtier_demotions": agg.get("kv_tier_demotions", 0),
+                "kvtier_promotions": agg.get("kv_tier_promotions", 0),
+                "kvtier_host_blocks": agg.get("kv_host_tier_blocks", 0),
+                "kvtier_index": self.kv_index.stats(),
+            })
+        return doc
 
     def fleet_stats(self, *, token: Optional[str] = None) -> dict:
         """Per-replica breakdown (engine stats + lease + health);

@@ -282,7 +282,20 @@ REQUESTS: Dict[str, Schema] = {
     # "kv_transfer_skipped" (decode replica already held the prefix) and
     # "reprefills" (prefill-pool/transfer failures absorbed by local
     # re-prefill) — unknown reply fields are preserved by older clients
-    # (proto3 rule). "session" is a stable conversation id: a
+    # (proto3 rule). With the tiered KV cache's fleet-global prefix
+    # index on (--kv-host-tier-mb/--kv-storage-tier on a --gateway
+    # plane), replies additionally carry "kv_import_from" (the sibling
+    # replica whose KV the serving attempt actually USED — its imported
+    # blocks matched at prefill; null when the attempt hit purely-local
+    # KV or fell back to re-prefill), "kv_import_staged_from" (the
+    # holder whose export was STAGED for the attempt — staged ≠ used),
+    # "kv_import_tier" ("hbm" | "host" | "storage": the rung the source
+    # exported from) and "kv_import_ms" (export + transport + import-
+    # queue latency); InferStats gains the kvtier_* summary (imports,
+    # import bytes, re-prefill fallbacks, demotions/promotions, host-
+    # tier occupancy) and per-replica rows the kv_host_tier_* /
+    # kv_tier_* occupancy and ladder counters. "session" is a stable
+    # conversation id: a
     # gateway-fronted plane pins it to the replica whose radix cache
     # holds the conversation's earlier steps ("routed_by": "session");
     # single-engine planes accept and ignore it.

@@ -246,17 +246,38 @@ def _build_engine_parts(model: str, *, checkpoint: Optional[str],
 
 
 def _check_paged_only(paged: bool, *, kv_quant, native_attention,
-                      kernel, kv_pool_bytes=None) -> None:
+                      kernel, kv_pool_bytes=None,
+                      kv_host_tier_bytes=None,
+                      kv_storage_tier=None) -> None:
     """The dense engine has no page table to read through: silently
     building it while the caller asked for quantization or the native
     kernel would serve dense fp attention with no error and no stats
     signal (kv_quant/kernel_path are None-filtered out of the wire doc).
     serve.py validates its flags; the library surface must too."""
     if not paged and (kv_quant is not None or native_attention
-                      or kernel != "auto" or kv_pool_bytes is not None):
+                      or kernel != "auto" or kv_pool_bytes is not None
+                      or kv_host_tier_bytes is not None
+                      or kv_storage_tier is not None):
         raise ValueError(
-            "kv_quant / native_attention / kernel / kv_pool_bytes "
-            "require paged=True")
+            "kv_quant / native_attention / kernel / kv_pool_bytes / "
+            "kv_host_tier_bytes / kv_storage_tier require paged=True")
+
+
+def _build_kv_storage_tier(kv_storage_tier, page_size: int):
+    """Resolve the ``--kv-storage-tier`` value: a URI becomes ONE shared
+    ``StorageKVTier`` (every replica in the process spills to — and
+    promotes from — the same root, which is what makes the storage rung
+    fleet-global); an already-built tier object passes through."""
+    if kv_storage_tier is None:
+        return None
+    if not isinstance(kv_storage_tier, str):
+        return kv_storage_tier
+    from lzy_tpu.serving.kv_tier import StorageKVTier
+    from lzy_tpu.storage.api import StorageConfig
+    from lzy_tpu.storage.registry import client_for
+
+    client = client_for(StorageConfig(uri=kv_storage_tier))
+    return StorageKVTier(client, kv_storage_tier, page_size)
 
 
 def build_gateway_service(
@@ -276,6 +297,9 @@ def build_gateway_service(
     kv_quant: Optional[str] = None,
     native_attention: bool = False,
     kernel: str = "auto",
+    kv_host_tier_bytes: Optional[int] = None,
+    kv_storage_tier=None,
+    kv_global_index: Optional[bool] = None,
     routing: str = "prefix",
     allocator=None,
     pool_label: str = "cpu-small",
@@ -293,6 +317,12 @@ def build_gateway_service(
     prefix-affinity routing, health/failover, and (optionally)
     allocator-driven autoscaling between ``min_replicas`` and
     ``max_replicas`` (defaults: ``replicas`` .. ``2 * replicas``).
+
+    ``kv_host_tier_bytes``/``kv_storage_tier`` build the tiered KV cache
+    behind each paged replica (``--kv-host-tier-mb``/``--kv-storage-tier``;
+    docs/serving.md "Tiered KV cache"); ``kv_global_index`` turns on the
+    gateway's fleet-global prefix index + cross-replica import (default:
+    on exactly when a tier is configured).
 
     ``routing``: ``"prefix"`` (cache-aware, the default) or ``"rr"``
     (round-robin — the measurable baseline). ``allocator``: an
@@ -317,13 +347,16 @@ def build_gateway_service(
         raise ValueError(f"unknown routing {routing!r}; use prefix or rr")
     _check_paged_only(paged, kv_quant=kv_quant,
                       native_attention=native_attention, kernel=kernel,
-                      kv_pool_bytes=kv_pool_bytes)
+                      kv_pool_bytes=kv_pool_bytes,
+                      kv_host_tier_bytes=kv_host_tier_bytes,
+                      kv_storage_tier=kv_storage_tier)
     cfg, params = _build_engine_parts(model, checkpoint=checkpoint,
                                       seed=seed)
     common = dict(slots=slots, max_queue=max_queue, eos_token=eos_token,
                   prefill_chunk=prefill_chunk, seed=seed,
                   spec_tokens=spec_tokens, prefill_budget=prefill_budget,
                   tenants=tenants)
+    storage_tier = _build_kv_storage_tier(kv_storage_tier, page_size)
 
     def engine_factory():
         if paged:
@@ -331,6 +364,8 @@ def build_gateway_service(
                 cfg, params, page_size=page_size, kv_blocks=kv_blocks,
                 kv_pool_bytes=kv_pool_bytes, kv_quant=kv_quant,
                 native_attention=native_attention, kernel=kernel,
+                kv_host_tier_bytes=kv_host_tier_bytes,
+                kv_storage_tier=storage_tier,
                 **common)
         else:
             engine = InferenceEngine(cfg, params, **common)
@@ -352,12 +387,26 @@ def build_gateway_service(
         from lzy_tpu.serving.tenancy import SloLimiter
 
         slo = SloLimiter(tenants)
+    if kv_global_index is None:
+        # tiered mode implies the fleet-global index: a tier without it
+        # would warm only the replica that demoted
+        kv_global_index = (kv_host_tier_bytes is not None
+                           or kv_storage_tier is not None)
+    kv_index = None
+    if kv_global_index:
+        if not paged:
+            raise ValueError("kv_global_index requires paged=True "
+                             "(there are no KV blocks to import)")
+        from lzy_tpu.gateway.kv_index import GlobalKVIndex
+
+        kv_index = GlobalKVIndex(page_size)
     service = GatewayService(
         fleet,
         router=router_cls(page_size if paged else prefill_chunk),
         autoscaler=autoscaler,
         model_name=model,
         slo=slo,
+        kv_index=kv_index,
     )
     try:
         for _ in range(replicas):
@@ -394,6 +443,8 @@ def build_disagg_gateway_service(
     kv_quant: Optional[str] = None,
     native_attention: bool = False,
     kernel: str = "auto",
+    kv_host_tier_bytes: Optional[int] = None,
+    kv_storage_tier=None,
     routing: str = "prefix",
     allocator=None,
     pool_label: str = "cpu-small",
@@ -437,11 +488,17 @@ def build_disagg_gateway_service(
     # pool producing int8 blocks + sidecars of the same shape (a
     # mismatch degrades safely — import_kv fails closed and the prompt
     # re-prefills locally — but transfers nothing)
+    # the tier rides BOTH pools: prefill replicas accumulate (and evict)
+    # radix caches too, and the shared storage rung lets a decode
+    # replica promote what a prefill replica demoted
     common = dict(slots=slots, max_queue=max_queue,
                   prefill_chunk=prefill_chunk, seed=seed,
                   page_size=page_size, kv_blocks=kv_blocks,
                   kv_pool_bytes=kv_pool_bytes, kv_quant=kv_quant,
                   native_attention=native_attention, kernel=kernel,
+                  kv_host_tier_bytes=kv_host_tier_bytes,
+                  kv_storage_tier=_build_kv_storage_tier(
+                      kv_storage_tier, page_size),
                   prefill_budget=prefill_budget, tenants=tenants)
 
     def decode_factory():
@@ -520,6 +577,8 @@ def build_inference_service(
     kv_quant: Optional[str] = None,
     native_attention: bool = False,
     kernel: str = "auto",
+    kv_host_tier_bytes: Optional[int] = None,
+    kv_storage_tier=None,
     spec_tokens: int = 0,
     warm_start: bool = False,
     start: bool = True,
@@ -563,7 +622,9 @@ def build_inference_service(
 
     _check_paged_only(paged, kv_quant=kv_quant,
                       native_attention=native_attention, kernel=kernel,
-                      kv_pool_bytes=kv_pool_bytes)
+                      kv_pool_bytes=kv_pool_bytes,
+                      kv_host_tier_bytes=kv_host_tier_bytes,
+                      kv_storage_tier=kv_storage_tier)
     cfg, params = _build_engine_parts(model, checkpoint=checkpoint,
                                       seed=seed)
     common = dict(slots=slots, max_queue=max_queue, eos_token=eos_token,
@@ -574,7 +635,11 @@ def build_inference_service(
         engine: InferenceEngine = PagedInferenceEngine(
             cfg, params, page_size=page_size, kv_blocks=kv_blocks,
             kv_pool_bytes=kv_pool_bytes, kv_quant=kv_quant,
-            native_attention=native_attention, kernel=kernel, **common)
+            native_attention=native_attention, kernel=kernel,
+            kv_host_tier_bytes=kv_host_tier_bytes,
+            kv_storage_tier=_build_kv_storage_tier(kv_storage_tier,
+                                                   page_size),
+            **common)
     else:
         engine = InferenceEngine(cfg, params, **common)
     if warm_start:

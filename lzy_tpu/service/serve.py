@@ -120,6 +120,26 @@ def main(argv=None) -> int:
                              "boundedly diverges from fp — docs/"
                              "serving.md 'Native paged attention & KV "
                              "quantization')")
+    parser.add_argument("--kv-host-tier-mb", type=int, default=None,
+                        help="tiered KV cache under --serve-paged/--disagg: "
+                             "radix-cache eviction DEMOTES block payloads "
+                             "to this much pinned host RAM (LRU) instead "
+                             "of dropping them; admission promotes them "
+                             "back — warm prefixes survive HBM pressure "
+                             "(docs/serving.md 'Tiered KV cache'). On a "
+                             "--gateway plane this also enables the "
+                             "fleet-global prefix index: a replica that "
+                             "misses a prefix a sibling holds imports the "
+                             "sibling's blocks instead of re-prefilling")
+    parser.add_argument("--kv-storage-tier", default=None,
+                        help="storage rung of the tiered KV cache: a "
+                             "storage URI (file://, mem://, s3://, "
+                             "azure://) host-tier overflow spills to in "
+                             "the kv_block_manifest format. Replicas "
+                             "sharing the same root share the tier — "
+                             "cross-replica cache warm-up after "
+                             "autoscale/failover is a storage read, not "
+                             "a re-prefill")
     parser.add_argument("--serve-native-attention", action="store_true",
                         help="native paged-attention read path under "
                              "--serve-paged: attention reads K/V through "
@@ -253,10 +273,13 @@ def main(argv=None) -> int:
         parser.error("--disagg IS a gateway mode; pass one or the other")
     if (args.serve_kv_quant or args.serve_native_attention
             or args.serve_kernel != "auto"
-            or args.serve_kv_pool_mb is not None) \
+            or args.serve_kv_pool_mb is not None
+            or args.kv_host_tier_mb is not None
+            or args.kv_storage_tier is not None) \
             and not (args.serve_paged or args.disagg):
         parser.error("--serve-kv-quant/--serve-native-attention/"
-                     "--serve-kernel/--serve-kv-pool-mb need the paged "
+                     "--serve-kernel/--serve-kv-pool-mb/"
+                     "--kv-host-tier-mb/--kv-storage-tier need the paged "
                      "cache (--serve-paged or --disagg)")
     if args.serve_kernel != "auto" and not args.serve_native_attention:
         parser.error("--serve-kernel picks the --serve-native-attention "
@@ -269,6 +292,8 @@ def main(argv=None) -> int:
     spec_tokens = args.spec_tokens if args.serve_spec else 0
     kv_pool_bytes = (args.serve_kv_pool_mb * (1 << 20)
                      if args.serve_kv_pool_mb is not None else None)
+    kv_host_tier_bytes = (args.kv_host_tier_mb * (1 << 20)
+                          if args.kv_host_tier_mb is not None else None)
     prefill_budget = args.serve_prefill_budget or None
     tenants = None
     slo_on = args.serve_slo or any(
@@ -319,6 +344,8 @@ def main(argv=None) -> int:
                 kv_quant=args.serve_kv_quant,
                 native_attention=args.serve_native_attention,
                 kernel=args.serve_kernel,
+                kv_host_tier_bytes=kv_host_tier_bytes,
+                kv_storage_tier=args.kv_storage_tier,
                 routing=args.gateway_routing,
                 allocator=cluster.allocator,
                 pool_label=args.gateway_pool,
@@ -350,6 +377,8 @@ def main(argv=None) -> int:
                 kv_quant=args.serve_kv_quant,
                 native_attention=args.serve_native_attention,
                 kernel=args.serve_kernel,
+                kv_host_tier_bytes=kv_host_tier_bytes,
+                kv_storage_tier=args.kv_storage_tier,
                 routing=args.gateway_routing,
                 allocator=cluster.allocator,
                 pool_label=args.gateway_pool,
@@ -374,6 +403,8 @@ def main(argv=None) -> int:
             kv_quant=args.serve_kv_quant,
             native_attention=args.serve_native_attention,
             kernel=args.serve_kernel,
+            kv_host_tier_bytes=kv_host_tier_bytes,
+            kv_storage_tier=args.kv_storage_tier,
             spec_tokens=spec_tokens,
             warm_start=warm_start,
             prefill_budget=prefill_budget,

@@ -40,6 +40,7 @@ from lzy_tpu.serving.engine import (
     EngineStats, InferenceEngine, PagedInferenceEngine)
 from lzy_tpu.serving.kv_cache import (
     BlockPool, KVCacheStats, NoFreeBlocks, RadixCache)
+from lzy_tpu.serving.kv_tier import HostKVTier, StorageKVTier
 from lzy_tpu.serving.scheduler import (
     AdmissionError, PromptTooLong, QuotaExceeded, Request, RequestQueue)
 from lzy_tpu.serving.spec import NgramProposer
@@ -54,6 +55,7 @@ __all__ = [
     "BlockPool",
     "DecodeEngine",
     "EngineStats",
+    "HostKVTier",
     "InferenceEngine",
     "KVCacheStats",
     "NgramProposer",
@@ -66,6 +68,7 @@ __all__ = [
     "Request",
     "RequestQueue",
     "SloLimiter",
+    "StorageKVTier",
     "StreamSession",
     "StreamSessionManager",
     "TenantPolicy",

@@ -1,15 +1,23 @@
 """Decode-pool engine: imports transferred KV prefixes, then just decodes.
 
-A decode replica is a plain ``PagedInferenceEngine`` plus an **import
-queue**: the disagg gateway enqueues a transferred
-:class:`~lzy_tpu.channels.kv_transfer.KVBlockExport` *before* submitting
-the request, and the engine folds queued imports into its pool/radix
-tree at the top of every scheduling round — i.e. strictly before any
-admission that could match them. The request itself is then an ordinary
-submit of the FULL prompt: its prefix match hits the imported blocks,
-prefill runs only the sub-block tail, and the first token is sampled
-from this engine's own rng — the exact draw order of a monolithic
-engine, which is what keeps disaggregated output bit-identical.
+A decode replica is a plain ``PagedInferenceEngine`` — the generic
+KV import queue (``queue_kv_import`` / the between-steps drain) now
+lives on the base paged engine, shared with the fleet-global tiered
+cache's cross-replica import path (``serving/kv_tier.py`` +
+``gateway/kv_index.py``). What this subclass keeps is the disagg
+accounting: the ``lzy_disagg_kv_imports_total`` family counts imports
+staged by the prefill→decode pipeline specifically.
+
+The ordering contract is unchanged: the disagg gateway enqueues a
+transferred :class:`~lzy_tpu.channels.kv_transfer.KVBlockExport`
+*before* submitting the request, and the engine folds queued imports
+into its pool/radix tree at the top of every scheduling round — i.e.
+strictly before any admission that could match them. The request itself
+is then an ordinary submit of the FULL prompt: its prefix match hits
+the imported blocks, prefill runs only the sub-block tail, and the
+first token is sampled from this engine's own rng — the exact draw
+order of a monolithic engine, which is what keeps disaggregated output
+bit-identical.
 
 If an import was skipped (pool too hot, payload lost mid-transfer) the
 match simply comes up short and the prompt re-prefills locally: the
@@ -18,11 +26,6 @@ request never observes the transfer at all.
 
 from __future__ import annotations
 
-import threading
-from typing import List
-
-from lzy_tpu.channels.kv_transfer import KVBlockExport
-from lzy_tpu.serving.disagg.kv_export import import_kv
 from lzy_tpu.serving.engine import PagedInferenceEngine
 from lzy_tpu.utils.metrics import REGISTRY
 
@@ -35,60 +38,10 @@ _IMPORT_BLOCKS = REGISTRY.counter(
 
 
 class DecodeEngine(PagedInferenceEngine):
-    """``PagedInferenceEngine`` with a thread-safe KV import queue."""
+    """``PagedInferenceEngine`` whose KV imports count as disagg
+    transfers (the queue machinery itself is inherited)."""
 
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
-        self._pending_imports: List[KVBlockExport] = []
-        self._import_lock = threading.Lock()
-        self._imports = 0
-        self._import_blocks = 0
-
-    def queue_kv_import(self, export: KVBlockExport) -> None:
-        """Enqueue a transferred prefix; applied between engine steps.
-        Queue BEFORE submitting the request that wants it: every
-        scheduling round drains imports before admissions, so an import
-        queued before a submit is always resident by the time that
-        request prefills."""
-        with self._import_lock:
-            self._pending_imports.append(export)
-        self.queue.work_available.set()     # wake a parked loop
-
-    def step(self) -> bool:
-        applied = self._apply_imports()
-        return super().step() or applied
-
-    def _can_admit(self, req) -> bool:
-        # drain imports again at the admission gate: a submit can land
-        # mid-step (after this step's top-of-loop drain but before
-        # _admit pops it), and its staged import must still be resident
-        # before the prefill's prefix match runs. No-op when empty.
-        self._apply_imports()
-        return super()._can_admit(req)
-
-    def _apply_imports(self) -> bool:
-        with self._import_lock:
-            if not self._pending_imports:
-                return False
-            pending, self._pending_imports = self._pending_imports, []
-        applied = False
-        for export in pending:
-            n = import_kv(self, export)
-            if n:
-                applied = True
-                self._imports += 1
-                self._import_blocks += n
-                _IMPORTS.inc(outcome="applied")
-                _IMPORT_BLOCKS.inc(n)
-            else:
-                _IMPORTS.inc(outcome="skipped")
-        return applied
-
-    def stats(self):
-        import dataclasses
-
-        return dataclasses.replace(
-            super().stats(),
-            kv_imports=self._imports,
-            kv_import_blocks=self._import_blocks,
-        )
+    def _note_kv_import(self, outcome: str, blocks: int) -> None:
+        _IMPORTS.inc(outcome=outcome)
+        if blocks:
+            _IMPORT_BLOCKS.inc(blocks)

@@ -173,6 +173,15 @@ class EngineStats:
     kv_export_blocks: Optional[int] = None
     kv_imports: Optional[int] = None
     kv_import_blocks: Optional[int] = None
+    # tiered KV cache fields (paged engines with a host/storage tier —
+    # serving/kv_tier.py): occupancy of the host rung plus the demotion/
+    # promotion ladder counters; None (and off the wire) without a tier
+    kv_host_tier_blocks: Optional[int] = None
+    kv_host_tier_bytes: Optional[int] = None
+    kv_tier_demotions: Optional[int] = None
+    kv_tier_promotions: Optional[int] = None
+    kv_tier_dropped: Optional[int] = None
+    kv_storage_tier_blocks: Optional[int] = None
     # speculative decoding fields (spec_tokens > 0 only; serving/spec.py)
     spec_tokens: Optional[int] = None
     spec_proposed_tokens: Optional[int] = None
@@ -1247,6 +1256,9 @@ class PagedInferenceEngine(InferenceEngine):
         kv_quant: Optional[str] = None,
         native_attention: bool = False,
         kernel: str = "auto",
+        kv_host_tier_bytes: Optional[int] = None,
+        kv_storage_tier=None,
+        kv_tier=None,
         **kwargs,
     ):
         from lzy_tpu.ops.paged_attention import (
@@ -1312,6 +1324,34 @@ class PagedInferenceEngine(InferenceEngine):
             raise ValueError(f"kv_blocks must be >= 2, got {kv_blocks}")
         self._kv_blocks = kv_blocks
         self.kv = RadixCache(kv_blocks, page_size)
+        # tiered KV cache (serving/kv_tier.py): radix eviction DEMOTES
+        # block payloads to pinned host RAM (and onward to storage)
+        # instead of dropping them; admission PROMOTES them back. The
+        # tier is advisory end to end — every failure path degrades to
+        # classic eviction / local re-prefill.
+        if kv_tier is not None:
+            self.kv_tier = kv_tier
+        elif kv_host_tier_bytes is not None or kv_storage_tier is not None:
+            from lzy_tpu.serving.kv_tier import HostKVTier
+
+            self.kv_tier = HostKVTier(kv_host_tier_bytes or 0, page_size,
+                                      storage=kv_storage_tier)
+        else:
+            self.kv_tier = None
+        if self.kv_tier is not None:
+            self.kv.on_evict = self._demote_block
+            self.kv.on_insert = self.kv_tier.discard
+        # cross-replica / disagg import queue: transferred KVBlockExports
+        # fold into the pool+tree between engine steps, strictly before
+        # admissions (a queued import is resident by the time the request
+        # that wants it prefills); export requests are the outbound twin,
+        # serviced on THIS thread so the device→host gather never races a
+        # donating prefill
+        self._pending_imports: List[Any] = []
+        self._export_requests: List[tuple] = []
+        self._kv_io_lock = threading.Lock()
+        self.kv_imports = 0
+        self.kv_import_blocks = 0
         # page tables: [slots, pages_per_seq] block ids (0 = scratch pad);
         # _slot_blocks mirrors the allocated prefix of each row in python
         self._tables = np.zeros((slots, self._pages_per_seq), np.int32)
@@ -1453,6 +1493,11 @@ class PagedInferenceEngine(InferenceEngine):
         backstopped by eviction + youngest-preemption."""
         from lzy_tpu.serving.kv_cache import blocks_for
 
+        # drain queued KV imports at the admission gate: a submit can
+        # land mid-step (after the top-of-loop drain but before _admit
+        # pops it), and its staged import must be resident before the
+        # prefill's prefix match runs. No-op when the queue is empty.
+        self._apply_imports()
         return self.kv.available() >= blocks_for(len(req.prompt), self._page)
 
     def _admit_verdict(self, req: Request) -> str:
@@ -1476,6 +1521,12 @@ class PagedInferenceEngine(InferenceEngine):
 
         prompt = req.prompt
         t0 = len(prompt)
+        # tier promotion FIRST: chains that aged out of HBM (or arrived
+        # via the shared storage tier) re-enter the radix tree here, so
+        # the match below hits them like any locally-cached prefix — and
+        # counts them in prefill_tokens_saved, which is the honest
+        # accounting (the prefill really is skipped)
+        self._promote_for(prompt[:-1])
         # longest cached whole-block prefix; capped at prompt[:-1] so at
         # least one real token remains to forward (logits for the first
         # generated token must come from an actual prefill position)
@@ -1578,6 +1629,363 @@ class PagedInferenceEngine(InferenceEngine):
         # during its own prefill, same as any freed slot's blocks)
         self.kv.release(job.table)
         job.table = []
+
+    # -- tiered KV cache (serving/kv_tier.py) --------------------------------
+
+    def step(self) -> bool:
+        """Paged scheduling round: service cross-replica KV I/O (queued
+        imports + export requests) strictly before the base round's
+        admissions, then run it — an import queued before a submit is
+        always resident by the time that request prefills."""
+        serviced = self._service_kv_io()
+        return super().step() or serviced
+
+    def _demote_block(self, chain, block: int, origin) -> None:
+        """``RadixCache.on_evict`` hook: gather the evicted block's K/V
+        rows (int8 sidecar leaves included — they are ordinary cache
+        leaves) to host memory and file them in the tier, keyed by the
+        block's full token chain. Every failure — including the
+        ``kvtier.demote`` chaos fault inside ``put`` — degrades to the
+        classic drop the eviction was going to do anyway."""
+        tier = self.kv_tier
+        if tier is None or not chain:
+            return
+        try:
+            leaves = {}
+            for key, leaf in zip(self._kv_leaf_keys(),
+                                 jax.tree_util.tree_leaves(self._cache)):
+                if key is None:        # index leaf: not payload
+                    continue
+                leaves[key] = np.asarray(leaf[block])
+            tier.put(tuple(int(t) for t in chain), leaves, origin=origin)
+        except Exception as e:  # noqa: BLE001 — demotion is advisory
+            tier.note_dropped()
+            _LOG.debug("kvtier: demotion of a %d-token chain dropped "
+                       "(%s: %s)", len(chain), type(e).__name__, e)
+
+    def _kv_leaf_keys(self):
+        """Cache-leaf keystrs in ``tree_leaves`` order, index leaves as
+        None — computed ONCE per engine (the cache's structure never
+        changes after build). Demotion runs inside the admission path's
+        eviction loop, and a full ``tree_flatten_with_path`` + per-leaf
+        ``keystr`` per evicted block would tax every pressured
+        admission with repeated pytree walks."""
+        keys = getattr(self, "_kv_leaf_keys_cache", None)
+        if keys is None:
+            flat, _ = jax.tree_util.tree_flatten_with_path(self._cache)
+            keys = [None if self._is_index(path)
+                    else jax.tree_util.keystr(path)
+                    for path, _ in flat]
+            self._kv_leaf_keys_cache = keys
+        return keys
+
+    def kv_tier_match_len(self, tokens: Sequence[int]) -> int:
+        """Tokens coverable by the radix tree PLUS contiguously
+        promotable tier chains — the probe the gateway uses to value a
+        tier hit like a radix hit before staging a sibling import.
+        Read-only: no refs, no promotion, no LRU bumps."""
+        page = self._page
+        n_full = len(tokens) // page
+        prefix = [int(t) for t in tokens[:n_full * page]]
+        depth = self.kv.match_len(prefix) // page
+        if self.kv_tier is not None:
+            while depth < n_full and self.kv_tier.has(
+                    tuple(prefix[:(depth + 1) * page])) is not None:
+                depth += 1
+        return depth * page
+
+    def _promote_for(self, tokens: Sequence[int]) -> int:
+        """Extend the radix match for ``tokens`` from the host/storage
+        tiers: pop contiguous tier chains past the resident prefix,
+        re-allocate pool blocks for them (evict-then-import — resident
+        refcounted blocks are untouchable by construction), scatter the
+        payloads in, and re-insert the chains with their origin
+        provenance. Returns blocks promoted; 0 on any failure — the
+        request simply re-prefills the tail locally (``kvtier.import``
+        chaos proves that path bit-identical)."""
+        tier = self.kv_tier
+        if tier is None:
+            return 0
+        from lzy_tpu.serving.kv_cache import NoFreeBlocks
+
+        page = self._page
+        n_full = len(tokens) // page
+        if n_full == 0:
+            return 0
+        prefix = [int(t) for t in tokens[:n_full * page]]
+        matched = self.kv.match_len(prefix) // page
+        if matched >= n_full:
+            return 0
+        entries: List[Any] = []
+        pin_blocks: List[int] = []
+        blocks: List[int] = []
+        try:
+            CHAOS.hit("kvtier.import")
+            depth = matched
+            while depth < n_full:
+                entry = tier.take(tuple(prefix[:(depth + 1) * page]))
+                if entry is None:
+                    break
+                entries.append(entry)
+                depth += 1
+            if not entries:
+                return 0
+            # pin the already-resident prefix: the allocate below may
+            # evict unreferenced leaves, and evicting an ancestor of the
+            # chain being promoted would corrupt the insert
+            if matched:
+                pin_blocks, _ = self.kv.lookup(prefix[:matched * page])
+            blocks = self.kv.allocate(len(entries))
+            ids = jnp.asarray(blocks, jnp.int32)
+            flat, _ = jax.tree_util.tree_flatten_with_path(self._cache)
+            expected = {jax.tree_util.keystr(p) for p, _ in flat
+                        if not self._is_index(p)}
+            for entry in entries:
+                if set(entry.leaves) != expected:
+                    # same fail-closed contract as import_kv: scattering
+                    # a quantized payload into an fp pool (or vice
+                    # versa) would serve garbage with no error anywhere
+                    raise ValueError(
+                        "tier entry leaves do not match the pool's "
+                        "cache leaves (mismatched kv_quant between the "
+                        "demoting and promoting pools?)")
+
+            def put(path, leaf):
+                if self._is_index(path):
+                    return leaf
+                key = jax.tree_util.keystr(path)
+                data = np.stack([e.leaves[key] for e in entries])
+                if data.shape[1:] != leaf.shape[1:] \
+                        or data.dtype != leaf.dtype:
+                    raise ValueError(
+                        f"tier leaf {data.shape}/{data.dtype} does not "
+                        f"fit pool leaf {leaf.shape}/{leaf.dtype}")
+                return leaf.at[ids].set(jnp.asarray(data))
+
+            self._cache = jax.tree_util.tree_map_with_path(put, self._cache)
+            # per-chain inserts so each node keeps ITS producer's
+            # provenance (a host-promoted chain may ride on a block a
+            # sibling replica originally prefilled)
+            for i, entry in enumerate(entries):
+                self.kv.insert(prefix[:(matched + i + 1) * page],
+                               pin_blocks + blocks[:i + 1],
+                               origin=entry.origin)
+            self.kv.release(blocks)
+            if pin_blocks:
+                self.kv.release(pin_blocks)
+            for entry in entries:
+                # counted at SUCCESS, not at take: a failed promotion
+                # must not make the tier look effective
+                tier.note_promoted(getattr(entry, "tier", None) or "host")
+            return len(entries)
+        except Exception as e:  # noqa: BLE001 — promotion is advisory
+            # roll back: popped host entries are re-filed (their payload
+            # never logically left the tier), refs dropped, and the
+            # caller re-prefills — a failed promotion costs FLOPs, never
+            # correctness and never a failed request
+            for entry in entries:
+                if getattr(entry, "tier", None) == "host":
+                    tier.restore(entry)
+            if blocks:
+                self.kv.release(blocks)
+            if pin_blocks:
+                self.kv.release(pin_blocks)
+            _LOG.info("kvtier: promotion failed (%s: %s); falling back "
+                      "to local prefill", type(e).__name__, e)
+            return 0
+
+    # -- cross-replica KV import/export --------------------------------------
+
+    def queue_kv_import(self, export) -> None:
+        """Enqueue a transferred prefix (``KVBlockExport``); applied
+        between engine steps, strictly before admissions. Queue BEFORE
+        submitting the request that wants it."""
+        with self._kv_io_lock:
+            self._pending_imports.append(export)
+        self.queue.work_available.set()     # wake a parked loop
+
+    def _apply_imports(self) -> bool:
+        with self._kv_io_lock:
+            if not self._pending_imports:
+                return False
+            pending, self._pending_imports = self._pending_imports, []
+        from lzy_tpu.serving.disagg.kv_export import import_kv
+
+        applied = False
+        for export in pending:
+            n = import_kv(self, export)
+            if n:
+                applied = True
+                self.kv_imports += 1
+                self.kv_import_blocks += n
+                self._note_kv_import("applied", n)
+            else:
+                self._note_kv_import("skipped", 0)
+        return applied
+
+    def _note_kv_import(self, outcome: str, blocks: int) -> None:
+        """Metrics hook — the disagg ``DecodeEngine`` counts its
+        ``lzy_disagg_kv_imports_total`` family here."""
+
+    def request_kv_export(self, tokens: Sequence[int],
+                          timeout_s: float = 5.0):
+        """Snapshot this engine's cached KV covering ``tokens``' prefix
+        — radix-resident blocks plus host-tier continuation chains — as
+        one ``KVBlockExport``, WITHOUT the caller touching the live
+        cache: the gather runs on the engine's own scheduling thread
+        between steps (a concurrent prefill would donate those
+        buffers). Returns None on timeout, shutdown, or nothing cached
+        — the caller (the gateway's cross-replica import) degrades to
+        a local re-prefill."""
+        if self._closed:
+            return None
+        if self._thread is None:
+            # synchronous/test mode: by the engine's single-driver
+            # contract the caller IS the scheduling thread
+            try:
+                return self._export_now(tokens)
+            except Exception:  # noqa: BLE001 — export is advisory
+                return None
+        holder: dict = {}
+        done = threading.Event()
+        with self._kv_io_lock:
+            self._export_requests.append((list(tokens), holder, done))
+        self.queue.work_available.set()
+        if not done.wait(timeout_s):
+            return None
+        return holder.get("export")
+
+    def _service_kv_io(self) -> bool:
+        """Between-steps servicing of the import queue and pending
+        export requests (both on the scheduling thread — the only
+        thread that may read or scatter the pooled cache leaves)."""
+        did = self._apply_imports()
+        with self._kv_io_lock:
+            if not self._export_requests:
+                return did
+            requests, self._export_requests = self._export_requests, []
+        for tokens, holder, done in requests:
+            try:
+                holder["export"] = self._export_now(tokens)
+            except Exception as e:  # noqa: BLE001 — export is advisory
+                _LOG.warning("kv export request failed (%s: %s)",
+                             type(e).__name__, e)
+                holder["export"] = None
+            finally:
+                done.set()
+            did = True
+        return did
+
+    def _export_now(self, tokens: Sequence[int]):
+        """Compose the export: the pinned radix gather (``export_kv``)
+        for the HBM-resident prefix, extended block-by-block from the
+        host tier (``peek`` — the source keeps its copy; the importer
+        allocates its own fresh blocks)."""
+        from lzy_tpu.channels.kv_transfer import KVBlockExport
+        from lzy_tpu.serving.disagg.kv_export import export_kv
+
+        page = self._page
+        n_full = len(tokens) // page
+        if n_full == 0:
+            return None
+        prefix = [int(t) for t in tokens[:n_full * page]]
+        export = export_kv(self, prefix)
+        depth = len(export.tokens) // page if export is not None else 0
+        tier = self.kv_tier
+        if tier is None or depth >= n_full:
+            return export
+        extra: List[Any] = []
+        while depth + len(extra) < n_full:
+            entry = tier.peek(
+                tuple(prefix[:(depth + len(extra) + 1) * page]))
+            if entry is None:
+                break
+            extra.append(entry)
+        if not extra:
+            return export
+        if export is None:
+            keys = set(extra[0].leaves)
+            if any(set(e.leaves) != keys for e in extra):
+                return None
+            leaves = {k: np.stack([e.leaves[k] for e in extra])
+                      for k in extra[0].leaves}
+            return KVBlockExport(tokens=prefix[:len(extra) * page],
+                                 page_size=page, leaves=leaves)
+        keys = set(export.leaves)
+        if any(set(e.leaves) != keys for e in extra):
+            return export           # mismatched leaf sets: HBM part only
+        leaves = {}
+        for k, arr in export.leaves.items():
+            leaves[k] = np.concatenate(
+                [np.asarray(arr)] + [e.leaves[k][None] for e in extra])
+        return KVBlockExport(
+            tokens=prefix[:(depth + len(extra)) * page],
+            page_size=page, leaves=leaves)
+
+    def kv_chains(self, limit: int = 4096) -> dict:
+        """Chains this replica could serve an import from, by tier —
+        the advertisement the gateway's global prefix index refreshes
+        each tick. Best-effort and lock-free over the tree (the index
+        is an expectation; a torn walk costs at worst one pointless
+        import attempt that degrades to re-prefill). Cached by the
+        tree/tier structure versions: an unchanged cache returns the
+        SAME object, which the gateway uses to skip re-hashing the
+        whole advertisement every tick."""
+        version = (self.kv.structure_version,
+                   self.kv_tier.version if self.kv_tier is not None
+                   else 0)
+        cached = getattr(self, "_kv_chains_cache", None)
+        if cached is not None and cached[0] == version:
+            return cached[1]
+        out = {"hbm": [], "host": []}
+        try:
+            # LEAF chains only: the index registers every chunk depth of
+            # a chain, so interior-node chains would be pure redundancy —
+            # wasted hashing per tick, and worse, shallow chains crowding
+            # the advertisement limit out of the deep ones that make
+            # imports worth staging
+            def walk(node, prefix):
+                for child in list(node.children.values()):
+                    if len(out["hbm"]) >= limit:
+                        return
+                    chain = prefix + list(child.chunk)
+                    if not child.children:
+                        out["hbm"].append(chain)
+                    walk(child, chain)
+
+            walk(self.kv._root, [])
+        except Exception:  # noqa: BLE001 — advertisement is advisory
+            pass
+        if self.kv_tier is not None:
+            try:
+                out["host"] = [list(c)
+                               for c in self.kv_tier.chains()[:limit]]
+            except Exception:  # noqa: BLE001 — advertisement is advisory
+                pass
+        self._kv_chains_cache = (version, out)
+        return out
+
+    @property
+    def kv_tier_demotions(self) -> int:
+        """Demotions down the ladder (hbm→host + host→storage); 0
+        without a tier. Read by the fleet aggregate."""
+        if self.kv_tier is None:
+            return 0
+        s = self.kv_tier.stats()
+        return s["demotions"] + s["demotions_to_storage"]
+
+    @property
+    def kv_tier_promotions(self) -> int:
+        if self.kv_tier is None:
+            return 0
+        s = self.kv_tier.stats()
+        return s["promotions"] + s["promotions_from_storage"]
+
+    @property
+    def kv_tier_dropped(self) -> int:
+        if self.kv_tier is None:
+            return 0
+        return self.kv_tier.stats()["dropped"]
 
     # -- decode --------------------------------------------------------------
 
@@ -1733,7 +2141,7 @@ class PagedInferenceEngine(InferenceEngine):
             # blocks currently holding int8 data: everything usable that
             # is not on the free list (slot-resident + radix-cached)
             self._note_quant_resident(ks.blocks_total - ks.blocks_free)
-        return dataclasses.replace(
+        s = dataclasses.replace(
             s,
             kv_page_size=self._page,
             kv_blocks_total=ks.blocks_total,
@@ -1744,7 +2152,23 @@ class PagedInferenceEngine(InferenceEngine):
             prefill_tokens_saved=ks.prefill_tokens_saved,
             kernel_path=self.kernel_path,
             kv_quant=self._kv_quant,
+            kv_imports=self.kv_imports,
+            kv_import_blocks=self.kv_import_blocks,
         )
+        if self.kv_tier is not None:
+            ts = self.kv_tier.stats()
+            s = dataclasses.replace(
+                s,
+                kv_host_tier_blocks=ts["host_blocks"],
+                kv_host_tier_bytes=ts["host_bytes"],
+                kv_tier_demotions=(ts["demotions"]
+                                   + ts["demotions_to_storage"]),
+                kv_tier_promotions=(ts["promotions"]
+                                    + ts["promotions_from_storage"]),
+                kv_tier_dropped=ts["dropped"],
+                kv_storage_tier_blocks=ts.get("storage_blocks"),
+            )
+        return s
 
     def _note_quant_resident(self, resident: int) -> None:
         with self._quant_resident_lock:
@@ -1762,6 +2186,15 @@ class PagedInferenceEngine(InferenceEngine):
         super().close(timeout)
         if self._kv_quant is not None:
             self._note_quant_resident(0)
+        if self.kv_tier is not None:
+            self.kv_tier.close()
+        # wake any export waiter parked on a request the loop will
+        # never service again (it reads None and re-prefills locally)
+        with self._kv_io_lock:
+            requests, self._export_requests = self._export_requests, []
+        for _, holder, done in requests:
+            holder["export"] = None
+            done.set()
 
     def stats_by_tenant(self) -> dict:
         out = super().stats_by_tenant()

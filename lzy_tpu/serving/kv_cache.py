@@ -177,9 +177,26 @@ class RadixCache:
         self._root = _Node(None, None, None)
         self._node_of: Dict[int, _Node] = {}
         self._clock = 0          # logical LRU clock — deterministic
+        # bumped only when the TREE changes shape (insert created nodes,
+        # eviction removed one) — not on lookups: the gateway's
+        # advertisement cache keys on it to skip re-hashing an
+        # unchanged cache every tick
+        self.structure_version = 0
         self.evictions = 0
         self.hit_tokens = 0
         self.lookup_tokens = 0
+        # tier hooks (serving/kv_tier.py): ``on_evict(chain_tokens,
+        # block, origin)`` fires BEFORE an evicted leaf's block returns
+        # to the free list — the engine's demotion hook gathers the
+        # block's K/V rows to host memory there; ``on_insert(chain)``
+        # fires for each NEWLY created tree node with its full root→node
+        # token chain — the engine drops any demoted-tier copy of that
+        # chain (the HBM copy is authoritative, and a chain must live in
+        # exactly one tier for the conservation audit to hold). Both are
+        # guarded: a hook failure degrades to classic eviction / a
+        # harmless stale tier entry, never a broken tree.
+        self.on_evict = None
+        self.on_insert = None
         self._update_gauges()
 
     # -- tree ----------------------------------------------------------------
@@ -259,7 +276,9 @@ class RadixCache:
         self._clock += 1
         node = self._root
         created = 0
+        chain: List[int] = []
         for chunk, block in zip(self._chunks(tokens), blocks):
+            chain.extend(chunk)
             child = node.children.get(chunk)
             if child is None:
                 child = _Node(chunk, block, node)
@@ -267,8 +286,15 @@ class RadixCache:
                 node.children[chunk] = child
                 self._node_of[block] = child
                 created += 1
+                if self.on_insert is not None:
+                    try:
+                        self.on_insert(tuple(chain))
+                    except Exception:  # noqa: BLE001 — advisory hook
+                        pass
             child.last_access = self._clock
             node = child
+        if created:
+            self.structure_version += 1
         self._update_gauges()
         return created
 
@@ -324,18 +350,40 @@ class RadixCache:
         walk(self._root)
         return out
 
+    def chain_tokens(self, node: "_Node") -> List[int]:
+        """The full root→``node`` token chain (the tier identity of the
+        node's block)."""
+        chunks: List[Tuple[int, ...]] = []
+        while node is not self._root and node is not None:
+            chunks.append(node.chunk)
+            node = node.parent
+        out: List[int] = []
+        for chunk in reversed(chunks):
+            out.extend(chunk)
+        return out
+
     def _evict_one(self) -> bool:
         """Evict the least-recently-used unreferenced leaf; returns False
         when nothing is evictable (every cached block is pinned by an
-        in-flight request)."""
+        in-flight request). With an ``on_evict`` hook installed, the
+        victim's payload is offered for DEMOTION before its block id
+        returns to the free list — a hook failure degrades to the
+        classic drop."""
         leaves = self._evictable_leaves()
         if not leaves:
             return False
         victim = min(leaves, key=lambda node: node.last_access)
+        if self.on_evict is not None:
+            try:
+                self.on_evict(self.chain_tokens(victim), victim.block,
+                              victim.origin)
+            except Exception:  # noqa: BLE001 — demotion is advisory
+                pass
         del victim.parent.children[victim.chunk]
         del self._node_of[victim.block]
         self.pool.release_to_free(victim.block)
         self.evictions += 1
+        self.structure_version += 1
         _EVICTIONS.inc()
         return True
 

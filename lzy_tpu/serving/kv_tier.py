@@ -1,0 +1,601 @@
+"""Tiered KV cache: HBM block pool → pinned host RAM → storage.
+
+A replica's radix prefix cache (``serving/kv_cache.py``) is capped by
+one device's HBM: under pressure, LRU eviction *drops* warm prefixes,
+and the prefill FLOPs that produced them are re-paid by the next
+arrival. This module is the next rung of the ladder — eviction becomes
+**demotion**:
+
+- :class:`HostKVTier` — a budgeted host-RAM tier behind each paged
+  engine. When the radix tree evicts an unreferenced leaf, the engine
+  gathers that block's K/V rows (plus int8 quantization sidecars — they
+  are just more cache leaves) to host memory and files them here, keyed
+  by the block's **full token chain** from the tree root (the exact
+  identity a radix prefix match needs back). The tier has its own byte
+  budget and logical-clock LRU; overflowing entries demote onward to
+  the storage tier, or drop (a drop re-creates classic eviction: the
+  next miss re-prefills).
+- :class:`StorageKVTier` — the durable rung. Entries spill through the
+  storage plane in the PR-4 ``kv_block_manifest`` format (leaf objects
+  first, the manifest object last — a visible manifest names a whole
+  payload), under a deterministic per-chain URI, so any replica sharing
+  the storage root can promote another replica's demoted prefixes: the
+  storage tier is fleet-global by construction.
+
+**Promotion** is the reverse walk: at admission, a paged engine with a
+tier extends its radix match chunk-by-chunk from the host tier (then
+the storage tier), re-allocating pool blocks evict-then-import style
+and re-inserting the chain with its origin provenance — so a prefix
+that aged out of HBM (or was computed by a sibling replica) costs a
+host/storage copy instead of a re-prefill.
+
+Every tier operation is ADVISORY: a failed demotion drops the payload
+(classic eviction), a failed promotion falls back to local re-prefill.
+The chaos points ``kvtier.demote`` / ``kvtier.import`` inject exactly
+those failures; the tier contract is that neither can ever fail a
+request.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from lzy_tpu.chaos.faults import CHAOS, InjectedFault
+from lzy_tpu.utils.log import get_logger
+from lzy_tpu.utils.metrics import REGISTRY
+
+_LOG = get_logger(__name__)
+
+DEMOTIONS = REGISTRY.counter(
+    "lzy_kvtier_demotions_total",
+    "KV block payloads demoted down the tier ladder, by (from_tier, "
+    "to_tier) — hbm->host on radix eviction, host->storage on host-budget "
+    "overflow")
+PROMOTIONS = REGISTRY.counter(
+    "lzy_kvtier_promotions_total",
+    "KV block payloads promoted back into the HBM radix tree, by "
+    "(from_tier, to_tier)")
+DROPPED = REGISTRY.counter(
+    "lzy_kvtier_dropped_total",
+    "tier payloads dropped (budget overflow with no lower tier, or a "
+    "failed demotion) — a drop degrades to classic eviction, never an "
+    "error")
+HOST_BLOCKS = REGISTRY.gauge(
+    "lzy_kvtier_host_blocks",
+    "block payloads resident in host-RAM tiers (process-wide sum)")
+HOST_BYTES = REGISTRY.gauge(
+    "lzy_kvtier_host_bytes",
+    "bytes resident in host-RAM tiers (process-wide sum)")
+STORAGE_BLOCKS = REGISTRY.gauge(
+    "lzy_kvtier_storage_blocks",
+    "block payloads this process has spilled to the storage tier")
+
+# chaos boundaries: both are advisory BY CONTRACT — an injected failure
+# at demote costs the payload (classic eviction), at import/promote it
+# costs a local re-prefill; neither may ever fail a request (the
+# invariant the kvtier chaos tests assert bit-identically)
+FP_DEMOTE = CHAOS.register(
+    "kvtier.demote", error=InjectedFault,
+    doc="KV block payload leaving HBM for a lower tier (radix eviction "
+        "demoting to host RAM, or host-budget overflow spilling to "
+        "storage)")
+FP_IMPORT = CHAOS.register(
+    "kvtier.import", error=InjectedFault,
+    doc="tier/cross-replica KV promotion toward HBM (host/storage-tier "
+        "promotion at admission, or a gateway-staged sibling import)")
+
+
+def chain_digest(chain: Iterable[int]) -> str:
+    """Stable, collision-resistant object name for a token chain — the
+    storage tier's URI key, shared by every replica that spills or
+    promotes against the same storage root."""
+    h = hashlib.sha256()
+    for t in chain:
+        h.update(int(t).to_bytes(4, "little", signed=False))
+    return h.hexdigest()[:32]
+
+
+class TierEntry:
+    """One demoted block: the K/V leaf rows of a single pool block plus
+    the identity (full root→node token chain) a prefix match needs to
+    re-admit it."""
+
+    __slots__ = ("chain", "leaves", "nbytes", "origin", "clock", "tier")
+
+    def __init__(self, chain: Tuple[int, ...],
+                 leaves: Dict[str, np.ndarray],
+                 origin: Optional[str] = None):
+        self.chain = chain
+        self.leaves = leaves
+        self.nbytes = sum(int(a.nbytes) for a in leaves.values())
+        self.origin = origin
+        self.clock = 0
+        self.tier = None            # set by take(): which rung served it
+
+
+class StorageKVTier:
+    """Durable tier: per-chain spills in the ``kv_block_manifest``
+    format through any ``storage/`` client. Keys are deterministic
+    chain digests under one base URI, so N replicas configured with the
+    same root share one fleet-global tier — replica A's demotions are
+    replica B's promotions with no coordination beyond the URI.
+    """
+
+    def __init__(self, storage, base_uri: str, page_size: int, *,
+                 max_chains: int = 8192):
+        self._storage = storage
+        self._base = base_uri.rstrip("/")
+        self.page_size = page_size
+        self._lock = threading.Lock()
+        #: chains THIS process spilled, in insertion order (observability
+        #: AND the pruning bound; the shared tier may hold more — other
+        #: replicas' spills are found by URI probe). Without a bound a
+        #: long-running fleet would accumulate manifest objects forever:
+        #: past ``max_chains`` the oldest of OUR spills is deleted from
+        #: storage (FIFO — the bottom rung's eviction), counted as a
+        #: drop. Each process prunes only its own spills; siblings own
+        #: theirs.
+        self._spilled: Dict[Tuple[int, ...], None] = {}
+        self.max_chains = max_chains
+        self.spills = 0
+        self.fetches = 0
+        self.pruned = 0
+
+    def _uri(self, chain: Tuple[int, ...]) -> str:
+        from lzy_tpu.storage.api import join_uri
+
+        return join_uri(self._base, f"chain-{chain_digest(chain)}")
+
+    def put(self, entry: TierEntry) -> None:
+        """Spill one entry: leaf objects first (multipart + retries via
+        the transfer engine), the manifest last — the sharded-spill
+        completion contract. Raises on failure; the caller degrades to
+        a drop."""
+        from lzy_tpu.channels.kv_transfer import (
+            KVBlockExport, spill_kv_export)
+
+        export = KVBlockExport(
+            tokens=[int(t) for t in entry.chain],
+            page_size=self.page_size,
+            # single-block payload: [1, page, heads, dim] per leaf; the
+            # manifest's tokens field carries the FULL chain (identity),
+            # the leaves carry only the chain's last block (payload)
+            leaves={k: v[None] for k, v in entry.leaves.items()},
+            prefilled_by=entry.origin,
+        )
+        spill_kv_export(self._storage, self._uri(entry.chain), export)
+        victims: List[Tuple[int, ...]] = []
+        with self._lock:
+            self._spilled.pop(entry.chain, None)
+            self._spilled[entry.chain] = None
+            self.spills += 1
+            while len(self._spilled) > self.max_chains:
+                victims.append(next(iter(self._spilled)))
+                del self._spilled[victims[-1]]
+                self.pruned += 1
+            STORAGE_BLOCKS.set(float(len(self._spilled)))
+        for victim in victims:
+            DROPPED.inc(tier="storage")
+            self.discard(victim)
+
+    def get(self, chain: Tuple[int, ...]) -> Optional[TierEntry]:
+        """Fetch a chain's entry, from THIS or any sibling replica's
+        spill. None on any failure (missing, torn, wrong chain) — the
+        caller re-prefills."""
+        from lzy_tpu.channels.kv_transfer import fetch_kv_export
+
+        uri = self._uri(chain)
+        try:
+            if not self._storage.exists(uri):
+                return None
+            export = fetch_kv_export(self._storage, uri)
+        except Exception as e:  # noqa: BLE001 — promotion is advisory
+            _LOG.warning("kvtier: storage fetch of %s failed (%s: %s)",
+                         uri, type(e).__name__, e)
+            return None
+        if tuple(export.tokens) != tuple(chain):
+            # a digest collision or a torn write: fail closed — scattering
+            # the wrong chain's KV would serve garbage with no error
+            _LOG.warning("kvtier: storage entry %s names a different "
+                         "chain; ignoring", uri)
+            return None
+        entry = TierEntry(tuple(chain),
+                          {k: np.asarray(v[0])
+                           for k, v in export.leaves.items()},
+                          origin=export.prefilled_by)
+        with self._lock:
+            self.fetches += 1
+        return entry
+
+    def known(self, chain: Tuple[int, ...]) -> bool:
+        """Membership in THIS process's spill set — an O(1), no-I/O
+        probe (foreign replicas' spills are discovered by ``get``'s
+        existence check at promotion time, off the routing path)."""
+        with self._lock:
+            return tuple(chain) in self._spilled
+
+    def discard(self, chain: Tuple[int, ...]) -> None:
+        """Best-effort removal (manifest + leaf objects)."""
+        from lzy_tpu.channels.kv_transfer import parse_kv_manifest
+
+        uri = self._uri(chain)
+        try:
+            doc = parse_kv_manifest(self._storage.read_bytes(uri))
+            for meta in doc["leaves"].values():
+                try:
+                    self._storage.delete(meta["uri"])
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
+            self._storage.delete(uri)
+        except Exception:  # noqa: BLE001 — may never have landed
+            pass
+        with self._lock:
+            self._spilled.pop(tuple(chain), None)
+            STORAGE_BLOCKS.set(float(len(self._spilled)))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"storage_blocks": len(self._spilled),
+                    "storage_spills": self.spills,
+                    "storage_fetches": self.fetches,
+                    "storage_pruned": self.pruned}
+
+
+class HostKVTier:
+    """Budgeted host-RAM tier with logical-clock LRU.
+
+    ``put`` is the demotion entry point (hit by the ``kvtier.demote``
+    chaos boundary — callers catch everything and degrade to a drop);
+    ``take`` pops an entry for promotion back into HBM (host residency
+    moves with the payload, keeping "a block lives in exactly one tier"
+    auditable); ``peek`` reads without moving (the cross-replica export
+    path — the source keeps its copy, the importer allocates fresh
+    blocks). A configured :class:`StorageKVTier` receives LRU overflow
+    instead of dropping it.
+
+    Thread safety: entries are guarded by one lock — ``put``/``take``
+    run on the engine's scheduling thread, ``peek``/``stats``/auditors
+    may run from gateway or test threads.
+    """
+
+    def __init__(self, budget_bytes: int, page_size: int, *,
+                 storage: Optional[StorageKVTier] = None):
+        if budget_bytes < 0:
+            raise ValueError(
+                f"budget_bytes must be >= 0, got {budget_bytes}")
+        self.budget_bytes = int(budget_bytes)
+        self.page_size = page_size
+        self.storage = storage
+        self._entries: Dict[Tuple[int, ...], TierEntry] = {}
+        self._bytes = 0
+        self._clock = 0
+        #: bumped whenever the entry SET changes (put/take/discard/
+        #: restore) — the advertisement cache's change detector
+        self.version = 0
+        self._lock = threading.Lock()
+        # storage spills run on a dedicated worker, NOT the engine's
+        # scheduling thread: a host-budget overflow during allocate()
+        # would otherwise put a remote multipart upload in the middle of
+        # an admission, stalling every in-flight decode for a storage
+        # round trip per evicted block. Entries awaiting upload stay
+        # promotable (take/peek/has read the pending map), and the
+        # kvtier.demote chaos decision is consumed at ENQUEUE time on
+        # the caller's thread, so fault schedules stay replayable.
+        self._spill_pending: Dict[Tuple[int, ...], TierEntry] = {}
+        self._spill_pending_bytes = 0
+        # the queue is BOUNDED: pending uploads pin host RAM outside the
+        # budget, and a slow storage backend under fast eviction churn
+        # must shed (counted drops) rather than grow RSS without limit
+        self._spill_cap_bytes = max(int(budget_bytes), 32 << 20)
+        self._spill_cv = threading.Condition(self._lock)
+        self._spill_thread: Optional[threading.Thread] = None
+        # gauge contributions are deltas (several engines share the
+        # process-global gauges); close() withdraws them
+        self._gauge_blocks = 0
+        self._gauge_bytes = 0
+        self._closed = False
+        self.demotions = 0          # hbm -> host (successful puts)
+        self.demotions_storage = 0  # host -> storage (overflow spills)
+        self.promotions = 0         # host -> hbm (takes)
+        self.promotions_storage = 0  # storage -> hbm (caller-reported)
+        self.dropped = 0
+
+    # -- demotion ------------------------------------------------------------
+
+    def put(self, chain: Tuple[int, ...], leaves: Dict[str, np.ndarray],
+            origin: Optional[str] = None) -> bool:
+        """File one demoted block. Raises whatever the chaos boundary
+        injects (callers catch and count a drop); returns False when the
+        payload could not be kept anywhere (over-budget with no storage
+        tier — the drop IS classic eviction)."""
+        CHAOS.hit("kvtier.demote")
+        entry = TierEntry(tuple(chain), leaves, origin=origin)
+        overflow: List[TierEntry] = []
+        with self._lock:
+            self._clock += 1
+            entry.clock = self._clock
+            old = self._entries.pop(entry.chain, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            kept = entry.nbytes <= self.budget_bytes
+            if kept:
+                self._entries[entry.chain] = entry
+                self._bytes += entry.nbytes
+                DEMOTIONS.inc(from_tier="hbm", to_tier="host")
+                self.demotions += 1
+                while self._bytes > self.budget_bytes:
+                    victim = min(self._entries.values(),
+                                 key=lambda e: e.clock)
+                    del self._entries[victim.chain]
+                    self._bytes -= victim.nbytes
+                    overflow.append(victim)
+            else:
+                overflow.append(entry)
+            self._sync_gauges_locked()
+        kept_anywhere = kept
+        for victim in overflow:
+            queued = self._queue_spill(victim)
+            if victim is entry:
+                kept_anywhere = queued
+        return kept_anywhere
+
+    def _queue_spill(self, entry: TierEntry) -> bool:
+        """Hand an overflowing entry to the spill worker; False =
+        dropped (no storage rung, or the demote fault fired). The chaos
+        decision happens HERE, on the caller's (engine) thread — the
+        worker does pure I/O, so per-point fault ordinals never depend
+        on upload-thread interleaving."""
+        if self.storage is None:
+            with self._lock:
+                self.dropped += 1
+            DROPPED.inc(tier="host")
+            return False
+        try:
+            CHAOS.hit("kvtier.demote")
+        except Exception as e:  # noqa: BLE001 — demotion is advisory
+            _LOG.warning("kvtier: storage spill refused (%s: %s); "
+                         "dropping payload", type(e).__name__, e)
+            with self._lock:
+                self.dropped += 1
+            DROPPED.inc(tier="storage")
+            return False
+        with self._spill_cv:
+            if self._closed or (self._spill_pending_bytes + entry.nbytes
+                                > self._spill_cap_bytes):
+                self.dropped += 1
+                DROPPED.inc(tier="storage")
+                return False
+            old = self._spill_pending.get(entry.chain)
+            if old is not None:
+                self._spill_pending_bytes -= old.nbytes
+            self._spill_pending[entry.chain] = entry
+            self._spill_pending_bytes += entry.nbytes
+            if self._spill_thread is None:
+                self._spill_thread = threading.Thread(
+                    target=self._spill_worker, name="kvtier-spill",
+                    daemon=True)
+                self._spill_thread.start()
+            self._spill_cv.notify_all()
+        return True
+
+    def _pop_pending_locked(self, chain) -> Optional[TierEntry]:
+        entry = self._spill_pending.pop(chain, None)
+        if entry is not None:
+            self._spill_pending_bytes -= entry.nbytes
+        return entry
+
+    def _spill_worker(self) -> None:
+        while True:
+            with self._spill_cv:
+                while not self._spill_pending and not self._closed:
+                    self._spill_cv.wait(timeout=0.5)
+                if self._closed and not self._spill_pending:
+                    return
+                chain = next(iter(self._spill_pending))
+                entry = self._spill_pending[chain]
+            try:
+                self.storage.put(entry)
+            except Exception as e:  # noqa: BLE001 — demotion advisory
+                _LOG.warning("kvtier: storage spill failed (%s: %s); "
+                             "dropping payload", type(e).__name__, e)
+                with self._spill_cv:
+                    self._pop_pending_locked(chain)
+                    self.dropped += 1
+                    self._spill_cv.notify_all()
+                DROPPED.inc(tier="storage")
+                continue
+            with self._spill_cv:
+                self._pop_pending_locked(chain)
+                self.demotions_storage += 1
+                self._spill_cv.notify_all()
+            DEMOTIONS.inc(from_tier="host", to_tier="storage")
+
+    def flush_spills(self, timeout_s: float = 10.0) -> bool:
+        """Block until every queued spill has been uploaded or dropped
+        (tests, and ``close`` — a retiring replica's spills are the
+        fleet's warm-up payload, so they land before the tier dies)."""
+        deadline = time.monotonic() + timeout_s
+        with self._spill_cv:
+            while self._spill_pending:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._spill_cv.wait(timeout=min(0.1, left))
+        return True
+
+    def note_dropped(self, tier: str = "hbm") -> None:
+        """Count a payload that never made it into the tier (the
+        engine's demote hook failed before/inside ``put`` — e.g. the
+        ``kvtier.demote`` chaos fault): the eviction degrades to the
+        classic drop."""
+        with self._lock:
+            self.dropped += 1
+        DROPPED.inc(tier=tier)
+
+    def restore(self, entry: TierEntry) -> None:
+        """Re-file an entry a failed promotion popped — NOT a demotion
+        (no new-demotion counter: the payload never left the tier
+        logically). If the budget refilled in between (the promotion's
+        own allocate may have demoted other blocks), the entry overflows
+        like any other: storage spill when a lower rung exists, a
+        COUNTED drop otherwise — never a silent vanish."""
+        with self._lock:
+            if entry.chain in self._entries:
+                return
+            fits = self._bytes + entry.nbytes <= self.budget_bytes
+            if fits:
+                self._clock += 1
+                entry.clock = self._clock
+                self._entries[entry.chain] = entry
+                self._bytes += entry.nbytes
+            self._sync_gauges_locked()
+        if not fits:
+            self._queue_spill(entry)  # never raises; counts drop/spill
+
+    # -- promotion / lookup --------------------------------------------------
+
+    def take(self, chain: Tuple[int, ...]) -> Optional[TierEntry]:
+        """Pop a chain's entry for promotion into HBM. Falls through to
+        the storage tier on a host miss (the storage copy stays — it is
+        the fleet-shared durable rung). Returns None on a full miss.
+        ``entry.origin`` carries the producer provenance back into the
+        radix insert."""
+        chain = tuple(chain)
+        with self._lock:
+            entry = self._entries.pop(chain, None)
+            if entry is not None:
+                self._bytes -= entry.nbytes
+            else:
+                # awaiting upload: still promotable as host-resident
+                # (the worker's copy uploads harmlessly either way)
+                entry = self._pop_pending_locked(chain)
+            if entry is not None:
+                self._sync_gauges_locked()
+        if entry is not None:
+            entry.tier = "host"
+            return entry
+        if self.storage is None:
+            return None
+        entry = self.storage.get(chain)
+        if entry is None:
+            return None
+        entry.tier = "storage"
+        return entry
+
+    def note_promoted(self, tier: str) -> None:
+        """Count ONE landed promotion. Deliberately not counted at
+        ``take`` time: a promotion that fails downstream (pool pressure,
+        leaf mismatch) restores the entry, and counting the take would
+        make the tier look effective while zero blocks ever re-entered
+        HBM — the engine reports success after the radix insert."""
+        with self._lock:
+            if tier == "storage":
+                self.promotions_storage += 1
+            else:
+                self.promotions += 1
+        PROMOTIONS.inc(from_tier=tier if tier in ("host", "storage")
+                       else "host", to_tier="hbm")
+
+    def peek(self, chain: Tuple[int, ...]) -> Optional[TierEntry]:
+        """Read a HOST entry without moving it (the cross-replica export
+        path). Deliberately does not touch storage: a source replica
+        must not round-trip the durable tier to feed a transport the
+        importer could read directly."""
+        with self._lock:
+            entry = self._entries.get(tuple(chain))
+            if entry is None:
+                entry = self._spill_pending.get(tuple(chain))
+            return entry
+
+    def discard(self, chain: Tuple[int, ...]) -> None:
+        """Drop a host entry whose chain just became HBM-resident again
+        (a fresh local prefill re-inserted it): the tree copy is
+        authoritative, and double residency would break the one-tier
+        accounting the auditors check."""
+        with self._lock:
+            self._pop_pending_locked(tuple(chain))
+            entry = self._entries.pop(tuple(chain), None)
+            if entry is not None:
+                self._bytes -= entry.nbytes
+                self._sync_gauges_locked()
+
+    def has(self, chain: Tuple[int, ...]) -> Optional[str]:
+        """Which tier (if any) could promote this chain right now —
+        ``"host"`` from the entry map, ``"storage"`` from this process's
+        spill set. Deliberately NO storage I/O: this probe sits on the
+        gateway's per-request routing path (``kv_tier_match_len``), and
+        a per-block remote existence check would put storage round
+        trips in front of every route. Foreign replicas' spills are
+        therefore invisible here — they are still found by ``take`` at
+        admission (one existence probe per actually-promoted chunk),
+        where the latency buys a skipped prefill instead of a routing
+        estimate."""
+        chain = tuple(chain)
+        with self._lock:
+            if chain in self._entries or chain in self._spill_pending:
+                return "host"
+        if self.storage is not None and self.storage.known(chain):
+            return "storage"
+        return None
+
+    def chains(self) -> List[Tuple[int, ...]]:
+        """Host-resident chains (for the gateway's global prefix
+        index)."""
+        with self._lock:
+            return list(self._entries)
+
+    # -- observability -------------------------------------------------------
+
+    def _sync_gauges_locked(self) -> None:
+        # called at every entry-set mutation — doubles as the change
+        # detector the advertisement cache keys on
+        self.version += 1
+        blocks = 0 if self._closed else len(self._entries)
+        nbytes = 0 if self._closed else self._bytes
+        HOST_BLOCKS.add(float(blocks - self._gauge_blocks))
+        HOST_BYTES.add(float(nbytes - self._gauge_bytes))
+        self._gauge_blocks, self._gauge_bytes = blocks, nbytes
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "host_blocks": len(self._entries),
+                "host_bytes": self._bytes,
+                "host_budget_bytes": self.budget_bytes,
+                "spill_pending": len(self._spill_pending),
+                "demotions": self.demotions,
+                "demotions_to_storage": self.demotions_storage,
+                "promotions": self.promotions,
+                "promotions_from_storage": self.promotions_storage,
+                "dropped": self.dropped,
+            }
+        if self.storage is not None:
+            out.update(self.storage.stats())
+        return out
+
+    def close(self, flush_timeout_s: float = 5.0) -> None:
+        """Flush queued storage spills (bounded — a retiring replica's
+        demotions are the fleet's warm-up payload), then withdraw this
+        tier's gauge contribution (a retired replica's host tier must
+        not keep inflating the process-wide occupancy)."""
+        if self.storage is not None:
+            self.flush_spills(flush_timeout_s)
+        with self._spill_cv:
+            self._closed = True
+            self._entries.clear()
+            self._bytes = 0
+            # spills that did not land inside the flush budget are LOST
+            # — count them (the module contract: never a silent vanish)
+            stranded = len(self._spill_pending)
+            self.dropped += stranded
+            self._spill_pending.clear()
+            self._spill_pending_bytes = 0
+            self._sync_gauges_locked()
+            self._spill_cv.notify_all()
+        for _ in range(stranded):
+            DROPPED.inc(tier="storage")

@@ -1,0 +1,87 @@
+"""bench.py supervisor: the CPU-fallback path must produce a RESULT row.
+
+BENCH_r01–r05 are all error rows because the TPU relay has been absent
+every round; PR 5 taught ``supervise()`` to fall back to a
+``JAX_PLATFORMS=cpu`` child when the relay is *definitively* absent
+(TCP preflight refused), so a round records a real serving-path
+trajectory tagged ``cpu_fallback: true`` instead of an error-only JSON.
+No round had actually exercised that path until BENCH_r06; this test
+pins the supervisor's control flow fast (the subprocess hop is faked —
+the real end-to-end run is the committed BENCH_r06.json).
+"""
+
+import json
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).parents[1]))
+
+import bench  # noqa: E402
+
+
+@pytest.fixture()
+def _absent_relay(monkeypatch):
+    monkeypatch.setattr(
+        bench, "tcp_preflight",
+        lambda: "relay not listening on 127.0.0.1:8083 (test)")
+    monkeypatch.setattr(
+        bench, "probe_backend",
+        lambda preflight_err=None: "probe 1: hung (test)")
+
+
+def _fake_child(metric_obj):
+    """Stand-in for the ``bench.py --run`` subprocess: emits one metric
+    line on stdout like a healthy CPU child would."""
+    class Proc:
+        returncode = 0
+        stdout = (json.dumps(metric_obj) + "\n").encode()
+
+    calls = []
+
+    def run(cmd, **kw):
+        calls.append((list(cmd), kw))
+        return Proc()
+
+    return run, calls
+
+
+def test_supervise_emits_cpu_fallback_row_when_relay_absent(
+        _absent_relay, monkeypatch, capsys):
+    """Relay definitively absent (preflight refused + probe failed):
+    supervise() must run ONE JAX_PLATFORMS=cpu child and print its
+    metric line tagged cpu_fallback:true + relay_error — NOT an
+    error-only row."""
+    run, calls = _fake_child({
+        "metric": bench.METRIC, "value": 0.0123,
+        "unit": "mfu_fraction", "vs_baseline": 0.03,
+        "detail": {"platform": "cpu"}})
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    bench.supervise()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    obj = json.loads(line)
+    assert obj["cpu_fallback"] is True
+    assert "error" not in obj                      # a RESULT, not an error
+    assert obj["relay_error"].startswith("probe 1")
+    assert obj["value"] == 0.0123
+    # exactly one child, on the CPU backend, running the real body
+    assert len(calls) == 1
+    cmd, kw = calls[0]
+    assert cmd[-1] == "--run"
+    assert kw["env"]["JAX_PLATFORMS"] == "cpu"
+
+
+def test_supervise_still_emits_error_row_when_cpu_child_fails(
+        _absent_relay, monkeypatch, capsys):
+    """If even the CPU child self-diagnoses, the round keeps the
+    error-only contract (never a fabricated result)."""
+    run, _ = _fake_child({
+        "metric": bench.METRIC, "value": 0.0,
+        "unit": "mfu_fraction", "vs_baseline": 0.0,
+        "error": "backend never initialized (test)"})
+    monkeypatch.setattr(bench.subprocess, "run", run)
+    bench.supervise()
+    obj = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert obj["error"]
+    assert "cpu_fallback" not in obj

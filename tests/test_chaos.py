@@ -644,8 +644,14 @@ class TestAutoscalerStability:
 
 def _build_disagg(cfg, params, *, decode=2, prefill=1, tenants=None,
                   prefill_budget=None):
+    # a small host tier on every engine puts the kvtier.demote /
+    # kvtier.import fault points in play for the soak: evictions demote,
+    # admissions attempt promotion, and an injected failure at either
+    # must degrade to classic eviction / local re-prefill with greedy
+    # output still bit-identical to the oracle
     kw = dict(slots=2, page_size=PAGE, temperature=0.7,
-              tenants=tenants, prefill_budget=prefill_budget)
+              tenants=tenants, prefill_budget=prefill_budget,
+              kv_host_tier_bytes=1 << 20)
     decode_fleet = ReplicaFleet(
         lambda: DecodeEngine(cfg, params, **kw),
         replica_prefix="decode")

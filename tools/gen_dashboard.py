@@ -33,6 +33,10 @@ def registry_metrics():
     # serving plane: engine + KV cache + request queue panels
     import lzy_tpu.serving.engine  # noqa: F401
     import lzy_tpu.serving.kv_cache  # noqa: F401
+    # tiered KV cache: demotions/promotions by (from_tier, to_tier),
+    # host/storage occupancy, cross-replica imports + fallbacks
+    # (lzy_kvtier_*; the index half lives in gateway/kv_index)
+    import lzy_tpu.serving.kv_tier  # noqa: F401
     import lzy_tpu.serving.scheduler  # noqa: F401
     # speculative decoding: proposed/accepted, acceptance rate, tok/step,
     # draft truncations
@@ -49,6 +53,7 @@ def registry_metrics():
     import lzy_tpu.serving.streams  # noqa: F401
     # gateway: routing hit rate, failovers, autoscale, per-replica load
     import lzy_tpu.gateway.fleet  # noqa: F401
+    import lzy_tpu.gateway.kv_index  # noqa: F401
     import lzy_tpu.gateway.router  # noqa: F401
     import lzy_tpu.gateway.service  # noqa: F401
     # disagg: transfer bytes/latency, cache-skips, re-prefill fallbacks
