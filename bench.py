@@ -492,6 +492,10 @@ def run() -> None:
     if extra:
         detail.update(extra)
         emit()
+    extra = capacity_curve_measurement()
+    if extra:
+        detail.update(extra)
+        emit()
     if platform in ("tpu", "axon"):
         # each extra pass builds a whole second model+optimizer: evict the
         # previous one (buffers AND compiled executables) first or OOM
@@ -1591,6 +1595,63 @@ def stream_measurement(jax, cfg, params, *, slots: int, prompt_len: int,
             svc.close()
     except Exception as e:  # noqa: BLE001 — diagnostics only
         _log(f"stream skipped: {type(e).__name__}: {e}")
+        return {}
+
+
+def capacity_curve_measurement():
+    """Best-effort operating-curve point (docs/serving.md "Capacity &
+    load testing"): the lzy_tpu/load virtual-clock harness replays a
+    synthetic multi-tenant trace against fleet-in-threads SimEngine
+    gateways and reports TTFT/inter-token p99 vs replica count plus a
+    shed-rate frontier — the capacity-model numbers ROADMAP item 3 asks
+    bench rounds to publish. Pure CPU + virtual time (no accelerator,
+    no model), so it rides the CPU-fallback path unchanged; the replay
+    speedup factor (virtual seconds per wall second) is the honesty
+    metric that these are simulated hours, not wall hours."""
+    try:
+        from lzy_tpu.load import (
+            FleetConfig, SimProfile, TraceConfig, capacity_artifact)
+
+        _log("capacity: replaying synthetic traces on the virtual "
+             "clock (replicas 1/2/4 + overload frontier)...")
+        trace = TraceConfig(seed=0, duration_s=480.0, users=24,
+                            tenants=8)
+        fleet = FleetConfig(replicas=2, profile=SimProfile(
+            slots=8, max_queue=48, kv_blocks=384))
+        frontier_fleet = FleetConfig(replicas=1, retry_limit=3,
+                                     profile=SimProfile(
+                                         slots=4, max_queue=16,
+                                         kv_blocks=160))
+        art = capacity_artifact(trace, fleet, replica_counts=[1, 2, 4],
+                                load_factors=[1.0, 5.0],
+                                frontier_fleet_cfg=frontier_fleet)
+        slo = {str(r["replicas"]): {
+            "ttft_p50_ms": r["ttft_p50_ms"],
+            "ttft_p99_ms": r["ttft_p99_ms"],
+            "itl_p99_ms": r["itl_p99_ms"],
+            "requests": r["requests"],
+        } for r in art["slo_curve"]}
+        frontier = {str(r["load_factor"]): {
+            "shed_rate": r["shed_rate"],
+            "ttft_p99_ms": r["ttft_p99_ms"],
+            "peak_queue_depth": r["peak_queue_depth"],
+        } for r in art["shed_frontier"]}
+        rep = art["replay"]
+        _log(f"capacity: {rep['virtual_s']:.0f} virtual s in "
+             f"{rep['wall_s']:.1f}s wall ({rep['speedup_x']:.0f}x); "
+             f"ttft p99 by replicas: "
+             + ", ".join(f"{k}: {v['ttft_p99_ms']:.0f}ms"
+                         for k, v in sorted(slo.items())))
+        return {
+            "capacity_slo_curve": slo,
+            "capacity_shed_frontier": frontier,
+            "capacity_virtual_s": rep["virtual_s"],
+            "capacity_replay_speedup_x": rep["speedup_x"],
+            "capacity_virtual_hours_per_wall_s":
+                rep["virtual_hours_per_wall_s"],
+        }
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        _log(f"capacity skipped: {type(e).__name__}: {e}")
         return {}
 
 

@@ -33,7 +33,6 @@ replica).
 from __future__ import annotations
 
 import threading
-import time
 from typing import List, Optional
 
 from lzy_tpu.channels.kv_transfer import InMemoryKVTransport
@@ -171,7 +170,23 @@ class DisaggGatewayService(GatewayService):
         A client ``liveness`` already reports gone skips the staging
         entirely (a prefill + transfer for a request the decode engine
         will reap on arrival is pure waste) — the submit still goes
-        through, and the engine's reaper does the terminal accounting."""
+        through, and the engine's reaper does the terminal accounting.
+
+        With the fleet-global KV index on, the prefill pool keeps
+        PRIORITY but is no longer the only source: when prefill-pool
+        staging lands nothing (pool empty/refusing/mid-fault → the
+        re-prefill fallback) and the router does not already expect the
+        prefix resident on the routed replica, the global index is
+        consulted for a DECODE-POOL sibling holding a deeper chain than
+        the replica's own radix+tier coverage — the base gateway's
+        cross-replica import path (``_stage_kv_import``), which used to
+        be unreachable behind the disagg override, so a warm sibling's
+        blocks now replace what was previously a guaranteed local
+        re-prefill."""
+        if self.kv_index is not None:
+            # same per-attempt contract (and the same point — before the
+            # admission probe) as the base gateway's _pre_submit
+            self._reset_kv_import_meta()
         engine = replica.engine
         if getattr(engine, "closed", False) or \
                 engine.queue.depth() >= engine.queue.max_depth:
@@ -180,6 +195,14 @@ class DisaggGatewayService(GatewayService):
             return True
         self._stage_kv(replica, prompt, deadline_s=deadline_s,
                        tenant=tenant)
+        if self.kv_index is not None:
+            meta = self._meta()
+            if not meta.get("prefilled_by") and not meta.get("skipped"):
+                # nothing staged from the prefill pool AND no resident
+                # expectation: a decode-pool sibling deeper than
+                # radix+tier coverage is the next-best source
+                self._stage_kv_import(replica, prompt,
+                                      deadline_s=deadline_s)
         return True
 
     # -- KV staging ----------------------------------------------------------
@@ -209,7 +232,7 @@ class DisaggGatewayService(GatewayService):
             self._count("skipped_cache")
             _SKIPPED_CACHE.inc()
             return
-        t0 = time.monotonic()
+        t0 = self._clock.now()
         try:
             CHAOS.hit("disagg.stage")
             staged = self._prefill_remote(prompt, deadline_s=deadline_s,
@@ -223,7 +246,7 @@ class DisaggGatewayService(GatewayService):
             return
         prefilled_by, export = staged
         replica.engine.queue_kv_import(export)
-        dt = time.monotonic() - t0
+        dt = self._clock.now() - t0
         with self._xfer_lock:
             self._transferred += 1
             self._xfer_bytes += export.nbytes
@@ -253,12 +276,12 @@ class DisaggGatewayService(GatewayService):
         # candidate: one candidate's near-full wait must come off the
         # next one's, or N candidates could stage N× past the deadline
         deadline_at = (None if deadline_s is None
-                       else time.monotonic() + deadline_s)
+                       else self._clock.now() + deadline_s)
         loads = dict(self.prefill_fleet.loads())
         while loads:
             left = None
             if deadline_at is not None:
-                left = deadline_at - time.monotonic()
+                left = deadline_at - self._clock.now()
                 if left <= 0:
                     return None
             wait_s = (self._prefill_timeout_s if left is None

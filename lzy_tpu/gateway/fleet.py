@@ -28,6 +28,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from lzy_tpu.gateway.health import HealthPolicy, HealthTracker
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.utils.log import get_logger
 from lzy_tpu.utils.metrics import REGISTRY
 
@@ -84,13 +85,16 @@ class ReplicaFleet:
         health: Optional[HealthTracker] = None,
         start_engines: bool = True,
         replica_prefix: str = "replica",
+        clock=None,
     ):
         self._factory = engine_factory
         self._allocator = allocator
         self._pool_label = pool_label
         self._session_owner = session_owner
         self._lease_timeout_s = lease_timeout_s
-        self.health = health or HealthTracker(HealthPolicy())
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
+        self.health = health or HealthTracker(HealthPolicy(),
+                                              clock=self._clock)
         self._start_engines = start_engines
         # distinct prefixes keep ids unambiguous when several fleets share
         # a surface (the disagg gateway runs a "prefill" and a "decode"
@@ -183,7 +187,7 @@ class ReplicaFleet:
             if replica is None or replica.state != READY:
                 return
             replica.state = DRAINING
-            replica.drain_since = time.time()
+            replica.drain_since = self._clock.time()
         _LOG.info("fleet: draining %s", replica_id)
         self._update_gauges()
 

@@ -25,11 +25,11 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-import time
 from collections import deque
 from typing import Dict, Optional
 
 from lzy_tpu.chaos.faults import CHAOS, DELAY, SLOW
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.utils.metrics import REGISTRY
 
 _TRANSITIONS = REGISTRY.counter(
@@ -82,7 +82,9 @@ class CircuitBreaker:
     straggler; attributing outcomes would need probe tokens threaded
     through every completion path."""
 
-    def __init__(self, policy: Optional[BreakerPolicy] = None):
+    def __init__(self, policy: Optional[BreakerPolicy] = None,
+                 clock=None):
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         self.policy = policy or BreakerPolicy()
         self._failures: Dict[str, deque] = {}
         self._state: Dict[str, str] = {}
@@ -111,7 +113,7 @@ class CircuitBreaker:
 
     def record_failure(self, replica_id: str,
                        now: Optional[float] = None) -> str:
-        now = now if now is not None else time.monotonic()
+        now = now if now is not None else self._clock.now()
         with self._lock:
             state = self._state.get(replica_id, CLOSED)
             if state == HALF_OPEN:
@@ -148,7 +150,7 @@ class CircuitBreaker:
                 self._set_state(replica_id, CLOSED)
 
     def state(self, replica_id: str, now: Optional[float] = None) -> str:
-        now = now if now is not None else time.monotonic()
+        now = now if now is not None else self._clock.now()
         with self._lock:
             state = self._state.get(replica_id, CLOSED)
             if state == OPEN and \
@@ -166,7 +168,7 @@ class CircuitBreaker:
         burn the claim and starve a recovered replica of traffic for
         another ``open_s``; the claim is taken by :meth:`try_route` at
         actual dispatch."""
-        now = now if now is not None else time.monotonic()
+        now = now if now is not None else self._clock.now()
         st = self.state(replica_id, now)
         if st != HALF_OPEN:
             return st != OPEN
@@ -182,7 +184,7 @@ class CircuitBreaker:
         completion reports back; a claim older than ``open_s`` is
         presumed lost (routed but never completed) and the next caller
         re-probes."""
-        now = now if now is not None else time.monotonic()
+        now = now if now is not None else self._clock.now()
         st = self.state(replica_id, now)
         if st != HALF_OPEN:
             return st != OPEN
@@ -210,7 +212,7 @@ class CircuitBreaker:
         """Seconds until this replica's breaker half-opens (None when
         already routable) — the shedding hint when the WHOLE fleet is
         behind open breakers."""
-        now = now if now is not None else time.monotonic()
+        now = now if now is not None else self._clock.now()
         with self._lock:
             if self._state.get(replica_id) != OPEN:
                 return None
@@ -231,9 +233,10 @@ class HealthTracker:
     for retirement and :meth:`routable` (the breaker) for routing."""
 
     def __init__(self, policy: Optional[HealthPolicy] = None,
-                 breaker: Optional[BreakerPolicy] = None):
+                 breaker: Optional[BreakerPolicy] = None, clock=None):
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         self.policy = policy or HealthPolicy()
-        self.breaker = CircuitBreaker(breaker)
+        self.breaker = CircuitBreaker(breaker, clock=self._clock)
         self._failures: Dict[str, int] = {}
         self._lock = threading.Lock()
 
@@ -284,7 +287,7 @@ class HealthTracker:
         if streak >= self.policy.max_consecutive_failures:
             return f"{streak} consecutive request failures"
         if heartbeat_ts is not None:
-            now = now if now is not None else time.time()
+            now = now if now is not None else self._clock.time()
             if now - heartbeat_ts > self.policy.heartbeat_timeout_s:
                 return (f"heartbeat stale by "
                         f"{now - heartbeat_ts:.0f}s")

@@ -25,10 +25,10 @@ single engine used to sit. What it adds over one engine:
 from __future__ import annotations
 
 import threading
-import time
 from typing import List, Optional
 
 from lzy_tpu.chaos.faults import CHAOS
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.gateway.autoscale import DOWN, UP, Autoscaler
 from lzy_tpu.gateway.fleet import ReplicaFleet
 from lzy_tpu.gateway.router import PrefixAffinityRouter
@@ -82,7 +82,13 @@ class GatewayService:
         slo=None,
         kv_index=None,
         kv_transport=None,
+        clock=None,
     ):
+        # injectable time (utils/clock): request deadlines, failover
+        # budgets, tick cadence and the drain loop all run on it — the
+        # load plane drives a whole fleet on a virtual clock; production
+        # (clock=None) is bit-identical to the old time.* calls
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         self.fleet = fleet
         self.router = router if router is not None else PrefixAffinityRouter(
             page_size)
@@ -125,7 +131,7 @@ class GatewayService:
         self._scale_ups = 0
         self._scale_downs = 0
         self._draining = False
-        self._stop = threading.Event()
+        self._stop = self._clock.event()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         #: chaos hook (``chaos.invariants.FenceAuditor``): when set, every
@@ -136,7 +142,7 @@ class GatewayService:
         #: the fence the failover path maintains IS the wire position
         from lzy_tpu.serving.streams import StreamSessionManager
 
-        self.streams = StreamSessionManager(self)
+        self.streams = StreamSessionManager(self, clock=self._clock)
 
     # -- request surface -----------------------------------------------------
 
@@ -309,7 +315,7 @@ class GatewayService:
                   stream=None, liveness=None) -> dict:
         from lzy_tpu.rpc.core import Unavailable
 
-        t0 = time.monotonic()
+        t0 = self._clock.now()
         wall_deadline = t0 + timeout_s
         fence = (self.fence_auditor.session(prompt)
                  if self.fence_auditor is not None else None)
@@ -381,7 +387,7 @@ class GatewayService:
 
                 attach_request(stream, req, len(emitted))
             if not req.wait(timeout=max(0.0,
-                                        wall_deadline - time.monotonic())):
+                                        wall_deadline - self._clock.now())):
                 req.cancel()
                 # no outcome will ever be recorded for this dispatch:
                 # a half-open probe claim must not outlive it
@@ -499,8 +505,7 @@ class GatewayService:
         except Exception:  # noqa: BLE001 — treat a broken probe as alive
             return False
 
-    @staticmethod
-    def _remaining_deadline(t0: float,
+    def _remaining_deadline(self, t0: float,
                             deadline_s: Optional[float]) -> Optional[float]:
         """The client deadline is absolute from first submission
         (anchored at ``t0``); a failover resubmits with whatever is left
@@ -509,7 +514,7 @@ class GatewayService:
         submitting an already-dead request."""
         if deadline_s is None:
             return None
-        return deadline_s - (time.monotonic() - t0)
+        return deadline_s - (self._clock.now() - t0)
 
     def _submit_routed(self, prompt: List[int], max_new_tokens: int, *,
                        t0: float, deadline_s: Optional[float],
@@ -626,14 +631,7 @@ class GatewayService:
         remaining deadline, and skipped for a client already gone."""
         if self.kv_index is None:
             return True
-        # reset the PER-ATTEMPT staging meta up front: an attempt that
-        # skips staging (client gone, expired deadline, admission-probe
-        # drop) must not inherit — and report — the previous attempt's
-        # kv_import_staged_from/tier/ms
-        meta = self._kvtier_meta()
-        meta.pop("kv_import_staged_from", None)
-        meta.pop("kv_import_tier", None)
-        meta.pop("kv_import_ms", None)
+        self._reset_kv_import_meta()
         engine = replica.engine
         if getattr(engine, "closed", False) or \
                 engine.queue.depth() >= engine.queue.max_depth:
@@ -641,6 +639,17 @@ class GatewayService:
         if not (liveness is not None and self._client_gone(liveness)):
             self._stage_kv_import(replica, prompt, deadline_s=deadline_s)
         return True
+
+    def _reset_kv_import_meta(self) -> None:
+        """Reset the PER-ATTEMPT staging meta up front (both gateways
+        call this at the top of their ``_pre_submit``, BEFORE the
+        admission probe): an attempt that skips staging — client gone,
+        expired deadline, admission-probe drop — must not inherit, and
+        report, the previous attempt's kv_import_staged_from/tier/ms."""
+        meta = self._kvtier_meta()
+        meta.pop("kv_import_staged_from", None)
+        meta.pop("kv_import_tier", None)
+        meta.pop("kv_import_ms", None)
 
     def _stage_kv_import(self, replica, prompt: List[int],
                          deadline_s: Optional[float] = None) -> None:
@@ -686,7 +695,7 @@ class GatewayService:
             prefix, exclude=(replica.id,), min_depth_tokens=local)
         if holder is None:
             return
-        t0 = time.monotonic()
+        t0 = self._clock.now()
         try:
             CHAOS.hit("kvtier.import")
             src = self.fleet.get(holder.replica_id)
@@ -728,7 +737,7 @@ class GatewayService:
         from lzy_tpu.gateway.kv_index import (
             IMPORT_BYTES, IMPORT_SECONDS, IMPORTS)
 
-        dt = time.monotonic() - t0
+        dt = self._clock.now() - t0
         with self._kvtier_lock:
             self._kvtier_imports += 1
             self._kvtier_import_bytes += fetched.nbytes
@@ -796,7 +805,7 @@ class GatewayService:
         """One health + autoscale round (the background loop calls this
         every ``tick_period_s``; tests call it with an injected clock).
         Returns the applied scale direction, if any."""
-        now = now if now is not None else time.time()
+        now = now if now is not None else self._clock.time()
         for rid in self.fleet.check_health(now=now):
             self.router.forget(rid)
             if self.kv_index is not None:
@@ -887,7 +896,7 @@ class GatewayService:
         self._stop.clear()
 
         def loop():
-            while not self._stop.wait(self._tick_period_s):
+            while not self._clock.wait(self._stop, self._tick_period_s):
                 try:
                     self.tick()
                 except Exception:  # noqa: BLE001 — the tick must not die
@@ -906,12 +915,12 @@ class GatewayService:
         (False: close() failed the stragglers with the usual shutdown
         error)."""
         self._draining = True
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        deadline = self._clock.now() + timeout_s
+        while self._clock.now() < deadline:
             with self._lock:
                 if self._inflight == 0:
                     break
-            time.sleep(0.02)
+            self._clock.sleep(0.02)
         with self._lock:
             drained = self._inflight == 0
         if not drained:

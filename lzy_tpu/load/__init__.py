@@ -1,0 +1,29 @@
+"""Million-user load plane: trace generation + virtual-clock fleet
+simulation + published operating curves.
+
+Quickstart (also ``python -m lzy_tpu.load``):
+
+>>> from lzy_tpu.load import FleetConfig, TraceConfig, replay
+>>> report = replay(TraceConfig(seed=7, duration_s=600, users=32),
+...                 FleetConfig(replicas=2))
+>>> report.ttft_p99_ms, report.speedup_x  # doctest: +SKIP
+
+See docs/serving.md "Capacity & load testing".
+"""
+
+from lzy_tpu.load.driver import (
+    Collector, FleetConfig, LoadDriver, LoadReport, autoscaler_gain_sweep,
+    build_fleet, capacity_artifact, default_tenant_policies, replay,
+    shed_frontier, sweep_replicas, wfq_weight_sweep)
+from lzy_tpu.load.sim import SimEngine, SimProfile
+from lzy_tpu.load.trace import (
+    TraceConfig, Turn, generate_trace, reply_tokens, trace_bytes,
+    trace_doc)
+
+__all__ = [
+    "Collector", "FleetConfig", "LoadDriver", "LoadReport", "SimEngine",
+    "SimProfile", "TraceConfig", "Turn", "autoscaler_gain_sweep",
+    "build_fleet", "capacity_artifact", "default_tenant_policies",
+    "generate_trace", "replay", "reply_tokens", "shed_frontier",
+    "sweep_replicas", "trace_bytes", "trace_doc", "wfq_weight_sweep",
+]

@@ -1,0 +1,94 @@
+"""CLI: replay a synthetic trace and print/publish operating curves.
+
+Examples::
+
+    # quick: one replay, summary line
+    python -m lzy_tpu.load --duration 600 --users 32 --replicas 2
+
+    # the published artifact: SLO curve + shed frontier
+    python -m lzy_tpu.load --mode curve --replica-counts 1,2,4 \
+        --load-factors 1,2,4 --out capacity.json
+
+    # policy tuning sweeps (slow)
+    python -m lzy_tpu.load --mode full --out capacity_full.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from lzy_tpu.load.driver import (
+    FleetConfig, autoscaler_gain_sweep, capacity_artifact, replay,
+    wfq_weight_sweep)
+from lzy_tpu.load.trace import TraceConfig
+
+
+def _ints(arg: str):
+    return [int(x) for x in arg.split(",") if x]
+
+
+def _floats(arg: str):
+    return [float(x) for x in arg.split(",") if x]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m lzy_tpu.load",
+        description="trace-driven virtual-clock fleet capacity harness")
+    ap.add_argument("--mode", choices=("replay", "curve", "full"),
+                    default="replay")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--duration", type=float, default=1800.0,
+                    help="simulated seconds per replay")
+    ap.add_argument("--users", type=int, default=64)
+    ap.add_argument("--tenants", type=int, default=8)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--replica-counts", type=_ints, default=[1, 2, 4])
+    ap.add_argument("--load-factors", type=_floats, default=[1.0, 2.0, 4.0])
+    ap.add_argument("--out", type=str, default=None,
+                    help="write the JSON artifact here")
+    args = ap.parse_args(argv)
+
+    trace_cfg = TraceConfig(seed=args.seed, duration_s=args.duration,
+                            users=args.users, tenants=args.tenants)
+    fleet_cfg = FleetConfig(replicas=args.replicas)
+
+    if args.mode == "replay":
+        report = replay(trace_cfg, fleet_cfg)
+        doc = report.doc()
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        print(f"[load] {report.requests} requests over "
+              f"{report.virtual_s / 3600:.2f} simulated hours in "
+              f"{report.wall_s:.1f}s wall ({report.speedup_x:.0f}x); "
+              f"ttft p99 {report.ttft_p99_ms:.0f} ms, shed "
+              f"{report.shed}/{report.requests}", file=sys.stderr)
+        out = doc
+    else:
+        out = capacity_artifact(trace_cfg, fleet_cfg,
+                                replica_counts=args.replica_counts,
+                                load_factors=args.load_factors)
+        if args.mode == "full":
+            out["wfq_weight_sweep"] = wfq_weight_sweep(
+                trace_cfg, fleet_cfg, [0.5, 2.0, 8.0])
+            out["autoscaler_gain_sweep"] = autoscaler_gain_sweep(
+                trace_cfg, fleet_cfg, [
+                    dict(min_replicas=1, max_replicas=8,
+                         up_sustain_s=2.0, cooldown_s=5.0),
+                    dict(min_replicas=1, max_replicas=8,
+                         up_sustain_s=10.0, cooldown_s=30.0),
+                    dict(min_replicas=1, max_replicas=8,
+                         up_sustain_s=30.0, cooldown_s=60.0),
+                ])
+        print(json.dumps(out, indent=2, sort_keys=True))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"[load] wrote {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

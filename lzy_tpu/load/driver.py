@@ -1,0 +1,632 @@
+"""Trace replay against a fleet-in-threads gateway on a virtual clock.
+
+``LoadDriver`` is the capacity-model harness ROADMAP item 3 asks for:
+it builds a real :class:`~lzy_tpu.gateway.service.GatewayService` over a
+:class:`~lzy_tpu.gateway.fleet.ReplicaFleet` of ``SimEngine`` replicas,
+spawns one closed-loop client thread per trace user, and drives the
+whole thing from a :class:`~lzy_tpu.utils.clock.VirtualClock` — hours of
+multi-tenant traffic replay in seconds of CPU, deterministically per
+seed, through the production routing / SLO / WFQ / breaker / autoscale
+code.
+
+Clients are WELL-BEHAVED by default: a shed (``retry_after_s`` on a
+``QuotaExceeded`` / ``Unavailable``) is honored with exactly that
+backoff before the retry, so shedding actually sheds — offered load
+drops when the fleet pushes back.  The shed-honoring test drives a
+``hammer`` client through the same harness to prove the opposite
+behavior is survived (bounded queue memory, breaker pushback), not
+rewarded.
+
+Outputs are capacity-model artifacts:
+
+- :func:`sweep_replicas` — TTFT / inter-token p50/p99 SLO curves vs
+  replica count (the Gemma-serving-comparison deliverable);
+- :func:`shed_frontier` — shed rate + p99 vs offered-load multiplier;
+- :func:`wfq_weight_sweep` / :func:`autoscaler_gain_sweep` — policy
+  tuning rows (LZY_SLOW tier + ``python -m lzy_tpu.load --mode full``);
+- ``lzy_load_*`` metrics in the process registry (dashboard panels) and
+  one JSON artifact (``capacity_artifact``) for BENCH probes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time as _time
+from typing import Dict, List, Optional
+
+from lzy_tpu.gateway.autoscale import Autoscaler
+from lzy_tpu.gateway.fleet import DRAINING, ReplicaFleet
+from lzy_tpu.gateway.router import PrefixAffinityRouter
+from lzy_tpu.gateway.service import GatewayService
+from lzy_tpu.load.sim import SimEngine, SimProfile
+from lzy_tpu.load.trace import (
+    TraceConfig, Turn, generate_trace, system_prompt)
+from lzy_tpu.serving.scheduler import AdmissionError, PromptTooLong
+from lzy_tpu.serving.tenancy import SloLimiter, TenantPolicy, TenantTable
+from lzy_tpu.utils.clock import VirtualClock
+from lzy_tpu.utils.log import get_logger
+from lzy_tpu.utils.metrics import REGISTRY
+
+_LOG = get_logger(__name__)
+
+LOAD_REQUESTS = REGISTRY.counter(
+    "lzy_load_requests_total",
+    "load-harness client requests by terminal outcome "
+    "(ok/shed/timeout/error/cancelled)")
+LOAD_TOKENS = REGISTRY.counter(
+    "lzy_load_tokens_total", "tokens generated under the load harness")
+LOAD_RETRIES = REGISTRY.counter(
+    "lzy_load_retries_total",
+    "client retries after a shed, honoring the retry_after_s hint")
+LOAD_TTFT = REGISTRY.histogram(
+    "lzy_load_ttft_seconds",
+    "virtual-time submit-to-first-token latency under trace replay",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0))
+LOAD_ITL = REGISTRY.histogram(
+    "lzy_load_inter_token_seconds",
+    "virtual-time gap between consecutive tokens of one request",
+    buckets=(0.01, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0))
+LOAD_VIRTUAL_SECONDS = REGISTRY.counter(
+    "lzy_load_virtual_seconds_total",
+    "simulated seconds replayed by the load harness")
+LOAD_SPEEDUP = REGISTRY.gauge(
+    "lzy_load_replay_speedup",
+    "virtual seconds simulated per wall second of the last replay")
+LOAD_SHED_RATE = REGISTRY.gauge(
+    "lzy_load_shed_rate",
+    "gave-up requests / offered requests in the last replay")
+LOAD_PEAK_QUEUE = REGISTRY.gauge(
+    "lzy_load_peak_queue_depth",
+    "peak fleet-aggregate admission queue depth seen in the last replay")
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """The simulated deployment a trace replays against."""
+
+    replicas: int = 2
+    profile: SimProfile = dataclasses.field(default_factory=SimProfile)
+    max_waiters: int = 4096        # gateway thread cap; clients are the cap
+    tick_period_s: float = 1.0
+    request_timeout_s: float = 300.0
+    retry_limit: int = 8
+    autoscaler: Optional[dict] = None     # Autoscaler kwargs, None = fixed
+    #: per-tenant policy fields ({tenant: {...}}); default carries the
+    #: heavy-tail tenants with finite rate limits so shedding is real
+    tenant_policies: Optional[dict] = None
+    default_policy: Optional[dict] = None
+
+
+def default_tenant_policies(tenants: int = 8) -> dict:
+    """Tiered policy table for the synthetic tenant mix: the two
+    heaviest tenants are interactive (big share, real rate limits), the
+    middle standard, the tail batch."""
+    out = {}
+    for i in range(tenants):
+        tier = 0 if i < 2 else (1 if i < 5 else 2)
+        out[f"t{i}"] = {
+            "priority": tier,
+            "requests_per_s": [40.0, 20.0, 10.0][tier],
+            "burst_s": 4.0,
+            "max_queued": 32,
+        }
+    return out
+
+
+def build_fleet(cfg: FleetConfig, clock: VirtualClock,
+                collector: "Collector"):
+    """A fleet-in-threads gateway over SimEngine replicas, everything on
+    the injected virtual clock."""
+    table = TenantTable(default=TenantPolicy(
+        **(cfg.default_policy or {})))
+    policies = (cfg.tenant_policies
+                if cfg.tenant_policies is not None
+                else default_tenant_policies())
+    for tenant, fields in policies.items():
+        table.set_policy(TenantPolicy(tenant=tenant, **fields))
+
+    def factory():
+        return SimEngine(cfg.profile, clock=clock, tenants=table,
+                         collector=collector)
+
+    fleet = ReplicaFleet(factory, clock=clock)
+    scaler = (Autoscaler(**cfg.autoscaler)
+              if cfg.autoscaler is not None else None)
+    gw = GatewayService(
+        fleet,
+        router=PrefixAffinityRouter(cfg.profile.page_size),
+        autoscaler=scaler,
+        model_name="sim",
+        # enforce_backoff: the harness's own finding — an advisory hint
+        # loses to a hammering client; enforcement makes honoring it the
+        # winning strategy (tests/test_load.py TestShedHonoring)
+        slo=SloLimiter(table, clock=clock.now, enforce_backoff=True),
+        max_waiters=cfg.max_waiters,
+        tick_period_s=cfg.tick_period_s,
+        clock=clock,
+    )
+    for _ in range(cfg.replicas):
+        fleet.add_replica()
+    return gw, fleet
+
+
+class Collector:
+    """Replay-local measurement sink (never the global REGISTRY — two
+    replays in one process must not contaminate each other's
+    percentiles).  Appends are serialized by the virtual clock."""
+
+    def __init__(self):
+        self.ttft_s: List[float] = []
+        self.gaps_s: List[float] = []
+        self.records: List[dict] = []
+        self.tokens = 0
+        self.tokens_by_tenant: Dict[str, int] = {}
+        self.peak_queue_depth = 0
+        self.retries = 0
+
+    def note_gap(self, gap: float) -> None:
+        self.gaps_s.append(gap)
+        LOAD_ITL.observe(gap)
+
+    def note_token(self, tenant: str) -> None:
+        self.tokens += 1
+        self.tokens_by_tenant[tenant] = \
+            self.tokens_by_tenant.get(tenant, 0) + 1
+        LOAD_TOKENS.inc()
+
+
+def percentile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    xs = sorted(values)
+    idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+    return xs[idx]
+
+
+class LoadDriver:
+    """Replays one trace against one gateway (see module docstring).
+
+    ``hammer_tenant``: requests for this tenant ignore every
+    ``retry_after_s`` hint and retry after ``hammer_interval_s`` —
+    the abuse case the shed-honoring test drives.
+    """
+
+    def __init__(self, gateway: GatewayService, fleet: ReplicaFleet,
+                 clock: VirtualClock, trace_cfg: TraceConfig, *,
+                 fleet_cfg: Optional[FleetConfig] = None,
+                 collector: Optional[Collector] = None,
+                 hammer_tenant: Optional[str] = None,
+                 hammer_interval_s: float = 0.02,
+                 max_virtual_s: Optional[float] = None):
+        self.gateway = gateway
+        self.fleet = fleet
+        self.clock = clock
+        self.trace_cfg = trace_cfg
+        self.fleet_cfg = fleet_cfg or FleetConfig()
+        self.collector = collector if collector is not None else Collector()
+        self.hammer_tenant = hammer_tenant
+        self.hammer_interval_s = hammer_interval_s
+        self.max_virtual_s = (max_virtual_s if max_virtual_s is not None
+                              else trace_cfg.duration_s * 6 + 600.0)
+        self._busy_until: Dict[str, float] = {}
+        #: guard tripped: clients stop issuing turns/retries and drain
+        self._stopping = False
+
+    # -- client side ---------------------------------------------------------
+
+    def _call(self, turn: Turn, prompt: List[int]) -> dict:
+        """One closed-loop request with shed-honoring backoff; returns a
+        record dict (always — failures become records, not raises)."""
+        cfg = self.fleet_cfg
+        hammer = (self.hammer_tenant is not None
+                  and turn.tenant == self.hammer_tenant)
+        t0 = self.clock.now()
+        retries = 0
+        while True:
+            try:
+                reply = self.gateway.generate(
+                    list(prompt), max_new_tokens=turn.max_new_tokens,
+                    timeout_s=cfg.request_timeout_s,
+                    tenant=turn.tenant, session=turn.session)
+            except TimeoutError:
+                LOAD_REQUESTS.inc(status="timeout")
+                return {"status": "timeout", "tenant": turn.tenant,
+                        "retries": retries, "tokens": []}
+            except PromptTooLong as e:
+                # permanent, request-scoped: retrying is pointless
+                LOAD_REQUESTS.inc(status="error")
+                return {"status": "error", "tenant": turn.tenant,
+                        "retries": retries, "tokens": [],
+                        "error": f"{type(e).__name__}: {e}"}
+            except Exception as e:  # noqa: BLE001 — shed/quota/unavailable
+                retry_after = getattr(e, "retry_after_s", None)
+                retryable = (isinstance(e, AdmissionError)
+                             or hasattr(e, "retry_after_s"))
+                if not retryable:
+                    LOAD_REQUESTS.inc(status="error")
+                    return {"status": "error", "tenant": turn.tenant,
+                            "retries": retries, "tokens": [],
+                            "error": f"{type(e).__name__}: {e}"}
+                retries += 1
+                self.collector.retries += 1
+                LOAD_RETRIES.inc()
+                if retries > cfg.retry_limit or self._stopping:
+                    LOAD_REQUESTS.inc(status="shed")
+                    return {"status": "shed", "tenant": turn.tenant,
+                            "retries": retries, "tokens": []}
+                if hammer:
+                    self.clock.sleep(self.hammer_interval_s)
+                else:
+                    # the robustness contract under test: honor the
+                    # plane's own backoff hint, so shed actually sheds
+                    self.clock.sleep(retry_after if retry_after
+                                     else 1.0)
+                continue
+            status = reply.get("status", "ok")
+            rec = {"status": status, "tenant": turn.tenant,
+                   "retries": retries, "tokens": reply["tokens"],
+                   "failovers": reply.get("failovers", 0),
+                   "replica": reply.get("replica")}
+            if status == "ok" and reply.get("ttft_ms") is not None:
+                ttft = reply["ttft_ms"] / 1000.0
+                rec["ttft_s"] = ttft
+                self.collector.ttft_s.append(ttft)
+                LOAD_TTFT.observe(ttft)
+            LOAD_REQUESTS.inc(status=status)
+            return rec
+
+    def _client(self, turns: List[Turn]) -> None:
+        with self.clock.participant():
+            sys_prompt: Dict[str, List[int]] = {}
+            history: Dict[str, List[int]] = {}
+            for turn in turns:
+                self.clock.sleep(turn.think_s)
+                if self._stopping or \
+                        self.clock.now() >= self.max_virtual_s:
+                    break
+                header = sys_prompt.get(turn.tenant)
+                if header is None:
+                    header = sys_prompt[turn.tenant] = system_prompt(
+                        self.trace_cfg.seed, turn.tenant,
+                        self.trace_cfg.system_prompt_tokens,
+                        self.trace_cfg.vocab)
+                base = (list(header) if turn.fresh
+                        else history.get(turn.session, list(header)))
+                prompt = base + list(turn.new_tokens)
+                if len(prompt) + turn.max_new_tokens >= \
+                        self.fleet_cfg.profile.max_seq_len:
+                    # conversation outgrew the window: restart it (what
+                    # a real chat product does — truncate/summarize)
+                    prompt = list(header) + list(turn.new_tokens)
+                rec = self._call(turn, prompt)
+                self.collector.records.append(rec)
+                if rec["status"] == "ok":
+                    history[turn.session] = prompt + rec["tokens"]
+
+    # -- driver side ---------------------------------------------------------
+
+    def _engines(self):
+        """Live (replica_id, engine) pairs — keyed by the fleet's OWN
+        unambiguous ids, never ``id(engine)`` (a scaled-down engine's
+        CPython id can be reused by a scale-up's fresh object, which
+        would hand the new replica a stale busy_until)."""
+        out = []
+        for replica in (self.fleet.replicas()
+                        + self.fleet.replicas(state=DRAINING)):
+            out.append((replica.id, replica.engine))
+        return out
+
+    def run(self) -> "LoadReport":
+        clock, cfg = self.clock, self.fleet_cfg
+        wall0 = _time.perf_counter()
+        users = generate_trace(self.trace_cfg)
+        threads = []
+        for turns in users:
+            t = threading.Thread(target=self._client, args=(turns,),
+                                 daemon=True)
+            t.start()
+            # serialize startup: registration order IS the deterministic
+            # tie-break for simultaneous wake-ups
+            while clock.participants < len(threads) + 1:
+                _time.sleep(0.0002)
+            clock.settle()
+            threads.append(t)
+        next_tick = cfg.tick_period_s
+        stalled = 0
+        while True:
+            clock.settle()
+            now = clock.now()
+            if now >= self.max_virtual_s and not self._stopping:
+                # virtual-time guard: clients stop issuing and the loop
+                # keeps draining until every participant parked out —
+                # breaking here instead would strand parked threads and
+                # turn the virtual stall into a real-time join stall
+                _LOG.warning("load: virtual-time guard hit at %.0fs; "
+                             "draining clients", now)
+                self._stopping = True
+            engines = self._engines()
+            work = [(rid, e) for rid, e in engines if e.has_work()]
+            if clock.participants == 0 and not work:
+                break
+            # next event: a replica's next round, a parked client, or
+            # the gateway tick
+            candidates = [next_tick]
+            if stalled < 3:
+                for rid, e in work:
+                    candidates.append(max(now, self._busy_until.get(
+                        rid, 0.0)))
+            deadline = clock.next_deadline()
+            if deadline is not None:
+                candidates.append(deadline)
+            t_next = min(candidates)
+            t_before = now
+            if t_next > now:
+                clock.advance_to(t_next)
+                now = clock.now()
+            if now + 1e-9 >= next_tick:
+                self.gateway.tick(now=clock.time())
+                live = self._engines()
+                agg_depth = sum(e.stats().queue_depth for _, e in live)
+                if agg_depth > self.collector.peak_queue_depth:
+                    self.collector.peak_queue_depth = agg_depth
+                live_ids = {rid for rid, _ in live}
+                for rid in [r for r in self._busy_until
+                            if r not in live_ids]:
+                    del self._busy_until[rid]    # retired replicas
+                next_tick += cfg.tick_period_s
+            progressed = now > t_before + 1e-12
+            for rid, e in self._engines():
+                if not e.has_work():
+                    continue
+                if self._busy_until.get(rid, 0.0) > now + 1e-9:
+                    continue
+                cost = e.run_round()
+                if cost > 0.0:
+                    self._busy_until[rid] = now + cost
+                    progressed = True
+            # no-progress backstop: engines report work but none of
+            # them can act on it (e.g. a head no admission will ever
+            # take) and no time passed — after a few spins, stop
+            # treating those engines as "due now" so t_next falls
+            # through to the tick/deadline and virtual time moves
+            # instead of the loop burning wall time in place
+            stalled = 0 if progressed else stalled + 1
+        for t in threads:
+            t.join(timeout=30.0)
+        virtual_s = clock.now()
+        wall_s = max(1e-9, _time.perf_counter() - wall0)
+        LOAD_VIRTUAL_SECONDS.inc(virtual_s)
+        LOAD_SPEEDUP.set(virtual_s / wall_s)
+        LOAD_PEAK_QUEUE.set(float(self.collector.peak_queue_depth))
+        return LoadReport.build(self, virtual_s, wall_s)
+
+
+@dataclasses.dataclass
+class LoadReport:
+    """One replay's capacity numbers.  ``metrics()`` is the
+    deterministic subset (virtual-time only); ``doc()`` adds wall-clock
+    facts (speedup) that legitimately vary run to run."""
+
+    replicas: int
+    requests: int
+    ok: int
+    shed: int
+    timeout: int
+    cancelled: int
+    errors: int
+    retries: int
+    tokens: int
+    failovers: int
+    preemptions: int
+    scale_ups: int
+    scale_downs: int
+    peak_queue_depth: int
+    ttft_p50_ms: float
+    ttft_p95_ms: float
+    ttft_p99_ms: float
+    itl_p50_ms: float
+    itl_p99_ms: float
+    throughput_tokens_per_vs: float
+    virtual_s: float
+    wall_s: float
+    speedup_x: float
+    tenants: Dict[str, int]
+    #: per-tenant outcome rows: {tenant: {"ok": n, "shed": n, ...,
+    #: "retries": n}} — what the shed-honoring and WFQ assertions read
+    outcomes_by_tenant: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
+
+    @classmethod
+    def build(cls, driver: LoadDriver, virtual_s: float,
+              wall_s: float) -> "LoadReport":
+        col = driver.collector
+        by = {}
+        by_tenant: Dict[str, Dict[str, int]] = {}
+        for rec in col.records:
+            by[rec["status"]] = by.get(rec["status"], 0) + 1
+            row = by_tenant.setdefault(rec["tenant"], {"retries": 0})
+            row[rec["status"]] = row.get(rec["status"], 0) + 1
+            row["retries"] += rec.get("retries", 0)
+        stats = driver.gateway.stats()
+        preempted = sum(getattr(e, "preempted", 0)
+                        for e in driver._engines())
+        shed_rate = (by.get("shed", 0) / max(1, len(col.records)))
+        LOAD_SHED_RATE.set(shed_rate)
+        return cls(
+            replicas=len(driver.fleet.replicas()),
+            requests=len(col.records),
+            ok=by.get("ok", 0),
+            shed=by.get("shed", 0),
+            timeout=by.get("timeout", 0),
+            cancelled=by.get("cancelled", 0),
+            errors=by.get("error", 0),
+            retries=col.retries,
+            tokens=col.tokens,
+            failovers=stats.get("failovers", 0),
+            preemptions=preempted,
+            scale_ups=stats.get("scale_ups", 0),
+            scale_downs=stats.get("scale_downs", 0),
+            peak_queue_depth=col.peak_queue_depth,
+            ttft_p50_ms=round(1000 * percentile(col.ttft_s, 0.50), 3),
+            ttft_p95_ms=round(1000 * percentile(col.ttft_s, 0.95), 3),
+            ttft_p99_ms=round(1000 * percentile(col.ttft_s, 0.99), 3),
+            itl_p50_ms=round(1000 * percentile(col.gaps_s, 0.50), 3),
+            itl_p99_ms=round(1000 * percentile(col.gaps_s, 0.99), 3),
+            throughput_tokens_per_vs=round(
+                col.tokens / max(1e-9, virtual_s), 3),
+            virtual_s=round(virtual_s, 3),
+            wall_s=round(wall_s, 3),
+            speedup_x=round(virtual_s / wall_s, 1),
+            tenants=dict(sorted(col.tokens_by_tenant.items())),
+            outcomes_by_tenant=dict(sorted(by_tenant.items())),
+        )
+
+    def metrics(self) -> dict:
+        """The run-to-run deterministic subset (no wall-clock facts)."""
+        doc = dataclasses.asdict(self)
+        doc.pop("wall_s")
+        doc.pop("speedup_x")
+        return doc
+
+    def doc(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def replay(trace_cfg: TraceConfig,
+           fleet_cfg: Optional[FleetConfig] = None, *,
+           hammer_tenant: Optional[str] = None,
+           max_virtual_s: Optional[float] = None) -> LoadReport:
+    """Generate + replay one trace against a fresh fleet; the one-call
+    entry the sweeps (and tests) compose."""
+    fleet_cfg = fleet_cfg or FleetConfig()
+    clock = VirtualClock()
+    collector = Collector()
+    gw, fleet = build_fleet(fleet_cfg, clock, collector)
+    try:
+        driver = LoadDriver(gw, fleet, clock, trace_cfg,
+                            fleet_cfg=fleet_cfg, collector=collector,
+                            hammer_tenant=hammer_tenant,
+                            max_virtual_s=max_virtual_s)
+        return driver.run()
+    finally:
+        gw.close()
+
+
+def sweep_replicas(trace_cfg: TraceConfig, fleet_cfg: FleetConfig,
+                   replica_counts: List[int]) -> List[dict]:
+    """The SLO curve: TTFT / inter-token percentiles + shed rate vs
+    fleet size, same trace replayed per point."""
+    rows = []
+    for n in replica_counts:
+        report = replay(trace_cfg,
+                        dataclasses.replace(fleet_cfg, replicas=n))
+        row = report.metrics()
+        row["shed_rate"] = round(report.shed / max(1, report.requests), 4)
+        rows.append(row)
+        _LOG.info("load: %d replica(s): ttft p99 %.1f ms, itl p99 "
+                  "%.1f ms, shed %.3f", n, row["ttft_p99_ms"],
+                  row["itl_p99_ms"], row["shed_rate"])
+    return rows
+
+
+def shed_frontier(trace_cfg: TraceConfig, fleet_cfg: FleetConfig,
+                  load_factors: List[float]) -> List[dict]:
+    """Shed rate + p99 vs offered load multiplier at a fixed fleet — the
+    overload frontier (where graceful degradation starts)."""
+    rows = []
+    for load in load_factors:
+        # bound the closed-loop stretch: a deeply overloaded fleet makes
+        # clients slide their turns without limit — 2x the trace horizon
+        # is plenty to measure the frontier
+        report = replay(trace_cfg.scaled(load), fleet_cfg,
+                        max_virtual_s=trace_cfg.duration_s * 2)
+        rows.append({
+            "load_factor": load,
+            "requests": report.requests,
+            "shed_rate": round(report.shed / max(1, report.requests), 4),
+            "retries": report.retries,
+            "ttft_p99_ms": report.ttft_p99_ms,
+            "peak_queue_depth": report.peak_queue_depth,
+            "preemptions": report.preemptions,
+            "virtual_s": report.virtual_s,
+        })
+    return rows
+
+
+def wfq_weight_sweep(trace_cfg: TraceConfig, fleet_cfg: FleetConfig,
+                     weights: List[float],
+                     tenant: str = "t0") -> List[dict]:
+    """Per-tenant p99 vs one tenant's WFQ weight (the tuning artifact
+    for the PR 7 fairness knobs)."""
+    rows = []
+    for w in weights:
+        policies = dict(fleet_cfg.tenant_policies
+                        or default_tenant_policies())
+        policies[tenant] = dict(policies.get(tenant, {}), weight=w)
+        report = replay(trace_cfg, dataclasses.replace(
+            fleet_cfg, tenant_policies=policies))
+        rows.append({
+            "tenant": tenant, "weight": w,
+            "tenant_tokens": report.tenants.get(tenant, 0),
+            "total_tokens": report.tokens,
+            "ttft_p99_ms": report.ttft_p99_ms,
+            "shed_rate": round(report.shed / max(1, report.requests), 4),
+        })
+    return rows
+
+
+def autoscaler_gain_sweep(trace_cfg: TraceConfig, fleet_cfg: FleetConfig,
+                          gains: List[dict]) -> List[dict]:
+    """Scale events + p99 per autoscaler gain setting — flap tuning
+    (bursts must not translate into lease churn)."""
+    rows = []
+    for gain in gains:
+        report = replay(trace_cfg, dataclasses.replace(
+            fleet_cfg, autoscaler=gain))
+        rows.append({
+            "gain": gain,
+            "scale_ups": report.scale_ups,
+            "scale_downs": report.scale_downs,
+            "final_replicas": report.replicas,
+            "ttft_p99_ms": report.ttft_p99_ms,
+            "shed_rate": round(report.shed / max(1, report.requests), 4),
+        })
+    return rows
+
+
+def capacity_artifact(trace_cfg: TraceConfig, fleet_cfg: FleetConfig, *,
+                      replica_counts: List[int],
+                      load_factors: List[float],
+                      frontier_fleet_cfg: Optional[FleetConfig] = None
+                      ) -> dict:
+    """The published operating curves in one JSON-shaped artifact: the
+    SLO curve vs replica count plus the shed-rate frontier, with the
+    replay-speedup provenance (virtual hours per wall second).
+    ``frontier_fleet_cfg`` lets the frontier run a deliberately tighter
+    deployment (small queues, low retry budget) so the overload knee is
+    inside the swept load range."""
+    wall0 = _time.perf_counter()
+    slo_curve = sweep_replicas(trace_cfg, fleet_cfg, replica_counts)
+    frontier = shed_frontier(trace_cfg,
+                             frontier_fleet_cfg or fleet_cfg,
+                             load_factors)
+    wall = max(1e-9, _time.perf_counter() - wall0)
+    virtual = (sum(r["virtual_s"] for r in slo_curve)
+               + sum(r["virtual_s"] for r in frontier))
+    return {
+        "trace": dataclasses.asdict(trace_cfg),
+        "fleet": {
+            "profile": dataclasses.asdict(fleet_cfg.profile),
+            "replica_counts": replica_counts,
+            "load_factors": load_factors,
+        },
+        "slo_curve": slo_curve,
+        "shed_frontier": frontier,
+        "replay": {
+            "virtual_s": round(virtual, 1),
+            "wall_s": round(wall, 2),
+            "speedup_x": round(virtual / wall, 1),
+            "virtual_hours_per_wall_s": round(virtual / 3600.0 / wall, 3),
+        },
+    }

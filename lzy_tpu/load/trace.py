@@ -1,0 +1,231 @@
+"""Seed-deterministic synthetic traffic traces for the load plane.
+
+A trace is the *script* of a million-user-shaped workload, generated
+once from a seed and replayed by ``load/driver.py`` against a
+fleet-in-threads gateway on a virtual clock:
+
+- **heavy-tailed tenant mix** — users map to tenants by a Zipf draw, so
+  a few tenants carry most of the traffic and the long tail exercises
+  the WFQ starvation guarantees;
+- **conversations with realistic prefix share** — each user runs
+  sessions of geometrically-distributed length whose turn N prompt is
+  the full turn N-1 prompt + reply + fresh user tokens, all sessions of
+  a tenant share a system-prompt header, and a new session sometimes
+  *revisits* an old one (continuing its accumulated history) — exactly
+  the shape radix caches, session pinning and cross-replica KV import
+  exist for;
+- **bursty arrivals** — per-turn think times are exponential (Poisson
+  per user) modulated by a global on/off burst schedule (think times
+  shrink by ``burst_factor`` inside a burst), so the autoscaler and the
+  shedding layer see flash crowds, not a fluid limit.
+
+Determinism is the contract: the same :class:`TraceConfig` (seed
+included) produces a byte-identical trace (:func:`trace_bytes`), and —
+because the virtual-clock replay is itself serialized — identical
+capacity metrics run to run.  Reply tokens are deterministic too:
+:func:`reply_tokens` is a pure function of the prompt shared between
+the trace's history model and the ``SimEngine`` that emits them, so a
+conversation's turn N+1 prompt is reproducible without running turn N
+first.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List
+
+import numpy as np
+
+_MASK = (1 << 63) - 1
+
+
+def _mix(h: int, v: int) -> int:
+    """Deterministic 63-bit mixing (splitmix-style) — stable across
+    processes, unlike builtin ``hash``."""
+    h = (h + 0x9E3779B97F4A7C15 + v) & _MASK
+    h = ((h ^ (h >> 30)) * 0xBF58476D1CE4E5B9) & _MASK
+    h = ((h ^ (h >> 27)) * 0x94D049BB133111EB) & _MASK
+    return h ^ (h >> 31)
+
+
+def reply_tokens(prompt: List[int], n: int, vocab: int = 32000) -> List[int]:
+    """The deterministic assistant reply a ``SimEngine`` emits for this
+    prompt — a pure function of (prompt tail, position), so the trace's
+    conversation-history model and the engine agree without coupling."""
+    h = _mix(len(prompt), prompt[-1] if prompt else 1)
+    out = []
+    for i in range(n):
+        h = _mix(h, i + 1)
+        out.append(1 + h % (vocab - 1))     # never token 0 (pad/scratch)
+    return out
+
+
+def user_tokens(seed: int, user: int, turn: int, n: int,
+                vocab: int = 32000) -> List[int]:
+    """Fresh user-message tokens for one turn (stable per (seed, user,
+    turn))."""
+    h = _mix(_mix(seed, user + 1), turn + 1)
+    out = []
+    for i in range(n):
+        h = _mix(h, i + 7)
+        out.append(1 + h % (vocab - 1))
+    return out
+
+
+def system_prompt(seed: int, tenant: str, n: int,
+                  vocab: int = 32000) -> List[int]:
+    """The tenant's shared header — every session of the tenant starts
+    with it, so tenants have real cross-session prefix share."""
+    h = _mix(seed, sum(ord(c) for c in tenant) + len(tenant))
+    out = []
+    for i in range(n):
+        h = _mix(h, i + 3)
+        out.append(1 + h % (vocab - 1))
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceConfig:
+    """Knobs of the synthetic workload (see module docstring)."""
+
+    seed: int = 0
+    duration_s: float = 3600.0      # per-user planned activity horizon
+    users: int = 128                # concurrent closed-loop clients
+    tenants: int = 8
+    zipf_a: float = 1.4             # tenant popularity skew
+    think_s: float = 8.0            # mean think time between turns
+    burst_factor: float = 6.0       # think-time speed-up inside a burst
+    burst_on_s: float = 60.0        # mean burst duration
+    burst_off_s: float = 240.0      # mean inter-burst gap
+    session_turns: float = 4.0      # mean turns per conversation
+    revisit_p: float = 0.3          # new session resumes an old one
+    system_prompt_tokens: int = 48
+    user_tokens_mean: float = 32.0
+    reply_tokens_mean: float = 16.0
+    reply_tokens_cap: int = 48
+    vocab: int = 32000
+
+    def scaled(self, load: float) -> "TraceConfig":
+        """The same workload at ``load``x offered rate (think times
+        shrink) — the shed-rate frontier sweeps this."""
+        return dataclasses.replace(self, think_s=self.think_s / load)
+
+
+@dataclasses.dataclass(frozen=True)
+class Turn:
+    """One scripted client turn: wait ``think_s``, then extend
+    ``session`` with ``new_tokens`` and ask for ``max_new_tokens``."""
+
+    user: int
+    tenant: str
+    session: str
+    fresh: bool                     # True: session starts (or restarts)
+    think_s: float
+    new_tokens: tuple
+    max_new_tokens: int
+
+
+def _burst_windows(rng: np.random.Generator,
+                   cfg: TraceConfig) -> List[tuple]:
+    """Global on/off burst schedule over the trace horizon."""
+    windows, t = [], 0.0
+    while t < cfg.duration_s:
+        t += float(rng.exponential(cfg.burst_off_s))
+        end = t + float(rng.exponential(cfg.burst_on_s))
+        if t >= cfg.duration_s:
+            break
+        windows.append((t, min(end, cfg.duration_s)))
+        t = end
+    return windows
+
+
+def _in_burst(windows: List[tuple], t: float) -> bool:
+    for a, b in windows:
+        if a <= t < b:
+            return True
+        if a > t:
+            break
+    return False
+
+
+def generate_trace(cfg: TraceConfig) -> List[List[Turn]]:
+    """Per-user turn scripts (``users`` lists, planned-time ordered).
+
+    The replay is closed-loop, so ``think_s`` is a *gap*, not an
+    absolute timestamp: an overloaded fleet pushes every later turn of
+    the user back — exactly how a real user behind a slow product
+    behaves — while the trace itself stays byte-identical per seed.
+    """
+    rng = np.random.default_rng(cfg.seed)
+    windows = _burst_windows(rng, cfg)
+    # heavy-tailed tenant popularity: user -> tenant by bounded Zipf
+    draws = rng.zipf(cfg.zipf_a, size=cfg.users * 4)
+    tenant_of = {}
+    i = 0
+    for user in range(cfg.users):
+        while draws[i % len(draws)] > cfg.tenants:
+            i += 1
+        tenant_of[user] = f"t{int(draws[i % len(draws)]) - 1}"
+        i += 1
+    users: List[List[Turn]] = []
+    for user in range(cfg.users):
+        tenant = tenant_of[user]
+        turns: List[Turn] = []
+        t = float(rng.uniform(0.0, min(cfg.think_s * 2, cfg.duration_s)))
+        session_n = 0
+        past: List[str] = []
+        turn_idx = 0
+        while t < cfg.duration_s:
+            # pick/continue a conversation
+            if past and rng.random() < cfg.revisit_p:
+                session = past[int(rng.integers(0, len(past)))]
+                fresh = False
+            else:
+                session_n += 1
+                session = f"u{user}-s{session_n}"
+                past.append(session)
+                if len(past) > 8:
+                    past.pop(0)
+                fresh = True
+            n_turns = 1 + int(rng.geometric(1.0 / cfg.session_turns))
+            first = fresh                     # revisits keep their history
+            for _ in range(n_turns):
+                scale = (1.0 / cfg.burst_factor
+                         if _in_burst(windows, t) else 1.0)
+                think = float(rng.exponential(cfg.think_s)) * scale
+                n_user = max(1, int(rng.lognormal(
+                    np.log(cfg.user_tokens_mean), 0.6)))
+                n_reply = min(cfg.reply_tokens_cap, max(1, int(
+                    rng.lognormal(np.log(cfg.reply_tokens_mean), 0.5))))
+                turns.append(Turn(
+                    user=user, tenant=tenant, session=session,
+                    fresh=first,
+                    think_s=round(think, 6),
+                    new_tokens=tuple(user_tokens(
+                        cfg.seed, user, turn_idx, n_user, cfg.vocab)),
+                    max_new_tokens=n_reply,
+                ))
+                first = False
+                turn_idx += 1
+                t += think
+                if t >= cfg.duration_s:
+                    break
+        users.append(turns)
+    return users
+
+
+def trace_doc(cfg: TraceConfig) -> dict:
+    """Canonical JSON-shaped form of the whole trace (determinism
+    checks serialize this)."""
+    return {
+        "config": dataclasses.asdict(cfg),
+        "users": [[dataclasses.asdict(t) for t in turns]
+                  for turns in generate_trace(cfg)],
+    }
+
+
+def trace_bytes(cfg: TraceConfig) -> bytes:
+    """Byte-identical per seed: sorted-key JSON of :func:`trace_doc`."""
+    return json.dumps(trace_doc(cfg), sort_keys=True,
+                      separators=(",", ":")).encode()

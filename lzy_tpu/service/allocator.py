@@ -33,6 +33,7 @@ from lzy_tpu.durable import (
 )
 from lzy_tpu.chaos.faults import CHAOS
 from lzy_tpu.types import PoolSpec, TpuPoolSpec, VmSpec
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.utils.ids import gen_id
 from lzy_tpu.utils.log import get_logger
 from lzy_tpu.utils.metrics import REGISTRY
@@ -129,7 +130,9 @@ class AllocatorService:
         allocate_timeout_s: float = 120.0,
         iam=None,                          # Optional[IamService]
         disks=None,                        # Optional[DiskService]
+        clock=None,                        # injectable wall clock (tests)
     ):
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         self._store = store
         self._executor = executor
         self._backend = backend
@@ -160,7 +163,7 @@ class AllocatorService:
             self._sessions[session.id] = session
         for doc in self._store.kv_list("vms").values():
             vm = Vm.from_doc(doc)
-            vm.heartbeat_ts = time.time()  # grace window before GC judgement
+            vm.heartbeat_ts = self._clock.time()  # grace before GC judgement
             self._vms[vm.id] = vm
 
     # -- pools -----------------------------------------------------------------
@@ -281,7 +284,7 @@ class AllocatorService:
     def free(self, vm_ids: Sequence[str]) -> None:
         """Return a gang to the session cache (VM → IDLE, reused until the
         session idle timeout, ``ExecuteTaskAction.cleanup`` parity)."""
-        now = time.time()
+        now = self._clock.time()
         with self._lock:
             for vm_id in vm_ids:
                 vm = self._vms.get(vm_id)
@@ -298,7 +301,7 @@ class AllocatorService:
             if vm is None or vm.status == DELETING:
                 raise KeyError(f"vm {vm_id!r} is not expected to register")
             self._agents[vm_id] = agent
-            vm.heartbeat_ts = time.time()
+            vm.heartbeat_ts = self._clock.time()
             if vm.status == ALLOCATING:
                 vm.status = RUNNING
                 self._persist(vm)
@@ -314,7 +317,7 @@ class AllocatorService:
                 raise KeyError(f"vm {vm_id!r} is not known to the allocator")
             if vm_id not in self._agents:
                 raise KeyError(f"vm {vm_id!r} has no registered agent")
-            vm.heartbeat_ts = time.time()
+            vm.heartbeat_ts = self._clock.time()
 
     def refresh_worker_token(self, vm_id: str) -> Optional[str]:
         """Reissue the VM's WORKER token once it is past half-life, so
@@ -336,7 +339,8 @@ class AllocatorService:
                 issued_at = float(vm.worker_token.split(":")[1])
             except (IndexError, ValueError):
                 issued_at = 0.0
-            if time.time() - issued_at <= 0.5 * self._iam.max_token_age_s:
+            if self._clock.time() - issued_at \
+                    <= 0.5 * self._iam.max_token_age_s:
                 return None
             vm.worker_token = self._iam.issue_token(f"vm/{vm.id}")
             self._persist(vm)
@@ -416,7 +420,7 @@ class AllocatorService:
     def gc_tick(self, now: Optional[float] = None) -> List[str]:
         """Reap idle-expired and heartbeat-dead VMs; returns destroyed vm ids.
         Called periodically by the harness / a timer."""
-        now = now if now is not None else time.time()
+        now = now if now is not None else self._clock.time()
         doomed: List[Vm] = []
         with self._lock:
             for vm in self._vms.values():
@@ -509,7 +513,7 @@ class AllocatorService:
                     for vm in gang:
                         vm.status = RUNNING
                         vm.idle_since = None
-                        vm.heartbeat_ts = time.time()
+                        vm.heartbeat_ts = self._clock.time()
                         self._persist(vm)
                     return sorted(gang, key=lambda v: v.host_index)
         return None
@@ -557,7 +561,7 @@ class _AllocateGangAction(OperationRunner):
         pool_label = self.state["pool_label"]
         gang_size = self.state["gang_size"]
 
-        self.state.setdefault("requested_at", time.time())
+        self.state.setdefault("requested_at", self.svc._clock.time())
         cached = self.svc._find_cached_gang(session_id, pool_label, gang_size)
         if cached is not None:
             _LOG.info("gang cache hit: %s", [v.id for v in cached])
@@ -577,6 +581,11 @@ class _AllocateGangAction(OperationRunner):
                 status=ALLOCATING, gang_id=gang_id, host_index=i,
                 gang_size=gang_size,
                 worker_token=self.svc._issue_worker_token(vm_id),
+                # explicit: gc_tick compares created_ts against the
+                # INJECTED clock's time() — the dataclass default
+                # (real time.time) would make orphaned-ALLOCATING
+                # reaping silently dead under a virtual/offset clock
+                created_ts=self.svc._clock.time(),
             ))
         with self.svc._lock:
             for vm in vms:
@@ -631,7 +640,8 @@ class _AllocateGangAction(OperationRunner):
         if all(s == RUNNING for s in statuses):
             requested_at = self.state.get("requested_at")
             if requested_at:
-                _M_ALLOC_SECONDS.observe(time.time() - requested_at)
+                _M_ALLOC_SECONDS.observe(
+                    self.svc._clock.time() - requested_at)
             return StepResult.finish(self._result())
         return StepResult.restart(0.1)
 

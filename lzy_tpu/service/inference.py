@@ -457,6 +457,7 @@ def build_disagg_gateway_service(
     start: bool = True,
     prefill_budget: Optional[int] = None,
     tenants=None,
+    kv_global_index: Optional[bool] = None,
 ):
     """Construct the disaggregated serving gateway (``serve.py --disagg``):
     a pool of ``prefill_replicas`` :class:`~lzy_tpu.serving.PrefillEngine`
@@ -531,6 +532,16 @@ def build_disagg_gateway_service(
         from lzy_tpu.serving.tenancy import SloLimiter
 
         slo = SloLimiter(tenants)
+    if kv_global_index is None:
+        # same implication as the monolithic gateway: a tier without the
+        # fleet-global index would warm only the replica that demoted
+        kv_global_index = (kv_host_tier_bytes is not None
+                           or kv_storage_tier is not None)
+    kv_index = None
+    if kv_global_index:
+        from lzy_tpu.gateway.kv_index import GlobalKVIndex
+
+        kv_index = GlobalKVIndex(page_size)
     service = DisaggGatewayService(
         decode_fleet,
         prefill_fleet,
@@ -542,6 +553,7 @@ def build_disagg_gateway_service(
         prefill_replicas=prefill_replicas,
         model_name=model,
         slo=slo,
+        kv_index=kv_index,
     )
     try:
         for _ in range(decode_replicas):

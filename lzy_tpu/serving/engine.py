@@ -52,7 +52,6 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-import time
 from typing import Any, List, Optional, Sequence
 
 import jax
@@ -230,6 +229,7 @@ class InferenceEngine:
         proposer=None,
         prefill_budget: Optional[int] = None,
         tenants=None,
+        clock=None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -246,6 +246,13 @@ class InferenceEngine:
         self.cfg = base
         self.params = params
         self.slots = slots
+        # injectable time (utils/clock): TTFT/step timestamps, deadlines
+        # and the loop's idle park all run on it, so a virtual clock can
+        # drive the whole engine deterministically; the system default
+        # is bit-identical to the old time.monotonic()/sleep() calls
+        from lzy_tpu.utils.clock import SYSTEM_CLOCK
+
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         self.eos_token = eos_token
         self.prefill_chunk = prefill_chunk
         self._temperature = temperature
@@ -284,7 +291,8 @@ class InferenceEngine:
         self._tenant_counts: dict = {}
         self._tenant_counts_lock = threading.Lock()
 
-        self.queue = RequestQueue(max_queue, policies=tenants)
+        self.queue = RequestQueue(max_queue, policies=tenants,
+                                  clock=self._clock)
         self._active: List[Optional[Request]] = [None] * slots
         self._cur = np.zeros((slots,), np.int32)   # last token per slot
         # host mirror of each slot's cache index (tokens resident in the
@@ -460,7 +468,7 @@ class InferenceEngine:
         req = Request(prompt, max_new_tokens, request_id=request_id,
                       deadline_s=deadline_s, greedy=greedy,
                       tenant=tenant, priority=priority,
-                      liveness=liveness)
+                      liveness=liveness, clock=self._clock)
         self.queue.submit(req)
         with self._outstanding_lock:
             self._outstanding = {r for r in self._outstanding
@@ -753,7 +761,7 @@ class InferenceEngine:
         """Shared prefill tail: record TTFT, emit the first token, and
         either free the slot (one-token request) or activate it."""
         req.phase = "decode"
-        now = time.monotonic()
+        now = self._clock.now()
         req.first_token_at = now
         _TTFT.observe(now - req.submitted_at)
         TENANT_TTFT.observe(now - req.submitted_at, tenant=req.tenant)
@@ -776,13 +784,13 @@ class InferenceEngine:
         plan = self._spec_plan()
         if plan is not None:
             return self._decode_verify(plan)
-        t0 = time.monotonic()
+        t0 = self._clock.now()
         tokens = jnp.asarray(self._cur[:, None])
         mask = jnp.asarray(self._greedy_mask())
         self._cache, nxt, self._rng = self._run_decode_step(tokens, mask)
         self._index_aliased = True
         nxt = np.asarray(nxt)        # one host transfer for the whole batch
-        dt = time.monotonic() - t0
+        dt = self._clock.now() - t0
         _STEP.observe(dt)
         self._post_decode_step()
         emitted = rows = 0
@@ -849,7 +857,7 @@ class InferenceEngine:
         sampled/no-draft rows emit exactly one, drawn from the same
         position-0 logits (and the same single rng split) a plain step
         would have produced."""
-        t0 = time.monotonic()
+        t0 = self._clock.now()
         width = self.spec_tokens + 1
         toks = np.zeros((self.slots, width), np.int32)
         toks[:, 0] = self._cur
@@ -868,7 +876,7 @@ class InferenceEngine:
             jnp.asarray(toks), mask)
         self._index_aliased = True
         greedy_all, nxt = jax.device_get((greedy_all, nxt))
-        dt = time.monotonic() - t0
+        dt = self._clock.now() - t0
         _STEP.observe(dt)
 
         emit: dict = {}
@@ -1078,7 +1086,8 @@ class InferenceEngine:
                         # all slots drained and the queue is empty: park
                         # until the next submit instead of spinning the
                         # device
-                        self.queue.work_available.wait(timeout=0.5)
+                        self._clock.wait(self.queue.work_available,
+                                         timeout=0.5)
                         self.queue.work_available.clear()
             except BaseException:  # noqa: BLE001 — engine-fatal
                 # a step()-level failure (device OOM, a poisoned compile) is
@@ -1121,9 +1130,9 @@ class InferenceEngine:
         thread) drains itself."""
         self._draining = True
         self.queue.work_available.set()     # wake a parked loop
-        deadline = time.monotonic() + timeout_s
+        deadline = self._clock.now() + timeout_s
         drained = False
-        while time.monotonic() < deadline:
+        while self._clock.now() < deadline:
             if self._closed:
                 break           # the loop died; close() cleans up
             with self._outstanding_lock:
@@ -1133,7 +1142,7 @@ class InferenceEngine:
             if not busy:
                 drained = True
                 break
-            time.sleep(0.01)
+            self._clock.sleep(0.01)
         self.close()
         return drained
 
@@ -1340,7 +1349,14 @@ class PagedInferenceEngine(InferenceEngine):
             self.kv_tier = None
         if self.kv_tier is not None:
             self.kv.on_evict = self._demote_block
+            self.kv.on_evict_batch = self._demote_blocks
             self.kv.on_insert = self.kv_tier.discard
+        # device→host gather accounting for the demotion path: one
+        # BATCHED gather per cache leaf per eviction round (not one per
+        # evicted block) — the count-of-transfers contract the batching
+        # test pins
+        self.kv_tier_gather_ops = 0
+        self.kv_tier_gather_rounds = 0
         # cross-replica / disagg import queue: transferred KVBlockExports
         # fold into the pool+tree between engine steps, strictly before
         # admissions (a queued import is resident by the time the request
@@ -1641,27 +1657,67 @@ class PagedInferenceEngine(InferenceEngine):
         return super().step() or serviced
 
     def _demote_block(self, chain, block: int, origin) -> None:
-        """``RadixCache.on_evict`` hook: gather the evicted block's K/V
-        rows (int8 sidecar leaves included — they are ordinary cache
-        leaves) to host memory and file them in the tier, keyed by the
-        block's full token chain. Every failure — including the
-        ``kvtier.demote`` chaos fault inside ``put`` — degrades to the
-        classic drop the eviction was going to do anyway."""
+        """``RadixCache.on_evict`` hook (single-victim form): one block
+        through the batched path below."""
+        self._demote_blocks([(chain, block, origin)])
+
+    def _demote_blocks(self, victims) -> None:
+        """``RadixCache.on_evict_batch`` hook: demote one eviction
+        round's victims — ``[(chain_tokens, block, origin), ...]`` — with
+        the per-block device→host copies COALESCED into a single gather
+        per cache leaf (int8 sidecar leaves included — they are ordinary
+        cache leaves).  A pressured admission that evicts a dozen blocks
+        used to pay a dozen tiny transfers per leaf; now it pays one
+        ``leaf[ids]`` gather per leaf for the whole round.  Every
+        failure — including the ``kvtier.demote`` chaos fault inside
+        ``put`` — degrades to the classic drop the eviction was going to
+        do anyway, counted per victim."""
         tier = self.kv_tier
-        if tier is None or not chain:
+        if tier is None:
+            return
+        victims = [(chain, block, origin) for chain, block, origin
+                   in victims if chain]
+        if not victims:
             return
         try:
-            leaves = {}
+            ids = jnp.asarray([block for _, block, _ in victims],
+                              jnp.int32)
+            gathered = {}
             for key, leaf in zip(self._kv_leaf_keys(),
                                  jax.tree_util.tree_leaves(self._cache)):
                 if key is None:        # index leaf: not payload
                     continue
-                leaves[key] = np.asarray(leaf[block])
-            tier.put(tuple(int(t) for t in chain), leaves, origin=origin)
+                # ONE [n_victims, page, ...] gather + host transfer per
+                # leaf, split per block below (np views, no extra copy)
+                gathered[key] = np.asarray(leaf[ids])
+                self.kv_tier_gather_ops += 1
+            self.kv_tier_gather_rounds += 1
+            from lzy_tpu.serving.kv_tier import GATHER_BATCHES
+
+            GATHER_BATCHES.inc()
         except Exception as e:  # noqa: BLE001 — demotion is advisory
-            tier.note_dropped()
-            _LOG.debug("kvtier: demotion of a %d-token chain dropped "
-                       "(%s: %s)", len(chain), type(e).__name__, e)
+            for chain, _, _ in victims:
+                tier.note_dropped()
+            _LOG.debug("kvtier: batched demotion of %d chain(s) dropped "
+                       "(%s: %s)", len(victims), type(e).__name__, e)
+            return
+        for i, (chain, block, origin) in enumerate(victims):
+            try:
+                # per-victim COPY, not a view: a view would pin the whole
+                # [n_victims, ...] gather base in host RAM for as long as
+                # ANY sibling entry survives in the tier, while the
+                # tier's byte accounting only books the slice — the
+                # budget would stop bounding real memory. The copy is a
+                # host memcpy; the device->host transfer above is still
+                # one gather per leaf (the batching win).
+                leaves = {key: arr[i].copy()
+                          for key, arr in gathered.items()}
+                tier.put(tuple(int(t) for t in chain), leaves,
+                         origin=origin)
+            except Exception as e:  # noqa: BLE001 — demotion is advisory
+                tier.note_dropped()
+                _LOG.debug("kvtier: demotion of a %d-token chain dropped "
+                           "(%s: %s)", len(chain), type(e).__name__, e)
 
     def _kv_leaf_keys(self):
         """Cache-leaf keystrs in ``tree_leaves`` order, index leaves as

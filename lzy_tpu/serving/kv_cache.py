@@ -188,14 +188,19 @@ class RadixCache:
         # tier hooks (serving/kv_tier.py): ``on_evict(chain_tokens,
         # block, origin)`` fires BEFORE an evicted leaf's block returns
         # to the free list — the engine's demotion hook gathers the
-        # block's K/V rows to host memory there; ``on_insert(chain)``
+        # block's K/V rows to host memory there; ``on_evict_batch``
+        # (preferred when set) receives every victim of ONE eviction
+        # round — ``[(chain_tokens, block, origin), ...]`` — in a single
+        # call, so the engine can coalesce the per-block device→host
+        # copies into one gather per cache leaf; ``on_insert(chain)``
         # fires for each NEWLY created tree node with its full root→node
         # token chain — the engine drops any demoted-tier copy of that
         # chain (the HBM copy is authoritative, and a chain must live in
-        # exactly one tier for the conservation audit to hold). Both are
+        # exactly one tier for the conservation audit to hold). All are
         # guarded: a hook failure degrades to classic eviction / a
         # harmless stale tier entry, never a broken tree.
         self.on_evict = None
+        self.on_evict_batch = None
         self.on_insert = None
         self._update_gauges()
 
@@ -314,19 +319,67 @@ class RadixCache:
         """``n`` fresh blocks (refcount 1 each), evicting LRU unreferenced
         tree leaves as needed. Raises :class:`NoFreeBlocks` — *before*
         taking any block — if the pool cannot cover the request even after
-        evicting everything evictable."""
+        evicting everything evictable.
+
+        Evictions for one allocate call form ONE round: every victim is
+        detached first, the demotion hook runs once over the whole batch
+        (``on_evict_batch`` — one device→host gather per cache leaf
+        instead of per block; per-block ``on_evict`` is the fallback),
+        and only then do the blocks return to the free list — the hook
+        must see the victims' K/V before anything can overwrite it."""
         if n > self.available():
             raise NoFreeBlocks(
                 f"need {n} blocks, only {self.available()} available "
                 f"(free + evictable)")
-        out = []
-        for _ in range(n):
-            if self.pool.free_count() == 0:
-                evicted = self._evict_one()
-                assert evicted, "available() promised an evictable block"
-            out.append(self.pool.alloc())
+        victims: List[_Node] = []
+        while self.pool.free_count() + len(victims) < n:
+            victim = self._detach_victim()
+            assert victim is not None, \
+                "available() promised an evictable block"
+            victims.append(victim)
+        if victims:
+            self._offer_demotions(victims)
+            for victim in victims:
+                self.pool.release_to_free(victim.block)
+                self.evictions += 1
+                _EVICTIONS.inc()
+        out = [self.pool.alloc() for _ in range(n)]
         self._update_gauges()
         return out
+
+    def _offer_demotions(self, victims: List["_Node"]) -> None:
+        """Offer one eviction round's victims for demotion (guarded —
+        a hook failure degrades to the classic drop)."""
+        if self.on_evict_batch is not None:
+            try:
+                self.on_evict_batch(
+                    [(self.chain_tokens(v), v.block, v.origin)
+                     for v in victims])
+            except Exception:  # noqa: BLE001 — demotion is advisory
+                pass
+            return
+        if self.on_evict is None:
+            return
+        for victim in victims:
+            try:
+                self.on_evict(self.chain_tokens(victim), victim.block,
+                              victim.origin)
+            except Exception:  # noqa: BLE001 — demotion is advisory
+                pass
+
+    def _detach_victim(self) -> Optional["_Node"]:
+        """Detach the LRU unreferenced leaf from the tree WITHOUT
+        returning its block to the free list (the caller batches the
+        demotion hook first).  ``chain_tokens`` stays valid on the
+        detached node — parents are intact, only the child link is cut."""
+        leaves = self._evictable_leaves()
+        if not leaves:
+            return None
+        victim = min(leaves, key=lambda node: node.last_access)
+        del victim.parent.children[victim.chunk]
+        del self._node_of[victim.block]
+        self.structure_version += 1
+        return victim
 
     def release(self, blocks: Sequence[int]) -> None:
         """Drop one reference per block. Unreferenced blocks in the tree
@@ -361,31 +414,6 @@ class RadixCache:
         for chunk in reversed(chunks):
             out.extend(chunk)
         return out
-
-    def _evict_one(self) -> bool:
-        """Evict the least-recently-used unreferenced leaf; returns False
-        when nothing is evictable (every cached block is pinned by an
-        in-flight request). With an ``on_evict`` hook installed, the
-        victim's payload is offered for DEMOTION before its block id
-        returns to the free list — a hook failure degrades to the
-        classic drop."""
-        leaves = self._evictable_leaves()
-        if not leaves:
-            return False
-        victim = min(leaves, key=lambda node: node.last_access)
-        if self.on_evict is not None:
-            try:
-                self.on_evict(self.chain_tokens(victim), victim.block,
-                              victim.origin)
-            except Exception:  # noqa: BLE001 — demotion is advisory
-                pass
-        del victim.parent.children[victim.chunk]
-        del self._node_of[victim.block]
-        self.pool.release_to_free(victim.block)
-        self.evictions += 1
-        self.structure_version += 1
-        _EVICTIONS.inc()
-        return True
 
     def available(self) -> int:
         """Blocks an :meth:`allocate` could obtain right now: the free

@@ -40,12 +40,12 @@ from __future__ import annotations
 
 import hashlib
 import threading
-import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
 from lzy_tpu.chaos.faults import CHAOS, InjectedFault
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.utils.log import get_logger
 from lzy_tpu.utils.metrics import REGISTRY
 
@@ -74,6 +74,10 @@ HOST_BYTES = REGISTRY.gauge(
 STORAGE_BLOCKS = REGISTRY.gauge(
     "lzy_kvtier_storage_blocks",
     "block payloads this process has spilled to the storage tier")
+GATHER_BATCHES = REGISTRY.counter(
+    "lzy_kvtier_gather_batches_total",
+    "batched demotion gathers (one device->host copy per cache leaf "
+    "covers a whole eviction round's victims)")
 
 # chaos boundaries: both are advisory BY CONTRACT — an injected failure
 # at demote costs the payload (classic eviction), at import/promote it
@@ -264,7 +268,11 @@ class HostKVTier:
     """
 
     def __init__(self, budget_bytes: int, page_size: int, *,
-                 storage: Optional[StorageKVTier] = None):
+                 storage: Optional[StorageKVTier] = None, clock=None):
+        # the spill-flush deadline runs on the injected clock (system by
+        # default; the spill worker itself is real I/O either way) —
+        # distinct from self._clock, the logical LRU counter below
+        self._time = clock if clock is not None else SYSTEM_CLOCK
         if budget_bytes < 0:
             raise ValueError(
                 f"budget_bytes must be >= 0, got {budget_bytes}")
@@ -419,10 +427,10 @@ class HostKVTier:
         """Block until every queued spill has been uploaded or dropped
         (tests, and ``close`` — a retiring replica's spills are the
         fleet's warm-up payload, so they land before the tier dies)."""
-        deadline = time.monotonic() + timeout_s
+        deadline = self._time.now() + timeout_s
         with self._spill_cv:
             while self._spill_pending:
-                left = deadline - time.monotonic()
+                left = deadline - self._time.now()
                 if left <= 0:
                     return False
                 self._spill_cv.wait(timeout=min(0.1, left))

@@ -28,11 +28,11 @@ from __future__ import annotations
 
 import itertools
 import threading
-import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
 from lzy_tpu.chaos.faults import CHAOS
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.utils.metrics import REGISTRY
 
 _QUEUE_DEPTH = REGISTRY.gauge(
@@ -191,7 +191,7 @@ class Request:
                  greedy: Optional[bool] = None,
                  tenant: str = DEFAULT_TENANT,
                  priority: Optional[int] = None,
-                 liveness=None):
+                 liveness=None, clock=None):
         self.id = request_id or f"req-{next(_ids)}"
         self.prompt: List[int] = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -202,7 +202,12 @@ class Request:
         self.error: Optional[str] = None
         self.status: Optional[str] = None     # "ok" | "cancelled" | "error"
         self.cancelled = False
-        self.submitted_at = time.monotonic()
+        # injectable time (utils/clock): deadlines, TTFT and the waiter
+        # wake-up all run on it — the load plane's virtual clock makes a
+        # simulated hour of requests expire, finish and wake in virtual
+        # time; the default is indistinguishable from time.monotonic()
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
+        self.submitted_at = self._clock.now()
         self.deadline: Optional[float] = (
             self.submitted_at + float(deadline_s)
             if deadline_s is not None else None)
@@ -232,7 +237,7 @@ class Request:
         #: prefilled, dense engine, or no match) — set by the paged
         #: engine at prefill staging, read by the disagg gateway's reply
         self.kv_prefilled_by: Optional[str] = None
-        self._done = threading.Event()
+        self._done = self._clock.event()
         # WFQ bookkeeping (owned by RequestQueue): virtual start/finish
         # tags, arrival sequence, and the queued flag
         self._vstart = 0.0
@@ -250,7 +255,7 @@ class Request:
     @property
     def expired(self) -> bool:
         """Client deadline passed (the engine reaps these like cancels)."""
-        return self.deadline is not None and time.monotonic() > self.deadline
+        return self.deadline is not None and self._clock.now() > self.deadline
 
     @property
     def client_dead(self) -> bool:
@@ -279,12 +284,12 @@ class Request:
                status: Optional[str] = None) -> None:
         self.error = error
         self.status = status or ("ok" if error is None else "error")
-        self.finished_at = time.monotonic()
+        self.finished_at = self._clock.now()
         self._done.set()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until finished (any terminal status); True if it did."""
-        return self._done.wait(timeout)
+        return self._clock.wait(self._done, timeout)
 
     @property
     def done(self) -> bool:
@@ -293,7 +298,7 @@ class Request:
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Generated token ids (prompt excluded); raises on engine error or
         timeout."""
-        if not self._done.wait(timeout):
+        if not self._clock.wait(self._done, timeout):
             raise TimeoutError(
                 f"request {self.id} not finished within {timeout}s")
         if self.error:
@@ -322,9 +327,10 @@ class RequestQueue:
     weights and queue caps; without it every tenant gets the tier-1
     default weight and only the global bound applies."""
 
-    def __init__(self, max_depth: int = 64, policies=None):
+    def __init__(self, max_depth: int = 64, policies=None, clock=None):
         self.max_depth = max_depth
         self.policies = policies
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         self._subq: Dict[str, deque] = {}
         self._finish_tag: Dict[str, float] = {}
         self._vtime = 0.0
@@ -337,7 +343,7 @@ class RequestQueue:
         self._last_pop: Optional[float] = None
         self._pop_interval_s = 0.05
         #: signalled on submit so an idle engine loop wakes immediately
-        self.work_available = threading.Event()
+        self.work_available = self._clock.event()
 
     # -- shed hints ----------------------------------------------------------
 
@@ -460,7 +466,7 @@ class RequestQueue:
                  if tag <= self._vtime and t not in self._subq]
         for t in stale:
             del self._finish_tag[t]
-        now = time.monotonic()
+        now = self._clock.now()
         if self._last_pop is not None:
             dt = now - self._last_pop
             self._pop_interval_s += 0.2 * (dt - self._pop_interval_s)

@@ -41,12 +41,12 @@ deadline eviction. Cancels are counted by the phase the request was in.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Any, Dict, List, Optional
 
 from lzy_tpu.channels.token_stream import TokenStreamChannel
 from lzy_tpu.chaos.faults import CHAOS, DELAY, ERROR, SLOW
 from lzy_tpu.serving.scheduler import shed_error
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.utils.ids import gen_id
 from lzy_tpu.utils.log import get_logger
 from lzy_tpu.utils.metrics import REGISTRY
@@ -120,7 +120,8 @@ class StreamSession:
         self.channel = TokenStreamChannel(request_id)
         self.reply: Optional[dict] = None
         self.error: Optional[BaseException] = None
-        self.opened_at = time.monotonic()
+        self._clock = manager._clock
+        self.opened_at = self._clock.now()
         self.last_poll = self.opened_at
         self.finished = threading.Event()
         self._cancelled = False
@@ -146,7 +147,7 @@ class StreamSession:
         the engine then reaps the request like a passed deadline. Cheap
         by design: it runs inside the engine's scheduling round (and
         under the request queue's lock for queued requests)."""
-        now = time.monotonic()
+        now = self._clock.now()
         lag = self.channel.consumer_lag
         with self._lock:
             if self._cancelled or self._dead_reason is not None:
@@ -189,7 +190,7 @@ class StreamSession:
 
     def touch(self) -> None:
         with self._lock:
-            self.last_poll = time.monotonic()
+            self.last_poll = self._clock.now()
 
     @property
     def phase(self) -> str:
@@ -238,8 +239,13 @@ class StreamSessionManager:
                  liveness_timeout_s: float = 15.0,
                  max_sessions: int = 64,
                  terminal_ttl_s: float = 60.0,
-                 max_frame_wait_s: float = 30.0):
+                 max_frame_wait_s: float = 30.0,
+                 clock=None):
         self._service = service
+        # injectable time: liveness windows, poll cursors and the
+        # terminal-session GC all age on it (virtual under the load
+        # plane's clock, wall time in production)
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         self.ack_window = int(ack_window)
         self.stall_grace_s = float(stall_grace_s)
         self.liveness_timeout_s = float(liveness_timeout_s)
@@ -384,7 +390,7 @@ class StreamSessionManager:
                 # client lost that reply (or its whole connection) and
                 # resumed at its fence — the canonical wire resume
                 RESUMES.inc()
-            sess.last_poll = time.monotonic()
+            sess.last_poll = self._clock.now()
             sess._polling += 1
         try:
             ch.ack(pos)      # everything below the poll cursor is acked
@@ -395,7 +401,7 @@ class StreamSessionManager:
                 sess._polling -= 1
                 # the liveness window restarts when the poll RETURNS —
                 # a client that waited out a long frame is not behind
-                sess.last_poll = time.monotonic()
+                sess.last_poll = self._clock.now()
                 sess._served = max(sess._served,
                                    pos + len(out["tokens"]))
         frame = {
@@ -453,7 +459,7 @@ class StreamSessionManager:
     def _gc(self) -> None:
         """Drop terminal sessions past their ttl (lazy, on open): the
         resume window for a lost final frame, not a leak."""
-        now = time.monotonic()
+        now = self._clock.now()
         with self._lock:
             stale = [sid for sid, s in self._sessions.items()
                      if s.terminal

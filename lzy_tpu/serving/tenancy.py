@@ -27,14 +27,15 @@ tenant always gets full speed.
 from __future__ import annotations
 
 import dataclasses
+import math
 import threading
-import time
 from typing import Callable, Dict, List, Optional
 
 from lzy_tpu.chaos.faults import CHAOS
 from lzy_tpu.serving.scheduler import (
     DEFAULT_PRIORITY, DEFAULT_TENANT, QuotaExceeded, quota_error,
     tier_weight)
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.utils.metrics import REGISTRY
 
 TENANT_REQUESTS = REGISTRY.counter(
@@ -160,7 +161,11 @@ class TokenBucket:
     the burst window a hard prompt-length cap."""
 
     def __init__(self, rate_per_s: float, burst: float,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Optional[Callable[[], float]] = None):
+        # the original injectable-clock seam, now generalized fleet-wide
+        # in utils/clock.py; a bare callable stays accepted (pass
+        # ``some_clock.now``)
+        clock = clock if clock is not None else SYSTEM_CLOCK.now
         if rate_per_s <= 0:
             raise ValueError(f"rate must be > 0, got {rate_per_s}")
         self.rate = float(rate_per_s)
@@ -209,11 +214,30 @@ class SloLimiter:
     debited, so retries are charged exactly once when they succeed."""
 
     def __init__(self, table: TenantTable,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Optional[Callable[[], float]] = None,
+                 enforce_backoff: bool = False,
+                 backoff_step_s: float = 0.05,
+                 backoff_cap_s: float = 10.0):
         self.table = table
-        self._clock = clock
+        self._clock = clock if clock is not None else SYSTEM_CLOCK.now
         self._buckets: Dict[str, tuple] = {}
         self._lock = threading.Lock()
+        # backoff ENFORCEMENT (off by default — additive behavior): the
+        # load harness found that an advisory retry_after_s loses to a
+        # hammering client — polling the bucket every few ms grabs each
+        # refilled token ahead of every client that honored the hint, so
+        # misbehavior WON throughput. With enforcement on, a refusal
+        # opens a per-tenant backoff window sized to the hint; arrivals
+        # inside the window are refused outright AND extend it by
+        # ``backoff_step_s`` (capped at ``backoff_cap_s`` ahead of now),
+        # so a hammering tenant starves itself while a hint-honoring one
+        # sails through on schedule. Tenant-scoped by design: the
+        # rate-limit identity is the tenant, so its clients share the
+        # window the way they share the bucket.
+        self._enforce_backoff = bool(enforce_backoff)
+        self._backoff_step_s = float(backoff_step_s)
+        self._backoff_cap_s = float(backoff_cap_s)
+        self._backoff_until: Dict[str, float] = {}
 
     def _buckets_for(self, tenant: str, policy: TenantPolicy):
         with self._lock:
@@ -234,18 +258,65 @@ class SloLimiter:
                 pair = self._buckets[tenant] = (req_bucket, tok_bucket)
             return pair
 
+    def _check_backoff(self, tenant: str) -> None:
+        """Enforced-backoff gate (see ``__init__``): refuse — and
+        extend — while the tenant's advertised window is open."""
+        if not self._enforce_backoff:
+            return
+        now = self._clock()
+        with self._lock:
+            until = self._backoff_until.get(tenant)
+            if until is None or now >= until:
+                return
+            # contempt of backoff: the early arrival pushes the window
+            # out (bounded ahead of now by the cap) — but NEVER shrinks
+            # it: a window already longer than the cap (deep token-debt
+            # refusals advertise long waits) must not collapse toward
+            # the cap just because the tenant hammered it
+            until = max(until, min(now + self._backoff_cap_s,
+                                   until + self._backoff_step_s))
+            self._backoff_until[tenant] = until
+        # CEIL to the wire precision: a round() hint can undershoot the
+        # stored window by half a millisecond, and a client honoring
+        # that hint EXACTLY (the virtual-clock harness does) would land
+        # inside the window and be penalized as a hammerer
+        hint = math.ceil((until - now) * 1000.0) / 1000.0
+        raise quota_error(
+            f"tenant {tenant!r} returned before its advertised "
+            f"retry_after_s elapsed; backing the window off",
+            tenant=tenant, reason="backoff",
+            retry_after_s=hint)
+
+    def _note_refusal(self, tenant: str, wait: float) -> None:
+        if not self._enforce_backoff:
+            return
+        now = self._clock()
+        with self._lock:
+            # the window must equal the CLIENT-VISIBLE hint
+            # (round(wait, 3) on the QuotaExceeded), never the unrounded
+            # wait: a compliant client sleeping exactly the hint must
+            # land at-or-after the window, not half a millisecond inside
+            # it. If the rounding undershoots the true bucket refill,
+            # the bucket itself refuses once more WITHOUT a backoff
+            # penalty — a soft second hint, not a punishment.
+            self._backoff_until[tenant] = max(
+                self._backoff_until.get(tenant, 0.0),
+                now + round(wait, 3))
+
     def admit(self, tenant: str, prompt_tokens: int) -> TenantPolicy:
         """Charge one request + its prompt tokens against the tenant's
         buckets; raises :class:`QuotaExceeded` on refusal. Returns the
         resolved policy so callers reuse the lookup (priority, quota)."""
         CHAOS.hit("slo.admit")
         policy = self.table.resolve(tenant)
+        self._check_backoff(tenant)
         req_bucket, tok_bucket = self._buckets_for(tenant, policy)
         if req_bucket is not None:
             wait = req_bucket.try_take(1.0)
             if wait is not None:
                 _RATE_LEVEL.set(req_bucket.level(), tenant=tenant,
                                 bucket="requests")
+                self._note_refusal(tenant, wait)
                 raise quota_error(
                     f"tenant {tenant!r} over its {policy.requests_per_s:g} "
                     f"requests/s limit",
@@ -260,6 +331,7 @@ class SloLimiter:
                     req_bucket.give_back(1.0)
                 _RATE_LEVEL.set(tok_bucket.level(), tenant=tenant,
                                 bucket="tokens")
+                self._note_refusal(tenant, wait)
                 raise quota_error(
                     f"tenant {tenant!r} over its "
                     f"{policy.prompt_tokens_per_s:g} prompt-tokens/s limit "

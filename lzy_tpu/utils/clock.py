@@ -1,0 +1,315 @@
+"""Injectable time for the serving plane: system clock + virtual clock.
+
+Every latency-bearing component of the stack (engines, request queue,
+gateway, breakers, autoscaler sustain windows, stream liveness, client
+deadlines) historically read ``time.time()`` / ``time.monotonic()`` and
+blocked in ``time.sleep()`` / ``Event.wait()`` directly.  That couples
+the whole fleet to wall time: an hour of traffic takes an hour, and
+every sleep-based test is slow and racy.  ``serving/tenancy.TokenBucket``
+already took an injectable clock; this module generalizes that pattern
+into one object the entire stack threads through:
+
+- :class:`SystemClock` — the production default.  ``now()`` is
+  ``time.monotonic()``, ``time()`` is ``time.time()``, waits are the
+  ordinary blocking primitives.  Components constructed without a clock
+  get the module singleton :data:`SYSTEM_CLOCK`; behavior is
+  bit-identical to the pre-refactor code.
+- :class:`VirtualClock` — deterministic discrete time for the load
+  plane (``lzy_tpu/load``) and for tests.  Threads that block through
+  the clock (``sleep``, ``wait`` on an event) PARK; the driving thread
+  calls :meth:`advance_to`, which fires due sleepers **one at a time in
+  (deadline, registration) order** and waits for each woken thread to
+  park again (or exit) before firing the next — a cooperative,
+  serialized schedule, so a multi-threaded fleet simulation replays
+  identically for a given seed.  Hours of virtual traffic run in
+  seconds of CPU because nobody ever really sleeps.
+
+The contract components must follow for virtual time to work:
+
+- read time ONLY via ``clock.now()`` (monotonic) / ``clock.time()``
+  (wall);
+- block ONLY via ``clock.sleep(s)`` or ``clock.wait(event, timeout)``;
+- create wake-up events via ``clock.event()`` (a virtual clock returns
+  an Event subclass whose ``set()`` notifies the scheduler, so a
+  completion wakes its waiter at a deterministic point).
+
+A ``threading.Event`` created elsewhere still works with
+``clock.wait`` — the waiter just relies on the real-time backstop poll
+instead of a prompt notification, which is correct but slower; the
+serving stack's own events all come from ``clock.event()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+#: real-time poll used as a liveness backstop while a thread is parked
+#: on a virtual clock: wake-ups normally arrive via explicit notify (a
+#: release, or a virtual event's ``set``); the backstop only covers an
+#: event set behind the scheduler's back (a foreign ``threading.Event``)
+_BACKSTOP_S = 0.05
+#: hard real-time ceiling on any single settle/advance: a virtual-clock
+#: deadlock (a participant blocked outside the clock) surfaces as a
+#: loud RuntimeError instead of a hung test run
+_STALL_LIMIT_S = 120.0
+
+
+class SystemClock:
+    """Wall-clock time and real blocking — the production default."""
+
+    virtual = False
+
+    def now(self) -> float:
+        """Monotonic seconds (interval math: deadlines, EWMAs, TTFT)."""
+        return time.monotonic()
+
+    def time(self) -> float:
+        """Wall-clock seconds (cross-process timestamps: heartbeats)."""
+        return time.time()
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            time.sleep(seconds)
+
+    def wait(self, event: threading.Event,
+             timeout: Optional[float] = None) -> bool:
+        return event.wait(timeout)
+
+    def event(self) -> threading.Event:
+        return threading.Event()
+
+
+#: process-wide default: components constructed without a clock use this
+SYSTEM_CLOCK = SystemClock()
+
+
+class _Waiter:
+    __slots__ = ("seq", "deadline", "event", "go", "released")
+
+    def __init__(self, seq: int, deadline: Optional[float], event):
+        self.seq = seq
+        self.deadline = deadline
+        self.event = event
+        self.go = threading.Event()     # real: set exactly at release
+        self.released = False
+
+
+class _VirtualEvent(threading.Event):
+    """``threading.Event`` whose ``set()`` notifies the virtual clock,
+    so a parked waiter is woken at the scheduler's next settle point
+    (deterministically) instead of at the backstop poll."""
+
+    def __init__(self, clock: "VirtualClock"):
+        super().__init__()
+        self._clock = clock
+
+    def set(self) -> None:  # noqa: A003 — threading.Event API
+        super().set()
+        self._clock._notify()
+
+
+class VirtualClock:
+    """Deterministic cooperative virtual time (see module docstring).
+
+    Threads that intend to block through this clock must register as
+    *participants* (:meth:`register` / :meth:`unregister`, or the
+    :meth:`participant` context manager).  The driving thread — which
+    must NOT be a participant — advances time with :meth:`advance_to`
+    and drains pending wake-ups with :meth:`settle`; both block until
+    every participant is parked again, so at any moment at most one
+    participant runs: the whole simulation is one deterministic
+    interleaving.
+
+    ``advance(dt)`` without any participants degrades to a plain
+    settable clock — the deterministic-test mode TokenBucket-style
+    components use (``clk.advance(10)`` makes ``now()`` jump).
+    """
+
+    virtual = True
+
+    def __init__(self, start: float = 0.0, epoch: float = 0.0):
+        self._now = float(start)
+        self._epoch = float(epoch)
+        self._cond = threading.Condition()
+        self._seq = 0
+        self._waiters: Dict[int, _Waiter] = {}
+        self._participants = 0
+        self._running = 0        # participants not currently parked
+
+    # -- reading time --------------------------------------------------------
+
+    def now(self) -> float:
+        with self._cond:
+            return self._now
+
+    def time(self) -> float:
+        with self._cond:
+            return self._epoch + self._now
+
+    def event(self) -> threading.Event:
+        return _VirtualEvent(self)
+
+    # -- participants --------------------------------------------------------
+
+    def register(self) -> None:
+        """The calling thread will block through this clock; it counts
+        as *running* until it parks."""
+        with self._cond:
+            self._participants += 1
+            self._running += 1
+            self._cond.notify_all()
+
+    def unregister(self) -> None:
+        with self._cond:
+            self._participants -= 1
+            self._running -= 1
+            self._cond.notify_all()
+
+    def participant(self):
+        """``with clock.participant():`` around a worker thread's body."""
+        clock = self
+
+        class _Ctx:
+            def __enter__(self):
+                clock.register()
+                return clock
+
+            def __exit__(self, *exc):
+                clock.unregister()
+                return False
+
+        return _Ctx()
+
+    @property
+    def participants(self) -> int:
+        with self._cond:
+            return self._participants
+
+    # -- blocking ------------------------------------------------------------
+
+    def sleep(self, seconds: float) -> None:
+        self.wait(None, max(0.0, float(seconds)))
+
+    def wait(self, event: Optional[threading.Event],
+             timeout: Optional[float] = None) -> bool:
+        """Park until ``event`` is set or virtual ``timeout`` elapses.
+        With ``event=None`` this is a pure virtual sleep.  Returns what
+        ``Event.wait`` would (True = event set)."""
+        with self._cond:
+            if event is not None and event.is_set():
+                return True
+            if timeout is not None and timeout <= 0:
+                return False
+            deadline = None if timeout is None else self._now + timeout
+            self._seq += 1
+            w = _Waiter(self._seq, deadline, event)
+            self._waiters[w.seq] = w
+            self._running -= 1
+            self._cond.notify_all()
+        try:
+            while True:
+                w.go.wait(_BACKSTOP_S)
+                with self._cond:
+                    w.go.clear()
+                    if event is not None and event.is_set():
+                        return True
+                    if w.deadline is not None and \
+                            self._now >= w.deadline - 1e-12:
+                        return False
+                    # spurious wake (backstop poll, never a release —
+                    # releases only fire once the wake condition holds,
+                    # and both conditions are stable): keep waiting
+        finally:
+            with self._cond:
+                del self._waiters[w.seq]
+                if not w.released:
+                    # self-wake (foreign event seen by the backstop):
+                    # the release path already credited _running
+                    self._running += 1
+                self._cond.notify_all()
+
+    # -- driving -------------------------------------------------------------
+
+    def _notify(self) -> None:
+        """A virtual event was set: let settle()/advance_to() reevaluate
+        which waiters became ready."""
+        with self._cond:
+            self._cond.notify_all()
+
+    def _ready_locked(self) -> Optional[_Waiter]:
+        """The next waiter whose wake condition already holds (event set,
+        or deadline reached), in registration order — the serialized
+        release discipline determinism rests on."""
+        best = None
+        for w in self._waiters.values():
+            if w.released:
+                continue
+            ready = (w.event is not None and w.event.is_set()) or (
+                w.deadline is not None and w.deadline <= self._now + 1e-12)
+            if ready and (best is None or w.seq < best.seq):
+                best = w
+        return best
+
+    def _release_locked(self, w: _Waiter) -> None:
+        # the thread counts as RUNNING from the instant of release —
+        # settle() must not release a second waiter while the first is
+        # still waking up, or two participants would run concurrently
+        # and the schedule would stop being deterministic
+        w.released = True
+        self._running += 1
+        w.go.set()
+
+    def settle(self) -> None:
+        """Release every waiter whose wake condition holds, one at a
+        time, waiting for the woken thread (and anything it wakes in
+        turn) to park again before releasing the next.  Returns once all
+        participants are parked and nothing further is ready."""
+        limit = time.monotonic() + _STALL_LIMIT_S
+        with self._cond:
+            while True:
+                if self._running > 0:
+                    if not self._cond.wait(_BACKSTOP_S) and \
+                            time.monotonic() > limit:
+                        raise RuntimeError(
+                            f"virtual clock stalled: {self._running} "
+                            f"participant(s) running outside the clock "
+                            f"for > {_STALL_LIMIT_S:.0f}s real")
+                    continue
+                w = self._ready_locked()
+                if w is None:
+                    return
+                self._release_locked(w)
+                limit = time.monotonic() + _STALL_LIMIT_S
+
+    def next_deadline(self) -> Optional[float]:
+        """Earliest parked deadline (None if nobody has one) — what the
+        driving loop advances to when it has no earlier work of its
+        own."""
+        with self._cond:
+            deadlines = [w.deadline for w in self._waiters.values()
+                         if w.deadline is not None and not w.released]
+            return min(deadlines) if deadlines else None
+
+    def advance_to(self, t: float) -> None:
+        """Move virtual time to ``t``, firing due sleepers strictly in
+        (deadline, registration) order with a full settle between
+        firings."""
+        self.settle()
+        while True:
+            with self._cond:
+                due = [w for w in self._waiters.values()
+                       if not w.released and w.deadline is not None
+                       and w.deadline <= t + 1e-12]
+                if not due:
+                    self._now = max(self._now, t)
+                    break
+                w = min(due, key=lambda w: (w.deadline, w.seq))
+                self._now = max(self._now, w.deadline)
+                self._release_locked(w)
+            self.settle()
+        self.settle()
+
+    def advance(self, dt: float) -> None:
+        self.advance_to(self.now() + float(dt))

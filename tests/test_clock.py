@@ -1,0 +1,167 @@
+"""Injectable time (utils/clock): SystemClock contract + VirtualClock
+determinism — the seam every latency-bearing component now runs on."""
+
+import threading
+import time
+
+from lzy_tpu.utils.clock import SYSTEM_CLOCK, SystemClock, VirtualClock
+
+
+def _start_parked(clock, target, *args):
+    """Start a participant thread and wait until it has parked (the
+    serialized-startup discipline the load driver uses)."""
+    before = clock.participants
+    t = threading.Thread(target=target, args=args, daemon=True)
+    t.start()
+    while clock.participants < before + 1:
+        time.sleep(0.0005)
+    clock.settle()
+    return t
+
+
+class TestSystemClock:
+    def test_now_is_monotonic_and_time_is_wall(self):
+        c = SystemClock()
+        a, b = c.now(), c.now()
+        assert b >= a
+        assert abs(c.time() - time.time()) < 5.0
+
+    def test_wait_and_event(self):
+        c = SystemClock()
+        ev = c.event()
+        assert isinstance(ev, threading.Event)
+        assert c.wait(ev, timeout=0.01) is False
+        ev.set()
+        assert c.wait(ev, timeout=0.01) is True
+
+    def test_module_singleton(self):
+        assert isinstance(SYSTEM_CLOCK, SystemClock)
+
+
+class TestVirtualClockBasics:
+    def test_advance_without_participants(self):
+        c = VirtualClock()
+        assert c.now() == 0.0
+        c.advance(10.5)
+        assert c.now() == 10.5
+        c.advance_to(7.0)           # time never goes backwards
+        assert c.now() == 10.5
+
+    def test_time_offsets_by_epoch(self):
+        c = VirtualClock(epoch=1000.0)
+        c.advance(5.0)
+        assert c.time() == 1005.0
+
+    def test_token_bucket_on_virtual_clock(self):
+        """The original injectable-clock consumer still composes: a
+        bucket drained at t=0 refills exactly with advance()."""
+        from lzy_tpu.serving.tenancy import TokenBucket
+
+        c = VirtualClock()
+        bucket = TokenBucket(1.0, 2.0, clock=c.now)
+        assert bucket.try_take(2.0) is None
+        wait = bucket.try_take(1.0)
+        assert wait == 1.0          # deterministic: virtual time
+        c.advance(1.0)
+        assert bucket.try_take(1.0) is None
+
+
+class TestVirtualClockScheduling:
+    def test_sleepers_fire_in_deadline_then_seq_order(self):
+        c = VirtualClock()
+        order = []
+
+        def worker(name, delay):
+            with c.participant():
+                c.sleep(delay)
+                order.append((name, c.now()))
+
+        for name, delay in (("a", 2.0), ("b", 1.0), ("c", 2.0)):
+            _start_parked(c, worker, name, delay)
+        c.advance_to(3.0)
+        # b first (earlier deadline); a before c (registered earlier)
+        assert order == [("b", 1.0), ("a", 2.0), ("c", 2.0)]
+        assert c.now() == 3.0
+
+    def test_event_set_wakes_waiter_at_settle(self):
+        c = VirtualClock()
+        ev = c.event()
+        out = {}
+
+        def waiter():
+            with c.participant():
+                out["flag"] = c.wait(ev, timeout=100.0)
+                out["t"] = c.now()
+
+        t = _start_parked(c, waiter)
+        c.advance_to(3.0)
+        assert "flag" not in out
+        ev.set()
+        c.settle()
+        t.join(5.0)
+        assert out == {"flag": True, "t": 3.0}
+
+    def test_wait_timeout_fires_on_advance(self):
+        c = VirtualClock()
+        ev = c.event()
+        out = {}
+
+        def waiter():
+            with c.participant():
+                out["flag"] = c.wait(ev, timeout=2.5)
+                out["t"] = c.now()
+
+        t = _start_parked(c, waiter)
+        c.advance_to(10.0)
+        t.join(5.0)
+        assert out == {"flag": False, "t": 2.5}
+
+    def test_interleaving_is_deterministic(self):
+        """Two identical multi-thread schedules produce the identical
+        event order — the property every capacity metric rests on."""
+
+        def run_once():
+            c = VirtualClock()
+            log = []
+
+            def worker(name, period, n):
+                with c.participant():
+                    for i in range(n):
+                        c.sleep(period)
+                        log.append((name, round(c.now(), 6)))
+
+            for name, period in (("x", 0.7), ("y", 1.1), ("z", 0.7)):
+                _start_parked(c, worker, name, period, 5)
+            c.advance_to(10.0)
+            return log
+
+        assert run_once() == run_once()
+
+    def test_request_wait_on_virtual_clock(self):
+        """serving.scheduler.Request composes: finish() from the driving
+        thread wakes a virtually-parked waiter; deadlines expire on
+        virtual time."""
+        from lzy_tpu.serving.scheduler import Request
+
+        c = VirtualClock()
+        req = Request([1, 2, 3], 4, deadline_s=5.0, clock=c)
+        out = {}
+
+        def waiter():
+            with c.participant():
+                out["done"] = req.wait(timeout=60.0)
+                out["t"] = c.now()
+
+        t = _start_parked(c, waiter)
+        c.advance_to(2.0)
+        assert not req.expired
+        req.finish()
+        c.settle()
+        t.join(5.0)
+        assert out == {"done": True, "t": 2.0}
+        c.advance_to(10.0)
+        # the deadline is virtual too (finished requests just don't care)
+        req2 = Request([1], 1, deadline_s=1.0, clock=c)
+        assert not req2.expired
+        c.advance(1.5)
+        assert req2.expired

@@ -491,3 +491,94 @@ class TestDisaggRpc:
                 client.close()
         finally:
             cluster.shutdown()
+
+
+class TestGlobalIndexInPreSubmit:
+    """Satellite (ROADMAP item 2 remainder): the disagg ``_pre_submit``
+    also consults the fleet-global KV index — prefill-pool staging keeps
+    priority, but when the pool lands nothing, a DECODE-pool sibling
+    holding a deeper chain than the routed replica's own radix+tier
+    coverage is imported where previously the request always
+    re-prefilled locally."""
+
+    def _make(self, cfg, params, *, prefill=0, decode=2):
+        from lzy_tpu.gateway import GlobalKVIndex, RoundRobinRouter
+
+        decode_fleet = ReplicaFleet(
+            lambda: DecodeEngine(cfg, params, slots=2, page_size=PAGE,
+                                 kv_blocks=32),
+            replica_prefix="decode")
+        prefill_fleet = ReplicaFleet(
+            lambda: PrefillEngine(cfg, params, slots=2, page_size=PAGE,
+                                  kv_blocks=32),
+            replica_prefix="prefill")
+        gw = DisaggGatewayService(
+            decode_fleet, prefill_fleet, page_size=PAGE,
+            # round-robin pins request i to decode replica (i % N): the
+            # second request DETERMINISTICALLY lands on the cold sibling
+            router=RoundRobinRouter(PAGE),
+            prefill_router=RoundRobinRouter(PAGE),
+            prefill_replicas=prefill, model_name="tiny",
+            kv_index=GlobalKVIndex(PAGE))
+        for _ in range(decode):
+            decode_fleet.add_replica()
+        for _ in range(prefill):
+            prefill_fleet.add_replica()
+        return gw, decode_fleet
+
+    def test_decode_sibling_import_replaces_reprefill(self, tiny_model):
+        """Prefill pool EMPTY (every staging falls back): request 2,
+        routed to the cold decode replica, imports the warm sibling's
+        blocks instead of re-prefilling — bit-identical output, import
+        counted on the cold engine, prefill tokens saved."""
+        cfg, params = tiny_model
+        gw, dfleet = self._make(cfg, params, prefill=0)
+        try:
+            shared = list(range(1, 4 * PAGE + 1))
+            r1 = gw.generate(shared + [5], max_new_tokens=6,
+                             timeout_s=120)
+            assert r1["tokens"] == _oracle_tokens(cfg, params,
+                                                  shared + [5], 6)
+            assert r1["prefilled_by"] is None       # pool is empty
+            assert r1["reprefills"] == 1            # fallback counted
+            gw.tick()       # decode replicas advertise into the index
+            r2 = gw.generate(shared + [9], max_new_tokens=6,
+                             timeout_s=120)
+            assert r2["tokens"] == _oracle_tokens(cfg, params,
+                                                  shared + [9], 6)
+            assert r2["replica"] != r1["replica"]
+            # the import was staged from the decode-pool SIBLING (not a
+            # prefill replica) and the prefix match really hit it
+            assert r2["kv_import_staged_from"] == r1["replica"]
+            assert r2["kv_import_from"] == r1["replica"]
+            assert r2["kv_import_tier"] == "hbm"
+            cold = dfleet.get(r2["replica"]).engine
+            assert cold.kv_imports == 1
+            assert cold.kv.stats().prefill_tokens_saved >= 4 * PAGE
+            stats = gw.stats()
+            assert stats["kvtier_imports"] == 1
+            assert stats["reprefill_fallbacks"] == 2
+        finally:
+            gw.close()
+
+    def test_prefill_pool_keeps_priority(self, tiny_model):
+        """With a live prefill pool, staging comes from it and the
+        global index is NOT consulted (no cross-replica import)."""
+        cfg, params = tiny_model
+        gw, dfleet = self._make(cfg, params, prefill=1)
+        try:
+            shared = list(range(1, 4 * PAGE + 1))
+            r1 = gw.generate(shared + [5], max_new_tokens=4,
+                             timeout_s=120)
+            assert r1["kv_staged_by"] is not None
+            assert r1["kv_staged_by"].startswith("prefill-")
+            gw.tick()
+            r2 = gw.generate(shared + [9], max_new_tokens=4,
+                             timeout_s=120)
+            assert r2["tokens"] == _oracle_tokens(cfg, params,
+                                                  shared + [9], 4)
+            # the prefill pool staged (or the router expected residency);
+            # either way no decode-sibling import was needed
+            assert gw.stats()["kvtier_imports"] == 0
+        finally:
+            gw.close()

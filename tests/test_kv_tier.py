@@ -508,3 +508,70 @@ class TestKvTierChaos:
         finally:
             CHAOS.disarm()
             gw.close()
+
+
+class TestBatchedDemotionGathers:
+    """Satellite (ROADMAP item 2 remainder): one eviction round's
+    per-block device→host copies coalesce into a single gather per
+    cache leaf (``RadixCache.on_evict_batch`` →
+    ``PagedInferenceEngine._demote_blocks``)."""
+
+    def test_one_gather_per_leaf_per_eviction_round(self, tiny_model):
+        cfg, params = tiny_model
+        # pool: 1 scratch + 6 usable. Request A caches a 4-block chain;
+        # request B (disjoint 4-block prompt + growth) then needs more
+        # than the free list holds — ONE allocate call evicts several of
+        # A's blocks in a single round.
+        eng = PagedInferenceEngine(cfg, params, slots=1, page_size=PAGE,
+                                   kv_blocks=7,
+                                   kv_host_tier_bytes=8 << 20)
+        try:
+            a = list(range(1, 4 * PAGE + 1)) + [3]
+            b = [(11 * i) % 60 + 1 for i in range(4 * PAGE)] + [9]
+            assert _run(eng, a) == _oracle(cfg, params, a, 6)
+            assert eng.kv_tier_gather_rounds == 0
+            rounds_before = eng.kv_tier_gather_rounds
+            ops_before = eng.kv_tier_gather_ops
+            demoted_before = eng.kv_tier.stats()["demotions"]
+            assert _run(eng, b) == _oracle(cfg, params, b, 6)
+            rounds = eng.kv_tier_gather_rounds - rounds_before
+            ops = eng.kv_tier_gather_ops - ops_before
+            demoted = eng.kv_tier.stats()["demotions"] - demoted_before
+            n_leaves = sum(1 for k in eng._kv_leaf_keys()
+                           if k is not None)
+            # the count-of-transfers contract: >= 2 blocks demoted in
+            # ONE round, paying exactly one gather PER LEAF — not one
+            # per (leaf x block) as the per-block path did
+            assert demoted >= 2, demoted
+            assert rounds == 1, (rounds, demoted)
+            assert ops == n_leaves, (ops, n_leaves, demoted)
+            # demoted payloads are real: each chain is promotable
+            assert eng.kv_tier.stats()["host_blocks"] == demoted
+            audit_engine(eng)
+            audit_kv_tier(eng.kv, eng.kv_tier)
+        finally:
+            eng.close()
+
+    def test_batched_demotions_promote_back_bit_identical(self,
+                                                          tiny_model):
+        """The batched payloads are byte-correct: re-running the evicted
+        prompt promotes the demoted chain back and the output stays
+        bit-identical with prefill tokens saved."""
+        cfg, params = tiny_model
+        eng = PagedInferenceEngine(cfg, params, slots=1, page_size=PAGE,
+                                   kv_blocks=7,
+                                   kv_host_tier_bytes=8 << 20)
+        try:
+            a = list(range(1, 4 * PAGE + 1)) + [3]
+            b = [(11 * i) % 60 + 1 for i in range(4 * PAGE)] + [9]
+            _run(eng, a)
+            _run(eng, b)                     # batch-demotes A's chain
+            saved_before = eng.kv.stats().prefill_tokens_saved
+            promoted_before = eng.kv_tier.stats()["promotions"]
+            assert _run(eng, a) == _oracle(cfg, params, a, 6)
+            assert eng.kv_tier.stats()["promotions"] > promoted_before
+            assert eng.kv.stats().prefill_tokens_saved > saved_before
+            audit_engine(eng)
+            audit_kv_tier(eng.kv, eng.kv_tier)
+        finally:
+            eng.close()

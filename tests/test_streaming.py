@@ -391,7 +391,12 @@ class TestSlowConsumerShed:
         cfg, params = tiny_model
         svc, engine = _service(cfg, params)
         svc.streams.ack_window = 4
-        svc.streams.stall_grace_s = 0.2
+        # short grace: with a warm XLA compilation cache the tiny model
+        # decodes ~1ms/token, and a 0.2s grace let the 200-token request
+        # FINISH before the stall window elapsed (the shed never fired
+        # and the test flaked fast-machine-dependently); 0.05s still
+        # spans dozens of decode rounds past the ack window
+        svc.streams.stall_grace_s = 0.05
         try:
             before = _counter(SHED_SLOW)
             opened = svc.streams.open([5, 9], max_new_tokens=200,

@@ -298,6 +298,55 @@ class TestSloLimiter:
         req_bucket = slo._buckets["t"][0]
         assert req_bucket.level() == pytest.approx(99.0)
 
+    def test_enforced_backoff_punishes_hammering(self):
+        """Load-harness finding (ISSUE 13): with an ADVISORY hint, a
+        client polling the bucket every few ms grabs each refilled token
+        ahead of everyone who honored the hint — misbehavior won
+        throughput. With ``enforce_backoff=True`` an early return is
+        refused AND extends the tenant's window, so hammering starves
+        itself while the hint-honoring schedule is served on time."""
+        clock = FakeClock()
+        table = TenantTable(default=TenantPolicy(requests_per_s=1.0,
+                                                 burst_s=1.0))
+        slo = SloLimiter(table, clock=clock, enforce_backoff=True,
+                         backoff_step_s=0.05)
+        slo.admit("ham", 4)
+        with pytest.raises(QuotaExceeded) as ei:
+            slo.admit("ham", 4)
+        hint = ei.value.retry_after_s
+        assert hint and hint > 0
+        # hammer: returns every 10 ms ignoring the hint — every poll is
+        # refused with reason="backoff" and pushes the window out, so
+        # even past the ORIGINAL hint the tenant stays refused
+        polls = 0
+        for _ in range(200):
+            clock.advance(0.01)
+            with pytest.raises(QuotaExceeded) as ei2:
+                slo.admit("ham", 4)
+            polls += 1
+            if clock.t - 1000.0 > hint + 0.5:
+                break
+        assert ei2.value.reason == "backoff"
+        assert polls > 10
+        # a polite tenant with the same policy: refused once, waits out
+        # ITS hint, admitted on schedule
+        slo.admit("pol", 4)
+        with pytest.raises(QuotaExceeded) as ei3:
+            slo.admit("pol", 4)
+        clock.advance(ei3.value.retry_after_s + 0.001)
+        slo.admit("pol", 4)     # honoring the hint still wins service
+
+    def test_backoff_enforcement_off_by_default(self):
+        clock = FakeClock()
+        table = TenantTable(default=TenantPolicy(requests_per_s=1.0,
+                                                 burst_s=1.0))
+        slo = SloLimiter(table, clock=clock)
+        slo.admit("t", 4)
+        with pytest.raises(QuotaExceeded):
+            slo.admit("t", 4)
+        clock.advance(1.0)      # refilled: advisory mode admits again
+        slo.admit("t", 4)
+
 
 # ---------------------------------------------------------------------------
 # chunked prefill: decode interleave + bit identity

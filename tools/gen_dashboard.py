@@ -67,6 +67,9 @@ def registry_metrics():
     # workflow-native inference: generations, cached hits, stream
     # resumptions, conversation affinity (lzy_llm_*)
     import lzy_tpu.llm.metrics  # noqa: F401
+    # load plane: trace-replay requests/retries, virtual-time TTFT and
+    # inter-token histograms, replay speedup, shed rate (lzy_load_*)
+    import lzy_tpu.load.driver  # noqa: F401
     from lzy_tpu.utils.metrics import Counter, Gauge, Histogram, REGISTRY
 
     kinds = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
