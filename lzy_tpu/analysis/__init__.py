@@ -1,0 +1,57 @@
+"""lzy-lint: whole-tree static analysis for the classes of bug this
+repo has actually shipped.
+
+Three of the worst bugs in this platform's history were members of
+statically detectable classes and were found late, at runtime:
+
+- **PR 5** — donated-buffer aliasing: ``jnp.asarray`` zero-copied the
+  same numpy memory into identical device buffers, so donating the
+  cache handed XLA the same buffer twice (intermittent segfault /
+  silent corruption).
+- **PR 6** — self-deadlock: ``retry_after_s`` was computed under the
+  engine's own non-reentrant lock, by a call path that re-acquired the
+  same lock.
+- **PR 12** — the affinity router re-sorted its whole chain index
+  under the router lock on every routed request once at capacity.
+
+This package makes those classes (and two more the fleet depends on:
+the injectable-clock invariant and the chaos fault-point contracts)
+*unshippable*: four AST-driven passes run over the live tree, a
+checked-in baseline ratchets the count at zero, and
+``tests/test_analysis.py`` fails tier-1 on any new violation.
+
+Passes (see :mod:`lzy_tpu.analysis.core` for the rule registry):
+
+- :mod:`~lzy_tpu.analysis.locks` — lock-order inversions,
+  non-reentrant self-reacquisition, blocking operations under a lock;
+- :mod:`~lzy_tpu.analysis.jaxpass` — donation aliasing, host-device
+  sync in engine hot loops, Python ``if`` on traced values;
+- :mod:`~lzy_tpu.analysis.clocks` — raw ``time.time/monotonic/sleep``
+  outside ``utils/clock.py`` and the justified allowlist;
+- :mod:`~lzy_tpu.analysis.chaos_contracts` — every registered fault
+  point is hit, its typed error is caught on a degradation path, and
+  every survivable-crash declaration has a death handler.
+
+Run ``python -m lzy_tpu.analysis`` (``--json`` for CI) or see
+``docs/analysis.md`` for the suppression / allowlist syntax.
+"""
+
+from lzy_tpu.analysis.core import (
+    AnalysisResult,
+    Baseline,
+    ProjectIndex,
+    Violation,
+    load_baseline,
+    load_tree,
+    run_passes,
+)
+
+__all__ = [
+    "AnalysisResult",
+    "Baseline",
+    "ProjectIndex",
+    "Violation",
+    "load_baseline",
+    "load_tree",
+    "run_passes",
+]

@@ -1,0 +1,99 @@
+"""``python -m lzy_tpu.analysis`` — run lzy-lint over the live tree.
+
+Exit status 0 means the ratchet holds (no violation outside the
+checked-in baseline); 1 means new violations; 2 means usage error.
+``--json`` emits a machine-readable document (violations, suppressed
+findings, the lock-site inventory and the chaos registry summary) for
+CI and dashboards.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from lzy_tpu.analysis import core
+from lzy_tpu.analysis.chaos_contracts import registry_summary
+from lzy_tpu.analysis.locks import lock_sites
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
+
+
+def default_root() -> Path:
+    import lzy_tpu
+
+    return Path(lzy_tpu.__file__).resolve().parent
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m lzy_tpu.analysis",
+        description="lzy-lint: whole-tree static analysis "
+                    "(lock discipline, JAX hazards, clock discipline, "
+                    "chaos contracts)")
+    ap.add_argument("--root", type=Path, default=None,
+                    help="tree to analyze (default: the installed "
+                         "lzy_tpu package)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output (violations + "
+                         "lock-site inventory + chaos registry)")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated subset of "
+                         "locks,jax,clock,chaos (default: all)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every unsuppressed violation, "
+                         "ignoring the checked-in baseline")
+    ap.add_argument("--baseline", type=Path, default=None,
+                    help="alternate baseline.json")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print findings silenced by justified "
+                         "suppressions")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(core.RULES.items()):
+            print(f"{rule}\n    {desc}")
+        return 0
+
+    t0 = SYSTEM_CLOCK.now()
+    root = args.root if args.root is not None else default_root()
+    index = core.load_tree(root)
+    passes = tuple(p.strip() for p in args.passes.split(",")) \
+        if args.passes else None
+    result = core.run_passes(index, passes)
+    baseline = core.Baseline(frozenset()) if args.no_baseline \
+        else core.load_baseline(args.baseline)
+    new = baseline.new_violations(result)
+    elapsed = SYSTEM_CLOCK.now() - t0
+
+    if args.json:
+        doc = result.to_doc()
+        doc["new_violations"] = [v.fingerprint for v in new]
+        doc["elapsed_s"] = round(elapsed, 3)
+        doc["files"] = len(index.modules)
+        doc["lock_sites"] = lock_sites(index)
+        doc["chaos_registry"] = registry_summary(index)
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        for v in result.violations:
+            marker = "" if v.fingerprint in baseline.accepted \
+                else " [NEW]"
+            print(f"{v.render()}{marker}")
+        if args.show_suppressed:
+            for v in result.suppressed:
+                print(f"{v.render()} [suppressed]")
+        by_rule = result.by_rule()
+        summary = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items())) \
+            or "clean"
+        print(f"lzy-lint: {len(index.modules)} files, "
+              f"passes={','.join(result.passes_run)}, "
+              f"{len(result.violations)} violation(s) "
+              f"({len(new)} new), {len(result.suppressed)} "
+              f"suppressed, {elapsed:.2f}s  [{summary}]")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

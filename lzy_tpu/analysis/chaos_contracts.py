@@ -1,0 +1,261 @@
+"""Chaos-contract pass: cross-check the fault-point registry.
+
+PR 6's whole design is that fault points are *registered contracts*:
+each ``CHAOS.register(name, error=..., crash_ok=...)`` declares the
+typed error its callers' degradation path catches and whether a hard
+``InjectedCrash`` is survivable there.  The soak exercises those
+contracts dynamically; this pass makes the *declarations themselves*
+checkable statically, so a contract can't rot between soaks:
+
+- ``chaos-unregistered-hit`` — ``CHAOS.hit("x")`` with a literal name
+  no module registers: the typo'd point would raise at runtime the
+  first time a plan is armed (and silently never fire until then).
+- ``chaos-unhit-point`` — a registered point with no ``hit()`` site:
+  a dead contract the soak believes it is covering.
+- ``chaos-uncaught-error`` — for points whose mode set includes
+  ``ERROR``: the declared error class must be caught somewhere — by a
+  *typed* handler (the class or a statically-known ancestor, anywhere
+  in the tree), or by a generic ``except Exception``/``BaseException``
+  in the hit module or a module that imports it (the advisory-path
+  idiom: "demotion is advisory" catches broadly at the caller).  This
+  is an approximation of "caught on a caller degradation path" — it
+  has no dataflow — but it forces every NEW point with a NEW error
+  class to ship a handler, which is the regression that matters.
+- ``chaos-crash-unhandled`` — a ``crash_ok=True`` point's hit module
+  must contain an ``InjectedCrash``/``BaseException`` handler: the
+  declared "survivable" failure domain must actually have its death
+  handler where the crash is raised.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Set, Tuple
+
+from lzy_tpu.analysis.core import ProjectIndex, Violation, dotted
+
+#: ancestor links for builtin exception classes the registry uses, so
+#: e.g. `except OSError` satisfies a point declaring `ConnectionError`
+_BUILTIN_BASES: Dict[str, Tuple[str, ...]] = {
+    "IOError": ("OSError",),
+    "ConnectionError": ("OSError",),
+    "TimeoutError": ("OSError",),
+    "FileNotFoundError": ("OSError",),
+    "KeyError": ("LookupError",),
+    "IndexError": ("LookupError",),
+    "RuntimeError": (),
+    "OSError": (),
+    "ValueError": (),
+    "LookupError": (),
+}
+_GENERIC = {"Exception", "BaseException"}
+
+
+@dataclasses.dataclass
+class _Point:
+    name: str
+    path: str
+    line: int
+    error: str                 # class name leaf
+    crash_ok: bool
+    has_error_mode: bool
+
+
+def _leaf(name: str) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _is_chaos_call(node: ast.Call, method: str) -> bool:
+    name = dotted(node.func)
+    if not name:
+        return False
+    parts = name.split(".")
+    return parts[-1] == method and len(parts) >= 2 and \
+        parts[-2] == "CHAOS"
+
+
+def _collect_points(index: ProjectIndex) -> List[_Point]:
+    points: List[_Point] = []
+    for mod in index:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_chaos_call(node, "register")):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            error = "InjectedFault"
+            crash_ok = False
+            has_error_mode = True          # default modes include ERROR
+            for kw in node.keywords:
+                if kw.arg == "error":
+                    error = _leaf(dotted(kw.value)) or error
+                elif kw.arg == "crash_ok":
+                    crash_ok = bool(getattr(kw.value, "value", False))
+                elif kw.arg == "modes":
+                    mode_names = {_leaf(dotted(e))
+                                  for e in getattr(kw.value, "elts", ())}
+                    has_error_mode = "ERROR" in mode_names
+            points.append(_Point(node.args[0].value, mod.path,
+                                 node.lineno, error, crash_ok,
+                                 has_error_mode))
+    return points
+
+
+def _collect_hits(index: ProjectIndex) -> List[Tuple[str, str, int]]:
+    hits: List[Tuple[str, str, int]] = []
+    for mod in index:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_chaos_call(node, "hit")):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                hits.append((node.args[0].value, mod.path, node.lineno))
+    return hits
+
+
+def _handler_names(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is not None:
+            exprs = node.type.elts if isinstance(node.type, ast.Tuple) \
+                else [node.type]
+            for e in exprs:
+                leaf = _leaf(dotted(e))
+                if leaf:
+                    out.add(leaf)
+    return out
+
+
+def _class_bases(index: ProjectIndex) -> Dict[str, Set[str]]:
+    bases: Dict[str, Set[str]] = {}
+    for mod in index:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                bs = {_leaf(dotted(b)) for b in node.bases}
+                bases.setdefault(node.name, set()).update(b for b in bs
+                                                          if b)
+    for name, builtin in _BUILTIN_BASES.items():
+        bases.setdefault(name, set()).update(builtin)
+    return bases
+
+
+def _ancestors(name: str, bases: Dict[str, Set[str]]) -> Set[str]:
+    out: Set[str] = set()
+    frontier = [name]
+    while frontier:
+        cur = frontier.pop()
+        for b in bases.get(cur, ()):
+            if b not in out:
+                out.add(b)
+                frontier.append(b)
+    return out
+
+
+def _module_imports(tree: ast.Module) -> Set[str]:
+    """Dotted module origins this module imports (lzy_tpu.* only)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            out.update(a.name for a in node.names)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            out.add(node.module)
+    return out
+
+
+def _path_to_module(path: str) -> str:
+    mod = path[:-3] if path.endswith(".py") else path
+    mod = mod.replace("/", ".")
+    return mod[:-len(".__init__")] if mod.endswith(".__init__") else mod
+
+
+def run(index: ProjectIndex) -> List[Violation]:
+    points = _collect_points(index)
+    hits = _collect_hits(index)
+    bases = _class_bases(index)
+    registered = {p.name for p in points}
+    hit_names = {h[0] for h in hits}
+    hit_modules: Dict[str, Set[str]] = {}
+    for name, path, _line in hits:
+        hit_modules.setdefault(name, set()).add(path)
+
+    handlers_by_mod = {mod.path: _handler_names(mod.tree)
+                       for mod in index}
+    typed_handlers: Set[str] = set()
+    for hs in handlers_by_mod.values():
+        typed_handlers |= hs - _GENERIC
+    imports_by_mod = {mod.path: _module_imports(mod.tree)
+                      for mod in index}
+
+    out: List[Violation] = []
+
+    for name, path, line in hits:
+        if name not in registered:
+            out.append(Violation(
+                "chaos-unregistered-hit", path, line,
+                f"CHAOS.hit({name!r}) but no module registers that "
+                f"point — it would raise KeyError the first time a "
+                f"plan is armed"))
+
+    for p in points:
+        if p.name not in hit_names:
+            out.append(Violation(
+                "chaos-unhit-point", p.path, p.line,
+                f"fault point {p.name!r} is registered but never hit — "
+                f"the soak believes it covers a boundary that does not "
+                f"exist"))
+            continue
+        mods = hit_modules[p.name]
+        if p.has_error_mode:
+            ok = p.error in typed_handlers or bool(
+                _ancestors(p.error, bases) & typed_handlers)
+            if not ok:
+                # advisory idiom: a generic handler counts when it sits
+                # at the boundary (the hit module) or a direct caller
+                # (a module importing the hit module)
+                hit_dotted = {_path_to_module(m) for m in mods}
+                for mod_path, hs in handlers_by_mod.items():
+                    if not (hs & _GENERIC):
+                        continue
+                    if mod_path in mods or any(
+                            imp in hit_dotted or any(
+                                imp.startswith(h + ".")
+                                for h in hit_dotted)
+                            for imp in imports_by_mod[mod_path]):
+                        ok = True
+                        break
+            if not ok:
+                out.append(Violation(
+                    "chaos-uncaught-error", p.path, p.line,
+                    f"fault point {p.name!r} declares error "
+                    f"{p.error} but no caller degradation path "
+                    f"catches it (no typed handler for {p.error} or an "
+                    f"ancestor, and no generic handler near the hit "
+                    f"site)"))
+        if p.crash_ok:
+            has_death_handler = any(
+                ("InjectedCrash" in handlers_by_mod[m]
+                 or "BaseException" in handlers_by_mod[m])
+                for m in mods)
+            if not has_death_handler:
+                out.append(Violation(
+                    "chaos-crash-unhandled", p.path, p.line,
+                    f"fault point {p.name!r} declares crash_ok=True "
+                    f"but no hit-site module has an InjectedCrash/"
+                    f"BaseException death handler"))
+    return out
+
+
+def registry_summary(index: ProjectIndex) -> List[dict]:
+    """The declared registry as data (for --json consumers)."""
+    points = _collect_points(index)
+    hits = _collect_hits(index)
+    by_name: Dict[str, List[str]] = {}
+    for name, path, line in hits:
+        by_name.setdefault(name, []).append(f"{path}:{line}")
+    return [{"point": p.name, "declared_at": f"{p.path}:{p.line}",
+             "error": p.error, "crash_ok": p.crash_ok,
+             "hits": sorted(by_name.get(p.name, ()))}
+            for p in sorted(points, key=lambda p: p.name)]

@@ -1,0 +1,343 @@
+"""lzy-lint core: tree loading, suppressions, rule registry, baseline.
+
+The analyzers in this package are *whole-tree* passes: they parse every
+``lzy_tpu`` module once into a :class:`ProjectIndex` (source + AST +
+suppression comments) and each pass walks that shared index.  Nothing
+here imports the modules under analysis — the tree is analyzed purely
+syntactically, so a pass can run against a broken or partially-stubbed
+checkout (and against the synthetic corpus under
+``tests/analysis_corpus/``).
+
+Suppression syntax (documented in ``docs/analysis.md``)::
+
+    some_call()   # lzy-lint: disable=lock-blocking-call -- one bounded
+                  #   storage probe; measured < 1ms, see PR 14
+
+- ``disable=<rule>[,<rule>...]`` names the rule(s) to silence on the
+  suppression's own line *or the line directly below it* (so a
+  standalone comment line above the offending statement works);
+- the justification after ``--`` is REQUIRED: a bare suppression is
+  itself a violation (``lint-bare-suppression``) that no suppression
+  can silence — the ratchet's whole point is that every exception to a
+  rule carries its reasoning in the diff.
+
+The baseline (``lzy_tpu/analysis/baseline.json``) is the ratchet: it
+lists the fingerprints of violations that are *known and accepted* (it
+ships empty — every real violation the passes surfaced was fixed in the
+PR that introduced them, and the file records those fixes as history).
+``tests/test_analysis.py`` fails on any violation whose fingerprint is
+not in the baseline, which makes every rule class unshippable going
+forward.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# -- rule registry ------------------------------------------------------------
+
+#: every rule the passes may emit, with a one-line description; __main__
+#: renders this as ``--list-rules`` and docs/analysis.md mirrors it
+RULES: Dict[str, str] = {
+    # locks pass
+    "lock-order-inversion":
+        "two locks are acquired in opposite orders on different code "
+        "paths — a potential deadlock cycle",
+    "lock-self-reacquire":
+        "a call path reachable while holding a non-reentrant "
+        "threading.Lock acquires the same lock again (the PR 6 "
+        "self-deadlock class)",
+    "lock-blocking-call":
+        "a blocking operation (sleep, event/queue wait, join, RPC "
+        "dispatch, storage I/O, device sync) is performed while "
+        "holding a lock (the PR 12 router-re-sort class)",
+    # jax pass
+    "jax-donation-alias":
+        "an argument donated to a jitted function can share a buffer "
+        "with another argument or a jnp.asarray'd host array (the "
+        "PR 5 donated-buffer segfault class)",
+    "jax-host-sync-hot-loop":
+        "a host-device synchronization (.item(), np.asarray, "
+        "device_get) inside a per-item loop of an engine "
+        "step/prefill/decode function",
+    "jax-traced-python-if":
+        "a Python `if`/`while` branches on a traced argument inside a "
+        "jitted function (trace-time error or silent specialization)",
+    # clock pass
+    "clock-raw-time":
+        "raw time.time/monotonic/sleep outside utils/clock.py and the "
+        "justified allowlist — the injectable-clock invariant (PR 12) "
+        "must not regress",
+    # chaos pass
+    "chaos-unregistered-hit":
+        "CHAOS.hit() names a fault point no module registers",
+    "chaos-unhit-point":
+        "a registered fault point has no hit() site — a dead contract",
+    "chaos-uncaught-error":
+        "a fault point's declared typed error is caught on no caller "
+        "degradation path",
+    "chaos-crash-unhandled":
+        "a crash_ok fault point's module has no InjectedCrash/"
+        "BaseException death handler",
+    # meta
+    "lint-bare-suppression":
+        "a lzy-lint disable comment carries no justification",
+    "lint-unknown-rule":
+        "a lzy-lint disable comment names a rule that does not exist",
+}
+
+#: rules that suppression comments can never silence
+_UNSUPPRESSABLE = frozenset({"lint-bare-suppression", "lint-unknown-rule"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One finding. ``symbol`` is the enclosing qualname (or '' for
+    module level); the fingerprint deliberately omits the line number so
+    unrelated edits above a known finding don't churn the baseline."""
+
+    rule: str
+    path: str               # repo-relative posix path
+    line: int
+    message: str
+    symbol: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}:{self.path}:{self.symbol}"
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{where}: {self.rule}{sym}: {self.message}"
+
+
+# -- suppressions -------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*lzy-lint:\s*disable=([A-Za-z0-9_,-]+)"
+    r"(?:\s*--\s*(\S.*))?")
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int
+    rules: Tuple[str, ...]
+    justification: str
+
+
+class SourceModule:
+    """One parsed file: source, AST, and its suppression comments."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.suppressions: Dict[int, Suppression] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                rules = tuple(r.strip() for r in m.group(1).split(",")
+                              if r.strip())
+                self.suppressions[i] = Suppression(
+                    i, rules, (m.group(2) or "").strip())
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """A suppression covers its own line and the line directly
+        below it (standalone-comment-above style)."""
+        if rule in _UNSUPPRESSABLE:
+            return False
+        for ln in (line, line - 1):
+            s = self.suppressions.get(ln)
+            if s is not None and s.justification and rule in s.rules:
+                return True
+        return False
+
+
+class ProjectIndex:
+    """The shared parse of the tree every pass walks."""
+
+    def __init__(self, root: Path, modules: Dict[str, SourceModule]):
+        self.root = root
+        self.modules = modules
+
+    def __iter__(self):
+        return iter(self.modules.values())
+
+    def get(self, path: str) -> Optional[SourceModule]:
+        return self.modules.get(path)
+
+    def meta_violations(self) -> List[Violation]:
+        """Suppression-comment hygiene: bare disables and unknown rule
+        names are violations in their own right."""
+        out: List[Violation] = []
+        for mod in self:
+            for s in mod.suppressions.values():
+                if not s.justification:
+                    out.append(Violation(
+                        "lint-bare-suppression", mod.path, s.line,
+                        f"disable={','.join(s.rules)} carries no "
+                        f"justification (append `-- <why>`)"))
+                for r in s.rules:
+                    if r != "all" and r not in RULES:
+                        out.append(Violation(
+                            "lint-unknown-rule", mod.path, s.line,
+                            f"unknown rule {r!r} in disable comment"))
+        return out
+
+
+def load_tree(root: Path, *, rel_to: Optional[Path] = None,
+              exclude: Sequence[str] = ()) -> ProjectIndex:
+    """Parse every ``*.py`` under ``root`` into a :class:`ProjectIndex`.
+    Paths are reported relative to ``rel_to`` (default: ``root``'s
+    parent, so the live tree reports ``lzy_tpu/...``).  Unparseable
+    files are skipped — the analyzers must never be the thing that
+    breaks on a broken tree; the test suite will complain louder."""
+    root = Path(root)
+    base = Path(rel_to) if rel_to is not None else root.parent
+    modules: Dict[str, SourceModule] = {}
+    for p in sorted(root.rglob("*.py")):
+        rel = p.relative_to(base).as_posix()
+        if any(part in ("__pycache__",) for part in p.parts):
+            continue
+        if any(rel.startswith(e) for e in exclude):
+            continue
+        try:
+            source = p.read_text(encoding="utf-8")
+            tree = ast.parse(source, filename=str(p))
+        except (OSError, SyntaxError):
+            continue
+        modules[rel] = SourceModule(rel, source, tree)
+    return ProjectIndex(root, modules)
+
+
+# -- results ------------------------------------------------------------------
+
+@dataclasses.dataclass
+class AnalysisResult:
+    violations: List[Violation]            # unsuppressed
+    suppressed: List[Violation]            # matched a justified disable
+    passes_run: Tuple[str, ...]
+
+    def by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for v in self.violations:
+            out[v.rule] = out.get(v.rule, 0) + 1
+        return out
+
+    def to_doc(self) -> dict:
+        return {
+            "passes": list(self.passes_run),
+            "violations": [dataclasses.asdict(v) for v in self.violations],
+            "suppressed": [dataclasses.asdict(v) for v in self.suppressed],
+            "by_rule": self.by_rule(),
+            "clean": not self.violations,
+        }
+
+
+def run_passes(index: ProjectIndex,
+               passes: Optional[Sequence[str]] = None) -> AnalysisResult:
+    """Run the named passes (default: all four) plus suppression
+    hygiene; split findings into unsuppressed vs justified-suppressed."""
+    # imported here so `from lzy_tpu.analysis import run_passes` does not
+    # drag every pass in before it's needed (and to avoid import cycles)
+    from lzy_tpu.analysis import chaos_contracts, clocks, jaxpass, locks
+
+    all_passes = {
+        "locks": locks.run,
+        "jax": jaxpass.run,
+        "clock": clocks.run,
+        "chaos": chaos_contracts.run,
+    }
+    names = tuple(passes) if passes else tuple(all_passes)
+    unknown = [n for n in names if n not in all_passes]
+    if unknown:
+        raise KeyError(f"unknown passes {unknown}; "
+                       f"known: {sorted(all_passes)}")
+    raw: List[Violation] = []
+    for name in names:
+        raw.extend(all_passes[name](index))
+    raw.extend(index.meta_violations())
+    kept: List[Violation] = []
+    suppressed: List[Violation] = []
+    for v in raw:
+        mod = index.get(v.path)
+        if mod is not None and mod.suppressed(v.rule, v.line):
+            suppressed.append(v)
+        else:
+            kept.append(v)
+    kept.sort(key=lambda v: (v.path, v.line, v.rule))
+    suppressed.sort(key=lambda v: (v.path, v.line, v.rule))
+    return AnalysisResult(kept, suppressed, names)
+
+
+# -- baseline (the ratchet) ---------------------------------------------------
+
+@dataclasses.dataclass
+class Baseline:
+    """Accepted fingerprints. Ships EMPTY: the ratchet is at zero, so
+    any unsuppressed violation is new. ``fixed`` is history — the real
+    findings the passes surfaced that were fixed when this tool landed
+    (the 'baseline delta' the ratchet started clean from)."""
+
+    accepted: frozenset
+    fixed: Tuple[str, ...] = ()
+
+    def new_violations(self, result: AnalysisResult) -> List[Violation]:
+        return [v for v in result.violations
+                if v.fingerprint not in self.accepted]
+
+
+def load_baseline(path: Optional[Path] = None) -> Baseline:
+    if path is None:
+        path = Path(__file__).with_name("baseline.json")
+    doc = json.loads(Path(path).read_text(encoding="utf-8"))
+    return Baseline(accepted=frozenset(doc.get("accepted", ())),
+                    fixed=tuple(doc.get("fixed", ())))
+
+
+# -- small shared AST helpers -------------------------------------------------
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target, best effort: ``a.b.c()`` ->
+    ``'a.b.c'``, ``f()`` -> ``'f'``, anything else -> ''."""
+    return dotted(node.func)
+
+
+def dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        inner = dotted(node.func)
+        parts.append(f"{inner}()" if inner else "()")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def iter_functions(tree: ast.Module) -> Iterable[Tuple[str, ast.AST]]:
+    """Yield (qualname, FunctionDef/AsyncFunctionDef) for every function
+    in the module, including methods and nested functions."""
+
+    def walk(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                yield q, child
+                yield from walk(child, f"{q}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")

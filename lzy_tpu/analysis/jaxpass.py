@@ -1,0 +1,339 @@
+"""JAX-hazards pass: donation aliasing, host sync in hot loops, Python
+control flow on traced values.
+
+The headline rule reproduces the PR 5 incident class statically:
+``jnp.asarray`` zero-copies host numpy memory, so two leaves built from
+the same array become THE SAME device buffer — donate the pytree and
+XLA is handed one buffer twice ("donate the same buffer twice",
+silent corruption, or a segfault, intermittently).  The fix idiom is
+``jnp.array`` (always copies) for anything that may be donated.
+
+Rules:
+
+- ``jax-donation-alias`` — at a call to a function compiled with
+  ``donate_argnums`` (decorator ``@functools.partial(jax.jit,
+  donate_argnums=...)`` / ``@jax.jit(...)`` or an assignment
+  ``g = jax.jit(f, donate_argnums=...)``), a donated argument
+  (a) appears syntactically identical to another argument, or
+  (b) is/contains a value tainted by ``jnp.asarray`` in the same
+  function body (including through a ``tree_map`` whose lambda returns
+  ``jnp.asarray(...)`` — the exact PR 5 shape).
+- ``jax-host-sync-hot-loop`` — ``.item()``, ``np.asarray``/
+  ``np.array``/``jax.device_get`` inside a ``for``/``while`` body of a
+  serving-engine step/prefill/decode/verify function.  One batched
+  host transfer per scheduling round is the correct pattern and is not
+  flagged (it sits outside the per-item loop); a per-item sync
+  serializes the device pipeline.
+- ``jax-traced-python-if`` — an ``if``/``while`` tests a traced
+  parameter of a jitted function.  Trace-time-static idioms are
+  exempt: ``x is None`` / ``is not None``, ``isinstance``, ``len(x)``
+  and ``.shape``/``.ndim``/``.size``/``.dtype`` access.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from lzy_tpu.analysis.core import (ProjectIndex, Violation, dotted,
+                                   iter_functions)
+
+_HOT_FUNC_RE = re.compile(r"(^|_)(step|decode|prefill|verify|advance)")
+_HOT_PATH_PREFIX = "lzy_tpu/serving/"
+_TREE_MAP_LEAVES = {"tree_map", "map", "tree_map_with_path",
+                    "tree_map_with_path_", "tree_multimap"}
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+
+def _donate_argnums_from_call(call: ast.Call) -> Optional[Set[int]]:
+    """If ``call`` is a jit invocation carrying donate_argnums, return
+    the donated positions."""
+    name = dotted(call.func)
+    leaf = name.rsplit(".", 1)[-1] if name else ""
+    inner_is_jit = False
+    if leaf == "partial" and call.args:
+        inner = dotted(call.args[0])
+        inner_is_jit = inner.rsplit(".", 1)[-1] == "jit"
+    is_jit = leaf == "jit" or inner_is_jit
+    if not is_jit:
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            return _int_tuple(kw.value)
+    return None
+
+
+def _int_tuple(node: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+    return out
+
+
+def _static_names(call: ast.Call, fn: ast.FunctionDef) -> Set[str]:
+    """Names of parameters marked static on a jit decorator call."""
+    params = [a.arg for a in fn.args.args]
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for i in _int_tuple(kw.value):
+                if i < len(params):
+                    out.add(params[i])
+        elif kw.arg == "static_argnames":
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                for e in kw.value.elts:
+                    if isinstance(e, ast.Constant):
+                        out.add(str(e.value))
+            elif isinstance(kw.value, ast.Constant):
+                out.add(str(kw.value.value))
+    return out
+
+
+def _contains_asarray(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = dotted(sub.func)
+            if name.rsplit(".", 1)[-1] == "asarray" and \
+                    name.split(".")[0] not in ("np", "numpy"):
+                return True
+    return False
+
+
+class _TaintVisitor(ast.NodeVisitor):
+    """Names in a function body that may hold a zero-copy
+    ``jnp.asarray`` view of host memory."""
+
+    def __init__(self):
+        self.tainted: Set[str] = set()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        taints = False
+        v = node.value
+        if isinstance(v, ast.Call):
+            name = dotted(v.func)
+            leaf = name.rsplit(".", 1)[-1] if name else ""
+            if leaf == "asarray" and \
+                    name.split(".")[0] not in ("np", "numpy"):
+                taints = True
+            elif leaf in _TREE_MAP_LEAVES:
+                for arg in list(v.args) + [kw.value for kw in v.keywords]:
+                    if isinstance(arg, ast.Lambda) and \
+                            _contains_asarray(arg.body):
+                        taints = True
+        elif isinstance(v, ast.Name) and v.id in self.tainted:
+            taints = True
+        if taints:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.tainted.add(t.id)
+        self.generic_visit(node)
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _donation_violations(mod, qual: str, fn: ast.AST,
+                         donators: Dict[str, Set[int]]) -> List[Violation]:
+    out: List[Violation] = []
+    tv = _TaintVisitor()
+    tv.visit(fn)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        leaf = name.rsplit(".", 1)[-1] if name else ""
+        # resolve through `self._fn(...)` too: match on the leaf name
+        donated = donators.get(leaf)
+        if donated is None:
+            continue
+        args = node.args
+        for i in sorted(donated):
+            if i >= len(args):
+                continue
+            d = args[i]
+            d_dump = ast.dump(d)
+            for j, other in enumerate(args):
+                if j != i and ast.dump(other) == d_dump:
+                    out.append(Violation(
+                        "jax-donation-alias", mod.path, node.lineno,
+                        f"argument {i} of {leaf}() is donated but the "
+                        f"same expression is also passed at position "
+                        f"{j} — XLA would receive one buffer twice",
+                        qual))
+                    break
+            if isinstance(d, ast.Call):
+                dn = dotted(d.func)
+                if dn.rsplit(".", 1)[-1] == "asarray" and \
+                        dn.split(".")[0] not in ("np", "numpy"):
+                    out.append(Violation(
+                        "jax-donation-alias", mod.path, node.lineno,
+                        f"donated argument {i} of {leaf}() is built by "
+                        f"jnp.asarray (zero-copy): a retained host "
+                        f"mirror may alias the donated buffer — use "
+                        f"jnp.array", qual))
+                    continue
+            hazard = _names_in(d) & tv.tainted
+            if hazard:
+                out.append(Violation(
+                    "jax-donation-alias", mod.path, node.lineno,
+                    f"donated argument {i} of {leaf}() carries "
+                    f"{sorted(hazard)} tainted by jnp.asarray "
+                    f"(zero-copy host aliasing, the PR 5 segfault "
+                    f"class) — build donated leaves with jnp.array",
+                    qual))
+    return out
+
+
+def _collect_donators(tree: ast.Module) -> Dict[str, Set[int]]:
+    """name -> donated argnums, for decorated defs and jit-assignments
+    anywhere in the module (including nested scopes)."""
+    out: Dict[str, Set[int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    donated = _donate_argnums_from_call(dec)
+                    if donated:
+                        out[node.name] = donated
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            donated = _donate_argnums_from_call(node.value)
+            if donated:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = donated
+                    elif isinstance(t, ast.Attribute):
+                        out[t.attr] = donated
+    return out
+
+
+def _jit_decorated(fn: ast.AST) -> Optional[ast.Call]:
+    for dec in getattr(fn, "decorator_list", ()):
+        if isinstance(dec, ast.Call):
+            name = dotted(dec.func)
+            leaf = name.rsplit(".", 1)[-1] if name else ""
+            if leaf == "jit":
+                return dec
+            if leaf == "partial" and dec.args and \
+                    dotted(dec.args[0]).rsplit(".", 1)[-1] == "jit":
+                return dec
+        elif isinstance(dec, (ast.Name, ast.Attribute)):
+            if dotted(dec).rsplit(".", 1)[-1] == "jit":
+                return ast.Call(func=dec, args=[], keywords=[])
+    return None
+
+
+def _traced_if_violations(mod, qual: str,
+                          fn: ast.FunctionDef) -> List[Violation]:
+    dec = _jit_decorated(fn)
+    if dec is None:
+        return []
+    static = _static_names(dec, fn)
+    traced = {a.arg for a in fn.args.args} - static - {"self"}
+    if not traced:
+        return []
+    out: List[Violation] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        hazards = _hazard_names(node.test, traced)
+        if hazards:
+            kind = "if" if isinstance(node, ast.If) else "while"
+            out.append(Violation(
+                "jax-traced-python-if", mod.path, node.lineno,
+                f"Python `{kind}` on traced parameter(s) "
+                f"{sorted(hazards)} inside jitted {fn.name}() — use "
+                f"lax.cond/select or mark the argument static", qual))
+    return out
+
+
+def _hazard_names(test: ast.AST, traced: Set[str]) -> Set[str]:
+    """Traced-parameter names used in a test in a way that needs the
+    VALUE at trace time (i.e. not a static identity/shape idiom)."""
+    if isinstance(test, ast.Compare) and \
+            all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+        return set()
+    safe: Set[int] = set()
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(test):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            leaf = name.rsplit(".", 1)[-1] if name else ""
+            if leaf in ("len", "isinstance"):
+                for sub in ast.walk(node):
+                    safe.add(id(sub))
+        elif isinstance(node, ast.Attribute) and \
+                node.attr in _STATIC_ATTRS:
+            for sub in ast.walk(node):
+                safe.add(id(sub))
+    hazards: Set[str] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in traced and \
+                id(node) not in safe:
+            hazards.add(node.id)
+    return hazards
+
+
+def _host_sync_violations(mod, qual: str, fn: ast.AST) -> List[Violation]:
+    if not mod.path.startswith(_HOT_PATH_PREFIX):
+        return []
+    leaf = qual.rsplit(".", 1)[-1]
+    if not _HOT_FUNC_RE.search(leaf):
+        return []
+    out: List[Violation] = []
+    for loop in ast.walk(fn):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if not name:
+                continue
+            parts = name.split(".")
+            sync = None
+            if parts[-1] == "item" and len(parts) > 1:
+                sync = f"{name}()"
+            elif parts[0] in ("np", "numpy") and \
+                    parts[-1] in ("asarray", "array"):
+                sync = f"{name}(...)"
+            elif parts[-1] == "device_get":
+                sync = f"{name}(...)"
+            if sync:
+                out.append(Violation(
+                    "jax-host-sync-hot-loop", mod.path, node.lineno,
+                    f"{sync} inside a per-item loop of hot function "
+                    f"{leaf}() — batch the host transfer once per "
+                    f"round (or justify a suppression)", qual))
+    return out
+
+
+def run(index: ProjectIndex) -> List[Violation]:
+    out: List[Violation] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for mod in index:
+        donators = _collect_donators(mod.tree)
+        for qual, fn in iter_functions(mod.tree):
+            if donators:
+                out.extend(_donation_violations(mod, qual, fn, donators))
+            if isinstance(fn, ast.FunctionDef):
+                out.extend(_traced_if_violations(mod, qual, fn))
+            out.extend(_host_sync_violations(mod, qual, fn))
+    # nested walks can revisit the same call site via enclosing scopes;
+    # a (path, line, rule) key dedups without losing distinct findings
+    deduped: List[Violation] = []
+    for v in out:
+        key = (v.path, v.line, v.rule + v.message)
+        if key not in seen:
+            seen.add(key)
+            deduped.append(v)
+    return deduped

@@ -1,0 +1,698 @@
+"""Lock-discipline pass: order inversions, self-reacquisition, blocking
+work under a lock.
+
+The model is deliberately *syntactic with a best-effort call graph*,
+tuned for this tree's idiom (every lock is ``self._lock``-style
+attribute state created in ``__init__``, or a module-level ``_LOCK``):
+
+- **lock identity** is ``(defining module, class, attribute)`` — i.e.
+  class-level: two instances of ``ReplicaFleet`` map to the same lock
+  node.  That is the standard abstraction for order graphs (an
+  inversion between instances of the same classes is still an
+  inversion) and it is what makes the analysis whole-tree tractable.
+- **acquisition sites** are ``with self._lock:`` /
+  ``self._lock.acquire()`` (and module-level equivalents), resolved
+  through the class's own attributes and its statically-resolvable
+  base classes.  ``threading.Condition`` counts as a lock.
+- **the call graph** resolves ``self.m()`` through the class and its
+  bases, ``self.attr.m()`` through constructor assignments
+  (``self.attr = SomeClass(...)``), bare ``f()`` to module functions,
+  and ``mod.f()`` through imports.  Unresolvable calls are ignored —
+  precision over recall: every finding this pass emits is meant to be
+  actionable, and the ratchet keeps the count at zero.
+
+Three rules:
+
+- ``lock-order-inversion`` — a cycle in the graph whose edge A -> B
+  means "somewhere, B is acquired (directly or via a resolved call)
+  while A is held".
+- ``lock-self-reacquire`` — while a non-reentrant ``threading.Lock``
+  is held on ``self``, a chain of *self-calls* reaches a method that
+  acquires the same lock again.  This is exactly the PR 6 bug
+  (``submit`` computed ``retry_after_s`` under the engine's own lock
+  through a path that re-locked it).  ``RLock``/``Condition`` are
+  reentrant and exempt.
+- ``lock-blocking-call`` — a blocking operation appears *lexically*
+  inside a held region: ``sleep``, ``join``, event/clock ``wait``
+  (a ``Condition.wait`` on the innermost held condition is exempt —
+  it releases that lock), ``block_until_ready``/``device_get``
+  device syncs, storage I/O (``read_bytes``/``write_bytes``/
+  multipart ops), queue waits, and RPC ``.call`` on client-shaped
+  receivers.  Direct-only by design: the interprocedural version of
+  this rule drowns in may-block propagation; the PR 12 class (heavy
+  work under the router lock) is caught at the site that does the
+  work.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from lzy_tpu.analysis.core import ProjectIndex, Violation, dotted
+
+LOCK_KINDS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+
+#: method names that block when called under a lock, by category
+_BLOCK_SLEEP = {"sleep"}
+_BLOCK_JOIN = {"join"}
+_BLOCK_DEVICE = {"block_until_ready", "device_get"}
+_BLOCK_STORAGE = {"read_bytes", "write_bytes", "put_bytes", "get_bytes",
+                  "multipart_upload", "upload_part", "download_ranged"}
+_BLOCK_WAIT = {"wait", "wait_past", "read_all"}
+_RPC_RECEIVER_HINTS = ("client", "rpc")
+_QUEUE_RECEIVER_HINTS = ("queue",)
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDef:
+    lock_id: str          # "<path>::<Class>.<attr>" or "<path>::<NAME>"
+    kind: str             # lock | rlock | condition
+
+    @property
+    def reentrant(self) -> bool:
+        return self.kind in ("rlock", "condition")
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    path: str
+    name: str
+    bases: List[str]                       # unresolved base names
+    methods: Dict[str, ast.AST]
+    locks: Dict[str, LockDef]              # attr -> def (own, not inherited)
+    attr_types: Dict[str, str]             # attr -> class name (best effort)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str
+    classes: Dict[str, ClassInfo]
+    functions: Dict[str, ast.AST]
+    locks: Dict[str, LockDef]              # module-level name -> def
+    imports: Dict[str, str]                # local name -> dotted origin
+
+
+@dataclasses.dataclass(frozen=True)
+class Held:
+    lock: LockDef
+    expr: str              # source expression, e.g. "self._cv"
+    via_self: bool
+
+
+def _lock_kind_of(value: ast.AST) -> Optional[str]:
+    """'lock'/'rlock'/'condition' if ``value`` constructs one."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted(value.func)
+    leaf = name.rsplit(".", 1)[-1] if name else ""
+    return LOCK_KINDS.get(leaf)
+
+
+def _collect_module(path: str, tree: ast.Module) -> ModuleInfo:
+    classes: Dict[str, ClassInfo] = {}
+    functions: Dict[str, ast.AST] = {}
+    locks: Dict[str, LockDef] = {}
+    imports: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imports[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                imports[a.asname or a.name] = f"{node.module}.{a.name}"
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            functions[node.name] = node
+        elif isinstance(node, ast.Assign):
+            kind = _lock_kind_of(node.value)
+            if kind:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        locks[t.id] = LockDef(f"{path}::{t.id}", kind)
+        elif isinstance(node, ast.ClassDef):
+            classes[node.name] = _collect_class(path, node)
+    return ModuleInfo(path, classes, functions, locks, imports)
+
+
+def _ann_class(ann: Optional[ast.AST]) -> Optional[str]:
+    """Class name out of a parameter annotation, unwrapping
+    ``Optional[X]`` — ``X`` survives, unions/strings don't."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Subscript):
+        name = dotted(ann.value)
+        if name.rsplit(".", 1)[-1] == "Optional":
+            return _ann_class(ann.slice)
+        return None
+    name = dotted(ann)
+    leaf = name.rsplit(".", 1)[-1] if name else None
+    return leaf if leaf and leaf[:1].isupper() else None
+
+
+def _value_type(value: ast.AST,
+                param_types: Dict[str, str]) -> Optional[str]:
+    """Best-effort class name of an assigned expression: a constructor
+    call, an annotated parameter, or the idiomatic
+    ``x if x is not None else Ctor(...)`` default."""
+    if isinstance(value, ast.Call):
+        ctor = dotted(value.func)
+        if ctor:
+            leaf = ctor.rsplit(".", 1)[-1]
+            return leaf if leaf[:1].isupper() else None
+        return None
+    if isinstance(value, ast.Name):
+        return param_types.get(value.id)
+    if isinstance(value, ast.IfExp):
+        return (_value_type(value.body, param_types)
+                or _value_type(value.orelse, param_types))
+    if isinstance(value, ast.BoolOp):
+        for v in value.values:
+            t = _value_type(v, param_types)
+            if t:
+                return t
+    return None
+
+
+def _collect_class(path: str, node: ast.ClassDef) -> ClassInfo:
+    info = ClassInfo(path=path, name=node.name,
+                     bases=[dotted(b) for b in node.bases if dotted(b)],
+                     methods={}, locks={}, attr_types={})
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods[item.name] = item
+            param_types = {
+                a.arg: t for a in (item.args.args
+                                   + item.args.kwonlyargs)
+                if (t := _ann_class(a.annotation))}
+            for sub in ast.walk(item):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for t in sub.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        kind = _lock_kind_of(sub.value)
+                        if kind:
+                            info.locks[t.attr] = LockDef(
+                                f"{path}::{node.name}.{t.attr}", kind)
+                        else:
+                            vt = _value_type(sub.value, param_types)
+                            if vt:
+                                info.attr_types.setdefault(t.attr, vt)
+        elif isinstance(item, ast.Assign):
+            kind = _lock_kind_of(item.value)
+            if kind:
+                for t in item.targets:
+                    if isinstance(t, ast.Name):
+                        info.locks[t.id] = LockDef(
+                            f"{path}::{node.name}.{t.id}", kind)
+    return info
+
+
+class _World:
+    """All modules + cross-module class resolution."""
+
+    def __init__(self, index: ProjectIndex):
+        self.modules: Dict[str, ModuleInfo] = {
+            m.path: _collect_module(m.path, m.tree) for m in index}
+        # class name -> [ClassInfo]; names are rarely ambiguous in this
+        # tree, and an ambiguous resolution is simply skipped
+        self.by_class_name: Dict[str, List[ClassInfo]] = {}
+        for mod in self.modules.values():
+            for ci in mod.classes.values():
+                self.by_class_name.setdefault(ci.name, []).append(ci)
+
+    def resolve_class(self, name: str,
+                      mod: ModuleInfo) -> Optional[ClassInfo]:
+        leaf = name.rsplit(".", 1)[-1]
+        local = mod.classes.get(leaf)
+        if local is not None:
+            return local
+        candidates = self.by_class_name.get(leaf, [])
+        return candidates[0] if len(candidates) == 1 else None
+
+    def mro(self, ci: ClassInfo, _seen=None) -> List[ClassInfo]:
+        seen = _seen if _seen is not None else set()
+        if (ci.path, ci.name) in seen:
+            return []
+        seen.add((ci.path, ci.name))
+        out = [ci]
+        mod = self.modules[ci.path]
+        for b in ci.bases:
+            base = self.resolve_class(b, mod)
+            if base is not None:
+                out.extend(self.mro(base, seen))
+        return out
+
+    def lock_attr(self, ci: ClassInfo, attr: str) -> Optional[LockDef]:
+        for c in self.mro(ci):
+            if attr in c.locks:
+                return c.locks[attr]
+        return None
+
+    def method(self, ci: ClassInfo,
+               name: str) -> Optional[Tuple[ClassInfo, ast.AST]]:
+        for c in self.mro(ci):
+            if name in c.methods:
+                return c, c.methods[name]
+        return None
+
+    def attr_type(self, ci: ClassInfo, attr: str) -> Optional[ClassInfo]:
+        mod = self.modules[ci.path]
+        for c in self.mro(ci):
+            t = c.attr_types.get(attr)
+            if t:
+                return self.resolve_class(t, self.modules[c.path])
+        _ = mod
+        return None
+
+
+FuncKey = Tuple[str, str]     # (path, qualname)
+
+
+@dataclasses.dataclass
+class FuncFacts:
+    key: FuncKey
+    cls: Optional[ClassInfo]
+    #: locks acquired anywhere in the body (id -> via_self)
+    acquires: Dict[str, bool]
+    #: resolved callees (FuncKey, is_self_call)
+    calls: List[Tuple[FuncKey, bool]]
+    #: (held tuple, callee key, is_self_call, line) for interprocedural
+    held_calls: List[Tuple[Tuple[Held, ...], FuncKey, bool, int]]
+    #: order edges recorded directly: (held id, acquired id, line)
+    edges: List[Tuple[str, str, int]]
+    #: direct blocking findings: (line, description)
+    blocking: List[Tuple[int, str]]
+    #: direct same-lock re-entry: (line, lock id)
+    direct_reacquire: List[Tuple[int, str]]
+
+
+class _FuncVisitor(ast.NodeVisitor):
+    def __init__(self, world: _World, mod: ModuleInfo,
+                 cls: Optional[ClassInfo], key: FuncKey):
+        self.world = world
+        self.mod = mod
+        self.cls = cls
+        self.facts = FuncFacts(key=key, cls=cls, acquires={}, calls=[],
+                               held_calls=[], edges=[], blocking=[],
+                               direct_reacquire=[])
+        self.held: List[Held] = []
+
+    # -- resolution ----------------------------------------------------------
+
+    def _resolve_lock(self, expr: ast.AST) -> Optional[Held]:
+        name = dotted(expr)
+        if not name:
+            return None
+        parts = name.split(".")
+        if parts[0] == "self" and self.cls is not None:
+            if len(parts) == 2:
+                ld = self.world.lock_attr(self.cls, parts[1])
+                if ld:
+                    return Held(ld, name, via_self=True)
+            elif len(parts) == 3:
+                target = self.world.attr_type(self.cls, parts[1])
+                if target is not None:
+                    ld = self.world.lock_attr(target, parts[2])
+                    if ld:
+                        return Held(ld, name, via_self=False)
+        elif len(parts) == 1:
+            ld = self.mod.locks.get(parts[0])
+            if ld:
+                return Held(ld, name, via_self=False)
+            if self.cls is not None:
+                ld = self.world.lock_attr(self.cls, parts[0])
+                if ld:
+                    return Held(ld, name, via_self=False)
+        return None
+
+    def _resolve_call(self,
+                      name: str) -> Optional[Tuple[FuncKey, bool]]:
+        parts = name.split(".")
+        if parts[0] == "self" and self.cls is not None:
+            if len(parts) == 2:
+                hit = self.world.method(self.cls, parts[1])
+                if hit:
+                    owner, _ = hit
+                    return ((owner.path, f"{owner.name}.{parts[1]}"),
+                            True)
+            elif len(parts) == 3:
+                target = self.world.attr_type(self.cls, parts[1])
+                if target is not None:
+                    hit = self.world.method(target, parts[2])
+                    if hit:
+                        owner, _ = hit
+                        return ((owner.path, f"{owner.name}.{parts[2]}"),
+                                False)
+        elif len(parts) == 1:
+            if parts[0] in self.mod.functions:
+                return ((self.mod.path, parts[0]), False)
+        elif len(parts) == 2:
+            # Class(...) methods / imported module functions: resolve a
+            # locally-defined or uniquely-named class's method
+            ci = self.world.resolve_class(parts[0], self.mod)
+            if ci is not None:
+                hit = self.world.method(ci, parts[1])
+                if hit:
+                    owner, _ = hit
+                    return ((owner.path, f"{owner.name}.{parts[1]}"),
+                            False)
+        return None
+
+    # -- blocking ------------------------------------------------------------
+
+    def _blocking_reason(self, name: str) -> Optional[str]:
+        parts = name.split(".")
+        attr = parts[-1]
+        receiver = ".".join(parts[:-1])
+        if attr in _BLOCK_SLEEP:
+            return f"sleep via {name}()"
+        if attr in _BLOCK_JOIN and receiver and any(
+                h in receiver.lower()
+                for h in ("thread", "worker", "proc", "beat")):
+            # receiver-hinted so str.join / os.path.join never match
+            return f"thread join via {name}()"
+        if attr in _BLOCK_DEVICE:
+            return f"host-device sync via {name}()"
+        if attr in _BLOCK_STORAGE:
+            return f"storage I/O via {name}()"
+        if attr in _BLOCK_WAIT and receiver:
+            held_exprs = [h.expr for h in self.held]
+            if receiver in held_exprs:
+                # Condition.wait on a held condition RELEASES it — only
+                # a problem if an OUTER lock stays held across the wait
+                if len(self.held) == 1 and self.held[0].expr == receiver:
+                    return None
+                outer = [h.expr for h in self.held if h.expr != receiver]
+                return (f"{name}() releases {receiver} but parks while "
+                        f"still holding {', '.join(outer)}")
+            return f"event/clock wait via {name}()"
+        if attr == "call" and receiver and any(
+                h in receiver.lower() for h in _RPC_RECEIVER_HINTS):
+            return f"RPC dispatch via {name}()"
+        if attr == "get" and receiver and any(
+                h in receiver.split(".")[-1].lower()
+                for h in _QUEUE_RECEIVER_HINTS):
+            return f"queue wait via {name}()"
+        return None
+
+    # -- visitor -------------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: List[Held] = []
+        for item in node.items:
+            held = self._resolve_lock(item.context_expr)
+            if held is not None:
+                for outer in self.held:
+                    if outer.lock.lock_id == held.lock.lock_id:
+                        if not held.lock.reentrant:
+                            self.facts.direct_reacquire.append(
+                                (item.context_expr.lineno,
+                                 held.lock.lock_id))
+                    else:
+                        self.facts.edges.append(
+                            (outer.lock.lock_id, held.lock.lock_id,
+                             item.context_expr.lineno))
+                self.facts.acquires.setdefault(held.lock.lock_id,
+                                               held.via_self)
+                self.held.append(held)
+                acquired.append(held)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted(node.func)
+        if name:
+            parts = name.split(".")
+            if parts[-1] == "acquire" and len(parts) > 1:
+                held = self._resolve_lock(node.func.value)
+                if held is not None:
+                    for outer in self.held:
+                        if outer.lock.lock_id != held.lock.lock_id:
+                            self.facts.edges.append(
+                                (outer.lock.lock_id, held.lock.lock_id,
+                                 node.lineno))
+                    self.facts.acquires.setdefault(held.lock.lock_id,
+                                                   held.via_self)
+            elif self.held:
+                reason = self._blocking_reason(name)
+                if reason:
+                    self.facts.blocking.append((node.lineno, reason))
+            resolved = self._resolve_call(name)
+            if resolved:
+                callee, is_self = resolved
+                self.facts.calls.append((callee, is_self))
+                if self.held:
+                    self.facts.held_calls.append(
+                        (tuple(self.held), callee, is_self, node.lineno))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # nested defs are analyzed as their own functions; a nested def
+        # inside a with-block does not RUN under the lock at def time
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def _analyze_functions(world: _World) -> Dict[FuncKey, FuncFacts]:
+    out: Dict[FuncKey, FuncFacts] = {}
+    for mod in world.modules.values():
+        for fname, fnode in mod.functions.items():
+            v = _FuncVisitor(world, mod, None, (mod.path, fname))
+            for stmt in fnode.body:
+                v.visit(stmt)
+            out[v.facts.key] = v.facts
+            _analyze_nested(world, mod, None, fnode, fname, out)
+        for ci in mod.classes.values():
+            for mname, mnode in ci.methods.items():
+                key = (mod.path, f"{ci.name}.{mname}")
+                v = _FuncVisitor(world, mod, ci, key)
+                for stmt in mnode.body:
+                    v.visit(stmt)
+                out[key] = v.facts
+                _analyze_nested(world, mod, ci, mnode,
+                                f"{ci.name}.{mname}", out)
+    return out
+
+
+def _analyze_nested(world: _World, mod: ModuleInfo,
+                    cls: Optional[ClassInfo], fnode: ast.AST,
+                    prefix: str, out: Dict[FuncKey, FuncFacts]) -> None:
+    for child in ast.walk(fnode):
+        if child is fnode or not isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        key = (mod.path, f"{prefix}.{child.name}")
+        if key in out:
+            continue
+        v = _FuncVisitor(world, mod, cls, key)
+        for stmt in child.body:
+            v.visit(stmt)
+        out[key] = v.facts
+
+
+def _fixpoint_summaries(
+        facts: Dict[FuncKey, FuncFacts],
+        self_only: bool) -> Dict[FuncKey, Set[str]]:
+    """Transitive lock-acquisition summaries.  ``self_only`` restricts
+    both the seed set (via-self acquires) and propagation (self-calls)
+    — the shape the self-reacquire rule needs."""
+    summary: Dict[FuncKey, Set[str]] = {}
+    for key, f in facts.items():
+        if self_only:
+            summary[key] = {lid for lid, via in f.acquires.items() if via}
+        else:
+            summary[key] = set(f.acquires)
+    for _ in range(40):
+        changed = False
+        for key, f in facts.items():
+            s = summary[key]
+            before = len(s)
+            for callee, is_self in f.calls:
+                if self_only and not is_self:
+                    continue
+                s |= summary.get(callee, set())
+            if len(s) != before:
+                changed = True
+        if not changed:
+            break
+    return summary
+
+
+def _short(lock_id: str) -> str:
+    path, name = lock_id.split("::", 1)
+    return f"{path}::{name}"
+
+
+def run(index: ProjectIndex) -> List[Violation]:
+    world = _World(index)
+    facts = _analyze_functions(world)
+    acq = _fixpoint_summaries(facts, self_only=False)
+    self_acq = _fixpoint_summaries(facts, self_only=True)
+
+    violations: List[Violation] = []
+
+    # direct findings
+    for key, f in facts.items():
+        path, qual = key
+        for line, reason in f.blocking:
+            violations.append(Violation(
+                "lock-blocking-call", path, line,
+                f"{reason} while holding a lock", qual))
+        for line, lock_id in f.direct_reacquire:
+            violations.append(Violation(
+                "lock-self-reacquire", path, line,
+                f"re-enters non-reentrant {_short(lock_id)} already "
+                f"held in this function", qual))
+
+    # interprocedural self-reacquire (the PR 6 class)
+    for key, f in facts.items():
+        path, qual = key
+        seen: Set[Tuple[str, FuncKey]] = set()
+        for held, callee, is_self, line in f.held_calls:
+            if not is_self:
+                continue
+            for h in held:
+                if h.lock.reentrant or not h.via_self:
+                    continue
+                if h.lock.lock_id in self_acq.get(callee, ()):  # noqa: E501
+                    mark = (h.lock.lock_id, callee)
+                    if mark in seen:
+                        continue
+                    seen.add(mark)
+                    violations.append(Violation(
+                        "lock-self-reacquire", path, line,
+                        f"call to {callee[1]}() while holding "
+                        f"non-reentrant {_short(h.lock.lock_id)}; the "
+                        f"callee (re)acquires the same lock", qual))
+
+    # lock-order graph: direct nesting edges + call-summary edges
+    edges: Dict[Tuple[str, str], Tuple[str, str, int]] = {}
+    for key, f in facts.items():
+        path, qual = key
+        for a, b, line in f.edges:
+            edges.setdefault((a, b), (path, qual, line))
+        for held, callee, _is_self, line in f.held_calls:
+            for h in held:
+                for b in acq.get(callee, ()):
+                    if b != h.lock.lock_id:
+                        edges.setdefault((h.lock.lock_id, b),
+                                         (path, qual, line))
+
+    violations.extend(_order_cycles(edges))
+    return violations
+
+
+def _order_cycles(
+        edges: Dict[Tuple[str, str], Tuple[str, str, int]]
+) -> List[Violation]:
+    """Report every 2-cycle (the overwhelmingly common inversion shape)
+    plus any longer strongly-connected component once."""
+    out: List[Violation] = []
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    reported: Set[frozenset] = set()
+    for (a, b), (path, qual, line) in sorted(edges.items()):
+        if (b, a) in edges and frozenset((a, b)) not in reported:
+            reported.add(frozenset((a, b)))
+            other = edges[(b, a)]
+            out.append(Violation(
+                "lock-order-inversion", path, line,
+                f"{_short(a)} -> {_short(b)} here, but "
+                f"{other[0]}:{other[2]} [{other[1]}] acquires "
+                f"{_short(b)} -> {_short(a)}: potential deadlock "
+                f"cycle", qual))
+    # longer cycles: SCCs of size > 2 not already covered by a 2-cycle
+    for scc in _sccs(graph):
+        if len(scc) < 3:
+            continue
+        key = frozenset(scc)
+        if any(r <= key for r in reported):
+            continue
+        anchor = None
+        for (a, b), site in sorted(edges.items()):
+            if a in scc and b in scc:
+                anchor = site
+                break
+        if anchor is None:
+            continue
+        reported.add(key)
+        path, qual, line = anchor
+        out.append(Violation(
+            "lock-order-inversion", path, line,
+            f"lock-order cycle through {len(scc)} locks: "
+            f"{', '.join(sorted(_short(x) for x in scc))}", qual))
+    return out
+
+
+def _sccs(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Tarjan, iteratively (the tree is big enough to bother)."""
+    idx: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in graph:
+        if root in idx:
+            continue
+        work = [(root, iter(sorted(graph.get(root, ()))))]
+        idx[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in idx:
+                    idx[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on.add(nxt)
+                    work.append((nxt, iter(sorted(graph.get(nxt, ())))))
+                    advanced = True
+                    break
+                elif nxt in on:
+                    low[node] = min(low[node], idx[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == idx[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(comp)
+    return sccs
+
+
+def lock_sites(index: ProjectIndex) -> List[dict]:
+    """Every resolved acquisition site in the tree — the inventory
+    ``--json`` exposes for dashboards/CI (not a rule)."""
+    world = _World(index)
+    facts = _analyze_functions(world)
+    rows: List[dict] = []
+    for (path, qual), f in sorted(facts.items()):
+        for lock_id, via_self in sorted(f.acquires.items()):
+            rows.append({"path": path, "function": qual,
+                         "lock": lock_id, "via_self": via_self})
+    return rows

@@ -19,9 +19,9 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-import time
 from typing import Any, Dict, List, Optional
 
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.utils.log import get_logger
 
 _LOG = get_logger(__name__)
@@ -75,7 +75,10 @@ class ChannelManager:
     channels in the channel-manager's Postgres for the same reason. Device
     residency and live slot peers stay process-local by nature."""
 
-    def __init__(self, store=None) -> None:
+    def __init__(self, store=None, *, clock=None) -> None:
+        # injectable time (utils/clock): tombstone grace stamps and the
+        # wait_status/wait_available deadline loops read it
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         self._channels: Dict[str, Channel] = {}
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -85,6 +88,7 @@ class ChannelManager:
         self._written_seq: Dict[str, int] = {}
         self._tombstones: Dict[str, float] = {}  # destroyed id → expiry ts
         self.device = DeviceResidency()
+        self._virtual_clock = bool(getattr(self._clock, "virtual", False))
         if store is not None:
             for doc in store.kv_list("channels").values():
                 ch = Channel(**doc)
@@ -137,7 +141,7 @@ class ChannelManager:
                 del self._channels[cid]
                 self._seq.pop(cid, None)
         if self._store is not None:
-            now = time.time()
+            now = self._clock.time()
             with self._io_lock:
                 for cid in dead:
                     # tombstone: an in-flight _write_outside that snapshotted
@@ -220,20 +224,34 @@ class ChannelManager:
             self._cv.notify_all()
         self._write_outside(entry_id, snap)
 
+    def _cv_wait(self, remaining: Optional[float]) -> None:
+        """Park on the channel condition. ``remaining`` is CLOCK seconds
+        (virtual under a VirtualClock), and a raw Condition only wakes
+        on real time — so under a virtual clock poll at a short real
+        backstop and let the caller's loop re-read ``clock.time()``
+        (the token_stream discipline). Publishes/fails still notify the
+        condition promptly either way."""
+        wait_s = 1.0 if remaining is None else remaining
+        if self._virtual_clock:
+            wait_s = min(wait_s, 0.05)
+        self._cv.wait(wait_s)
+
     def wait_status(self, entry_id: str, timeout_s: float = 2.0) -> Channel:
         """Bounded cv-wait until the channel completes/fails (or timeout);
         returns the channel either way. The RPC long-poll handler's primitive —
         no busy-polling, the waiter parks on the condition variable."""
-        deadline = time.time() + timeout_s
+        deadline = self._clock.time() + timeout_s
         with self._cv:
             while True:
                 ch = self._live(entry_id)
                 if ch.completed or ch.failed:
                     return ch
-                remaining = deadline - time.time()
+                # (loop re-reads the clock each round; _cv_wait caps the
+                # real park under a virtual clock so the deadline fires)
+                remaining = deadline - self._clock.time()
                 if remaining <= 0:
                     return ch
-                self._cv.wait(remaining)
+                self._cv_wait(remaining)
 
     def wait_available(self, entry_id: str,
                        timeout_s: Optional[float] = 300.0) -> Channel:
@@ -241,7 +259,8 @@ class ChannelManager:
         device-resident value exists — the ICI short-circuit). ``timeout_s=None``
         waits indefinitely (gang peers waiting on a long-running producer;
         graph-level deadlines govern instead)."""
-        deadline = None if timeout_s is None else time.time() + timeout_s
+        deadline = None if timeout_s is None else \
+            self._clock.time() + timeout_s
         with self._cv:
             while True:
                 ch = self._live(entry_id)
@@ -250,12 +269,12 @@ class ChannelManager:
                 if ch.completed or entry_id in self.device:
                     return ch
                 if deadline is None:
-                    self._cv.wait(1.0)
+                    self._cv_wait(None)
                     continue
-                remaining = deadline - time.time()
+                remaining = deadline - self._clock.time()
                 if remaining <= 0:
                     raise TimeoutError(f"channel {entry_id} not available after {timeout_s}s")
-                self._cv.wait(min(remaining, 1.0))
+                self._cv_wait(min(remaining, 1.0))
 
 
 class ChannelFailed(RuntimeError):

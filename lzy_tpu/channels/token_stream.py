@@ -38,10 +38,10 @@ from __future__ import annotations
 
 import json
 import threading
-import time
 from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
 from lzy_tpu.storage.api import join_uri
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.utils.ids import gen_id
 from lzy_tpu.utils.log import get_logger
 
@@ -67,8 +67,12 @@ class TokenStreamChannel:
     status — ``ok`` or ``cancelled``) or :meth:`fail`.
     """
 
-    def __init__(self, channel_id: Optional[str] = None):
+    def __init__(self, channel_id: Optional[str] = None, *,
+                 clock=None):
         self.id = channel_id or gen_id("tokstream")
+        # injectable time (utils/clock): read/wait_past deadlines run on
+        # it, so a virtual-clock fleet can park consumers virtually
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         self._tokens: List[int] = []
         self._cv = threading.Condition()
         self._closed = False
@@ -151,6 +155,19 @@ class TokenStreamChannel:
 
     # -- consumer side -------------------------------------------------------
 
+    def _cv_wait(self, remaining: Optional[float]) -> None:
+        """Park on the channel condition for up to ``remaining``
+        seconds. ``remaining`` is VIRTUAL seconds when a VirtualClock
+        is injected, and a raw ``Condition`` cannot be woken by virtual
+        time — so under a virtual clock this polls at a short real
+        backstop and lets the caller's loop re-read ``clock.now()``
+        (the same discipline utils/clock applies to foreign events).
+        Publishes still wake the condition promptly either way."""
+        wait_s = 1.0 if remaining is None else remaining
+        if getattr(self._clock, "virtual", False):
+            wait_s = min(wait_s, 0.05)
+        self._cv.wait(wait_s)
+
     @property
     def position(self) -> int:
         with self._cv:
@@ -209,16 +226,16 @@ class TokenStreamChannel:
         :class:`StreamFailed` on a failed stream, ``TimeoutError`` on
         timeout."""
         deadline = None if timeout_s is None else \
-            time.monotonic() + timeout_s
+            self._clock.now() + timeout_s
         with self._cv:
             while len(self._tokens) <= start and not self._closed:
                 remaining = None if deadline is None else \
-                    deadline - time.monotonic()
+                    deadline - self._clock.now()
                 if remaining is not None and remaining <= 0:
                     raise TimeoutError(
                         f"stream {self.id} produced nothing past "
                         f"{start} within {timeout_s}s")
-                self._cv.wait(remaining if remaining is not None else 1.0)
+                self._cv_wait(remaining)
             if self._error is not None:
                 raise StreamFailed(
                     f"stream {self.id} failed: {self._error}")
@@ -236,13 +253,13 @@ class TokenStreamChannel:
         keepalive (the producer is alive but produced nothing yet) and a
         failed stream reports its error in-band (the poll reply owns the
         error format)."""
-        deadline = time.monotonic() + max(0.0, timeout_s)
+        deadline = self._clock.now() + max(0.0, timeout_s)
         with self._cv:
             while len(self._tokens) <= start and not self._closed:
-                remaining = deadline - time.monotonic()
+                remaining = deadline - self._clock.now()
                 if remaining <= 0:
                     break
-                self._cv.wait(remaining)
+                self._cv_wait(remaining)
             return {"tokens": list(self._tokens[start:]),
                     "closed": self._closed,
                     "status": self._status,
@@ -432,10 +449,12 @@ class StorageTokenStreamReader:
     appear, finishes when the manifest lands. The manifest-last contract
     means an existing manifest guarantees every chunk is readable."""
 
-    def __init__(self, client, uri: str, *, poll_s: float = 0.02):
+    def __init__(self, client, uri: str, *, poll_s: float = 0.02,
+                 clock=None):
         self._client = client
         self._uri = uri
         self._poll_s = poll_s
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
 
     def _manifest(self) -> Optional[dict]:
         uri = join_uri(self._uri, "manifest.json")
@@ -447,16 +466,16 @@ class StorageTokenStreamReader:
         """Block until the manifest commits; returns ``{"tokens",
         "status", "error"}``. Raises :class:`StreamFailed` for a failed
         stream, ``TimeoutError`` past the budget."""
-        deadline = time.monotonic() + timeout_s
+        deadline = self._clock.now() + timeout_s
         while True:
             manifest = self._manifest()
             if manifest is not None:
                 break
-            if time.monotonic() > deadline:
+            if self._clock.now() > deadline:
                 raise TimeoutError(
                     f"spilled stream at {self._uri} not finished within "
                     f"{timeout_s}s")
-            time.sleep(self._poll_s)
+            self._clock.sleep(self._poll_s)
         tokens: List[int] = []
         for n in range(manifest["chunks"]):
             uri = join_uri(self._uri, f"chunk-{n:06d}.json")
@@ -471,7 +490,7 @@ class StorageTokenStreamReader:
     def iter_tokens(self, timeout_s: float = 120.0) -> Iterator[int]:
         """Incremental read: yield chunk contents as chunks appear,
         return once the manifest commits and every chunk is drained."""
-        deadline = time.monotonic() + timeout_s
+        deadline = self._clock.now() + timeout_s
         next_chunk = 0
         while True:
             uri = join_uri(self._uri, f"chunk-{next_chunk:06d}.json")
@@ -487,8 +506,8 @@ class StorageTokenStreamReader:
                         f"spilled stream at {self._uri} failed: "
                         f"{manifest.get('error')}")
                 return
-            if time.monotonic() > deadline:
+            if self._clock.now() > deadline:
                 raise TimeoutError(
                     f"spilled stream at {self._uri} stalled at chunk "
                     f"{next_chunk} for {timeout_s}s")
-            time.sleep(self._poll_s)
+            self._clock.sleep(self._poll_s)

@@ -154,6 +154,12 @@ class PostgresOperationStore(OperationStore):
 
     def __init__(self, dsn: str, *, _connect=connect):
         # deliberately NOT calling super().__init__ — different connection
+        from lzy_tpu.utils.clock import SYSTEM_CLOCK
+
+        # the base class's row timestamps read self._clock; a real
+        # Postgres shares only wall time with us, so the system clock is
+        # the one correct choice here (see the clock-pass allowlist)
+        self._clock = SYSTEM_CLOCK
         self._dsn = dsn
         self._conn, integrity, self._sqlstate = _connect(dsn)
         self._integrity_errors = (integrity,)

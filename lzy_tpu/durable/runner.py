@@ -16,13 +16,13 @@ from __future__ import annotations
 import dataclasses
 import enum
 import threading
-import time
 import traceback
 import typing
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from lzy_tpu.durable.failures import InjectedFailures
 from lzy_tpu.durable.store import DONE, FAILED, RUNNING, OperationStore, OpRecord
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.utils.ids import gen_id
 from lzy_tpu.utils.log import get_logger, logging_context
 
@@ -92,7 +92,11 @@ class OperationsExecutor:
     """Runs durable operations on worker threads; schedules RESTART delays;
     restores RUNNING ops on boot."""
 
-    def __init__(self, store: OperationStore, workers: int = 4):
+    def __init__(self, store: OperationStore, workers: int = 4, *,
+                 clock=None):
+        # injectable time (utils/clock): retry not-before deadlines, op
+        # deadlines and the join timeout all read it
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         self._store = store
         self._factories: Dict[str, Callable[..., OperationRunner]] = {}
         self._cv = threading.Condition()
@@ -126,7 +130,7 @@ class OperationsExecutor:
         record = self._store.create(
             op_id or gen_id(f"op-{kind}"), kind, state,
             idempotency_key=idempotency_key,
-            deadline=(time.time() + deadline_s) if deadline_s else None,
+            deadline=(self._clock.time() + deadline_s) if deadline_s else None,
         )
         if record.status == RUNNING:
             self._enqueue(record.id, 0.0)
@@ -144,17 +148,20 @@ class OperationsExecutor:
         return resumed
 
     def await_op(self, op_id: str, timeout_s: float = 30.0) -> OpRecord:
-        deadline = time.time() + timeout_s
+        deadline = self._clock.time() + timeout_s
         event = self._waiters.setdefault(op_id, threading.Event())
         while True:
             record = self._store.load(op_id)
             if record.done:
                 self._waiters.pop(op_id, None)  # don't leak one event per op
                 return record
-            remaining = deadline - time.time()
+            remaining = deadline - self._clock.time()
             if remaining <= 0:
                 raise TimeoutError(f"operation {op_id} still {record.status}")
-            event.wait(min(remaining, 0.5))
+            # clock.wait, not event.wait: remaining is CLOCK seconds —
+            # under a virtual clock a raw wait would park real seconds
+            # against a frozen deadline and the timeout could never fire
+            self._clock.wait(event, min(remaining, 0.5))
 
     def shutdown(self, *, join_timeout_s: float = 5.0) -> None:
         with self._cv:
@@ -162,9 +169,9 @@ class OperationsExecutor:
             self._cv.notify_all()
         # drain: let in-flight ops finish their current step before the caller
         # closes the store underneath them; one deadline bounds the WHOLE drain
-        deadline = time.time() + join_timeout_s
+        deadline = self._clock.time() + join_timeout_s
         for t in self._threads:
-            t.join(max(0.0, deadline - time.time()))
+            t.join(max(0.0, deadline - self._clock.time()))
 
     # -- internals -------------------------------------------------------------
 
@@ -179,7 +186,7 @@ class OperationsExecutor:
             if not requeue and op_id in self._inflight:
                 return False
             self._inflight.add(op_id)
-            self._queue.append((time.time() + delay_s, op_id))
+            self._queue.append((self._clock.time() + delay_s, op_id))
             self._queue.sort()
             self._cv.notify()
             return True
@@ -187,13 +194,19 @@ class OperationsExecutor:
     def _pop(self) -> Optional[str]:
         with self._cv:
             while not self._stopped:
-                now = time.time()
+                now = self._clock.time()
                 ready = [i for i, (t, _) in enumerate(self._queue) if t <= now]
                 if ready:
                     _, op_id = self._queue.pop(ready[0])
                     self._driving[op_id] = self._driving.get(op_id, 0) + 1
                     return op_id
                 timeout = (self._queue[0][0] - now) if self._queue else None
+                if timeout is not None and \
+                        getattr(self._clock, "virtual", False):
+                    # retry not-before stamps are CLOCK time; a raw cv
+                    # can't be woken virtually, so backstop-poll and
+                    # re-read the clock (the token_stream discipline)
+                    timeout = min(timeout, 0.05)
                 self._cv.wait(timeout=timeout)
             return None
 
@@ -228,7 +241,7 @@ class OperationsExecutor:
         record = self._store.load(op_id)
         if record.done:
             return
-        if record.deadline is not None and time.time() > record.deadline:
+        if record.deadline is not None and self._clock.time() > record.deadline:
             runner = self._make_runner(record)
             self._store.fail(op_id, "operation deadline exceeded")
             runner.on_expired()

@@ -15,8 +15,9 @@ import dataclasses
 import json
 import sqlite3
 import threading
-import time
 from typing import Any, Dict, List, Optional, Tuple
+
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 
 RUNNING = "RUNNING"
 DONE = "DONE"
@@ -80,7 +81,11 @@ class OperationStore:
     #: driver exception types that signal a unique-constraint violation
     _integrity_errors: tuple = (sqlite3.IntegrityError,)
 
-    def __init__(self, path: str = ":memory:"):
+    def __init__(self, path: str = ":memory:", *, clock=None):
+        # injectable time (utils/clock): row timestamps, lease expiries
+        # and idempotency deadlines are wall-clock reads off it —
+        # deterministic under a virtual clock, bit-identical otherwise
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.executescript(_SCHEMA)
@@ -103,7 +108,7 @@ class OperationStore:
                deadline: Optional[float] = None) -> OpRecord:
         """Insert a RUNNING op; an existing op with the same idempotency key is
         returned instead (reference ``IdempotencyUtils`` dedup)."""
-        now = time.time()
+        now = self._clock.time()
         with self._lock:
             if idempotency_key is not None:
                 row = self._execute(
@@ -175,7 +180,7 @@ class OperationStore:
             self._execute(
                 "UPDATE operations SET state = ?, step = ?, updated_at = ? "
                 "WHERE id = ? AND status = ?",
-                (json.dumps(state), step, time.time(), op_id, RUNNING),
+                (json.dumps(state), step, self._clock.time(), op_id, RUNNING),
             )
             self._conn.commit()
 
@@ -188,7 +193,7 @@ class OperationStore:
         whether the row was settled by THIS call."""
         sql = ("UPDATE operations SET status = ?, result = ?, updated_at = ? "
                "WHERE id = ? AND status = ?")
-        params = [DONE, json.dumps(result), time.time(), op_id, RUNNING]
+        params = [DONE, json.dumps(result), self._clock.time(), op_id, RUNNING]
         if if_deadline is not ...:
             sql += " AND deadline IS ?"
             params.append(if_deadline)
@@ -204,7 +209,7 @@ class OperationStore:
         call."""
         sql = ("UPDATE operations SET status = ?, error = ?, updated_at = ? "
                "WHERE id = ? AND status = ?")
-        params = [FAILED, error, time.time(), op_id, RUNNING]
+        params = [FAILED, error, self._clock.time(), op_id, RUNNING]
         if if_deadline is not ...:
             sql += " AND deadline IS ?"
             params.append(if_deadline)
@@ -223,7 +228,7 @@ class OperationStore:
             cur = self._execute(
                 "UPDATE operations SET deadline = ?, updated_at = ? "
                 "WHERE id = ? AND status = ? AND deadline IS ?",
-                (new_deadline, time.time(), op_id, RUNNING, old_deadline),
+                (new_deadline, self._clock.time(), op_id, RUNNING, old_deadline),
             )
             self._conn.commit()
             return cur.rowcount == 1
@@ -232,7 +237,7 @@ class OperationStore:
         """Delete DONE/FAILED ops of the given kind prefix not updated for
         ``older_than_s`` — retention for high-churn records (idempotency
         dedup rows); returns rows deleted."""
-        cutoff = time.time() - older_than_s
+        cutoff = self._clock.time() - older_than_s
         with self._lock:
             cur = self._execute(
                 "DELETE FROM operations WHERE kind LIKE ? "
@@ -289,7 +294,7 @@ class OperationStore:
 
     def try_acquire_lease(self, name: str, owner: str, ttl_s: float) -> bool:
         """Acquire if free, expired, or already ours. Returns ownership."""
-        now = time.time()
+        now = self._clock.time()
         with self._lock:
             cur = self._execute(
                 "UPDATE leases SET owner = ?, expires_at = ? "
@@ -317,7 +322,7 @@ class OperationStore:
             cur = self._execute(
                 "UPDATE leases SET expires_at = ? "
                 "WHERE name = ? AND owner = ?",
-                (time.time() + ttl_s, name, owner),
+                (self._clock.time() + ttl_s, name, owner),
             )
             self._conn.commit()
             return cur.rowcount == 1
@@ -337,6 +342,6 @@ class OperationStore:
                 "SELECT owner, expires_at FROM leases WHERE name = ?",
                 (name,),
             ).fetchone()
-        if row is None or row[1] < time.time():
+        if row is None or row[1] < self._clock.time():
             return None
         return row[0], row[1]

@@ -117,6 +117,12 @@ class PrefixAffinityRouter:
         """Record that ``tokens`` were routed to ``replica_id`` — its
         engine will now hold (or refresh) those prefix blocks.
         ``session`` additionally pins that conversation to the replica."""
+        # hash OUTSIDE the lock: chunk_hashes is a pure function of the
+        # prompt, and observe runs on every routed request — O(prompt)
+        # hashing under the fleet-global router lock was the same
+        # per-request-latency-cliff class as the PR 12 index re-sort
+        # (surfaced by lzy-lint's held-call inventory)
+        hashes = chunk_hashes(tokens, self.page_size)
         with self._lock:
             self._clock += 1
             if session is not None:
@@ -126,8 +132,7 @@ class PrefixAffinityRouter:
                                  key=lambda s: self._sessions[s][1])
                     del self._sessions[victim]
             idx = self._index.setdefault(replica_id, {})
-            for depth, h in enumerate(
-                    chunk_hashes(tokens, self.page_size)):
+            for depth, h in enumerate(hashes):
                 idx[h] = (self._clock, depth)
             if len(idx) > self._cap + self._cap // 4:
                 # evict oldest chains, DEEPEST first within one prompt:
@@ -170,9 +175,9 @@ class PrefixAffinityRouter:
         Read-only: probing must not keep an expectation hot — only an
         actual route does (``observe`` refreshes the chosen replica's
         chains), so entries on losing replicas age out as designed."""
+        hashes = chunk_hashes(tokens, self.page_size)   # outside the lock
         with self._lock:
-            return self._match_locked(
-                replica_id, chunk_hashes(tokens, self.page_size))
+            return self._match_locked(replica_id, hashes)
 
     def _match_locked(self, replica_id: str,
                       hashes: Sequence[int]) -> int:
@@ -201,6 +206,12 @@ class PrefixAffinityRouter:
         if not loads:
             return None, "empty"
         session_rate = None
+        # hash the prompt ONCE, before taking the lock: under routing
+        # contention every concurrent choose() used to serialize its
+        # O(chunks) hashing behind the fleet-global lock. A session-
+        # pinned route now pays a hash it may not use — off the lock,
+        # in parallel — which is the right trade for a shared hot path.
+        hashes = chunk_hashes(tokens, self.page_size)
         with self._lock:
             min_load = min(loads.values())
             choice = reason = None
@@ -220,9 +231,6 @@ class PrefixAffinityRouter:
                     session_rate = (self._session_hits
                                     / self._session_routed)
             if choice is None:
-                # hash the prompt ONCE; matching each replica's index is
-                # then O(chunks) membership checks on the request hot path
-                hashes = chunk_hashes(tokens, self.page_size)
                 best_id, best_match = None, 0
                 for rid in loads:
                     m = self._match_locked(rid, hashes)

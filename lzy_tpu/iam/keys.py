@@ -24,8 +24,9 @@ imports cleanly on hosts without it.
 from __future__ import annotations
 
 import base64
-import time
 from typing import Optional, Tuple
+
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 
 ED_PREFIX = "ed/"
 
@@ -70,7 +71,7 @@ def sign_token(private_pem: str, subject_id: str, gen: int = 0,
         raise ValueError("subject id must not contain ':'")
     private = serialization.load_pem_private_key(
         private_pem.encode(), password=None)
-    ts = str(int(now if now is not None else time.time()))
+    ts = str(int(now if now is not None else SYSTEM_CLOCK.time()))
     payload = f"{subject_id}:{ts}:{gen}".encode()
     sig = base64.urlsafe_b64encode(private.sign(payload)).decode().rstrip("=")
     return f"{ED_PREFIX}{subject_id}:{ts}:{gen}:{sig}"

@@ -30,10 +30,10 @@ import dataclasses
 import hashlib
 import hmac
 import secrets
-import time
 from typing import Dict, Optional
 
 from lzy_tpu.durable.store import OperationStore
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 
 USER = "USER"
 WORKER = "WORKER"
@@ -86,9 +86,12 @@ class IamService:
     DEFAULT_MAX_TOKEN_AGE_S = 7 * 24 * 3600.0
 
     def __init__(self, store: OperationStore, secret: Optional[str] = None,
-                 max_token_age_s: Optional[float] = None):
+                 max_token_age_s: Optional[float] = None, *, clock=None):
         import threading
 
+        # injectable time (utils/clock): OTT expiry and token-age checks
+        # are wall-clock reads off it (cross-process timestamps)
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         self._store = store
         self._ott_lock = threading.Lock()
         self.max_token_age_s = (
@@ -211,7 +214,7 @@ class IamService:
             # not accumulate rows forever in the durable store
             self._purge_expired_otts_locked()
             self._store.kv_put(self._OTT_NS, nonce, {
-                "subject": subject_id, "expires": time.time() + ttl,
+                "subject": subject_id, "expires": self._clock.time() + ttl,
             })
         # deliberately NOT a valid bearer shape: authenticate() rejects it,
         # so an OTT can never be replayed as a session token
@@ -247,12 +250,12 @@ class IamService:
                     f"not {expect_subject}"
                 )
             self._store.kv_del(self._OTT_NS, key)
-        if time.time() > float(doc["expires"]):
+        if self._clock.time() > float(doc["expires"]):
             raise AuthError("one-time token expired")
         return doc["subject"]
 
     def _purge_expired_otts_locked(self) -> None:
-        now = time.time()
+        now = self._clock.time()
         for key, doc in list(self._store.kv_list(self._OTT_NS).items()):
             if doc is None or now > float(doc["expires"]):
                 self._store.kv_del(self._OTT_NS, key)
@@ -264,7 +267,7 @@ class IamService:
     # -- tokens ----------------------------------------------------------------
 
     def _issue(self, subject_id: str, gen: int) -> str:
-        ts = str(int(time.time()))
+        ts = str(int(self._clock.time()))
         sig = hmac.new(self._secret, f"{subject_id}:{ts}:{gen}".encode(),
                        hashlib.sha256).hexdigest()
         return f"{subject_id}:{ts}:{gen}:{sig}"
@@ -291,7 +294,7 @@ class IamService:
             issued_at = float(ts)
         except ValueError:
             raise AuthError("malformed token timestamp")
-        if time.time() - issued_at > self.max_token_age_s:
+        if self._clock.time() - issued_at > self.max_token_age_s:
             raise AuthError("token expired")
         doc = self._store.kv_get("iam", f"subject:{subject_id}")
         if doc is None:
@@ -321,7 +324,7 @@ class IamService:
         keys = doc.get("keys") or {}
         if not any(ed.verify(pem, payload, sig) for pem in keys.values()):
             raise AuthError("invalid token signature")
-        if time.time() - issued_at > self.max_token_age_s:
+        if self._clock.time() - issued_at > self.max_token_age_s:
             raise AuthError("token expired")
         if gen != int(doc.get("gen", 0)):
             raise AuthError("token revoked (stale generation)")

@@ -35,11 +35,11 @@ What riding the workflow buys a generation, for free:
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from lzy_tpu.chaos.faults import CHAOS
 from lzy_tpu.utils.backoff import RetryPolicy
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.utils.ids import gen_id
 from lzy_tpu.utils.log import get_logger
 
@@ -170,7 +170,7 @@ def llm_generate(prompt, gen_params, model_digest,
     stream, spill, spill_thread, stream_owned = _resolve_stream(opts)
     session = conversation.id if conversation is not None else None
     prompt_tokens = [int(t) for t in prompt]
-    t0 = time.monotonic()
+    t0 = SYSTEM_CLOCK.now()
 
     def dispatch():
         CHAOS.hit("llm.dispatch")
@@ -243,7 +243,7 @@ def llm_generate(prompt, gen_params, model_digest,
         ttft_ms=reply.get("ttft_ms"),
         conversation_id=session,
         step=step,
-        wall_ms=round(1000 * (time.monotonic() - t0), 3),
+        wall_ms=round(1000 * (SYSTEM_CLOCK.now() - t0), 3),
     )
 
 

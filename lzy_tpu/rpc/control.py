@@ -16,7 +16,6 @@ Clients mirror the in-process method surfaces, so ``RemoteRuntime`` and
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Any, Dict, List, Optional
 
 from lzy_tpu.channels.manager import Channel
@@ -24,6 +23,7 @@ from lzy_tpu.channels.p2p import SlotPeer
 from lzy_tpu.rpc.core import JsonRpcClient, JsonRpcServer
 from lzy_tpu.service.graph import TaskDesc
 from lzy_tpu.types import TpuPoolSpec, VmSpec
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.utils.log import get_logger
 
 _LOG = get_logger(__name__)
@@ -55,13 +55,11 @@ class WorkerToken:
 
         if self.private_key is None or not ed.is_ed_token(self.current):
             return None
-        import time as _time
-
         try:
             subject_id, issued_at, gen, _, _ = ed.parse_token(self.current)
         except ValueError:
             return None
-        if _time.time() - issued_at < self.SELF_REFRESH_S:
+        if SYSTEM_CLOCK.time() - issued_at < self.SELF_REFRESH_S:
             return None
         fresh = ed.sign_token(self.private_key, subject_id, gen)
         self.rotate(fresh)
@@ -551,9 +549,11 @@ class RpcChannelsClient:
     the subset of ChannelManager the worker uses. Device residency stays
     process-local (that is its meaning)."""
 
-    def __init__(self, client: JsonRpcClient, token=None):
+    def __init__(self, client: JsonRpcClient, token=None, *, clock=None):
         from lzy_tpu.channels.manager import DeviceResidency
 
+        # injectable time (utils/clock): the wait_available deadline loop
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         self._client = client
         self._token = token                # str or shared WorkerToken holder
         self.device = DeviceResidency()
@@ -583,7 +583,8 @@ class RpcChannelsClient:
                        timeout_s: Optional[float] = 300.0) -> _ChannelView:
         from lzy_tpu.channels.manager import ChannelFailed
 
-        deadline = None if timeout_s is None else time.time() + timeout_s
+        deadline = None if timeout_s is None else \
+            self._clock.time() + timeout_s
         while True:
             doc = self._client.call("WaitChannel", {
                 "entry_id": entry_id, "timeout_s": 2.0,
@@ -595,7 +596,7 @@ class RpcChannelsClient:
                 peer = SlotPeer(**doc["slot_peer"]) if doc["slot_peer"] else None
                 return _ChannelView(doc["completed"], doc["failed"], peer,
                                     doc["storage_uri"])
-            if deadline is not None and time.time() > deadline:
+            if deadline is not None and self._clock.time() > deadline:
                 raise TimeoutError(
                     f"channel {entry_id} not available after {timeout_s}s"
                 )
@@ -714,7 +715,9 @@ class RpcWhiteboardClient:
     against ``WhiteboardService.java:45``."""
 
     def __init__(self, address: Optional[str] = None, *, token=None,
-                 client: Optional[JsonRpcClient] = None):
+                 client: Optional[JsonRpcClient] = None, clock=None):
+        # injectable time (utils/clock): the iter_stream poll deadline
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         if client is None:
             if address is None:
                 raise ValueError("pass address or client")
@@ -781,7 +784,9 @@ class RpcInferenceClient:
     ``stats`` is read-only and retries transparently."""
 
     def __init__(self, address: Optional[str] = None, *, token=None,
-                 client: Optional[JsonRpcClient] = None):
+                 client: Optional[JsonRpcClient] = None, clock=None):
+        # injectable time (utils/clock): the iter_stream poll deadline
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         if client is None:
             if address is None:
                 raise ValueError("pass address or client")
@@ -902,21 +907,22 @@ class RpcInferenceClient:
 
         pos = int(position)
         failures = 0
-        deadline = time.time() + deadline_s
+        deadline = self._clock.time() + deadline_s
         while True:
             try:
                 frame = self.stream_poll(request_id, pos, wait_s=wait_s)
                 failures = 0
             except (Unavailable, TimeoutError):
                 failures += 1
-                if failures > max_poll_failures or time.time() > deadline:
+                if failures > max_poll_failures or \
+                        self._clock.time() > deadline:
                     raise
                 continue
             yield frame
             pos += len(frame.get("tokens", ()))
             if frame.get("done"):
                 return
-            if time.time() > deadline:
+            if self._clock.time() > deadline:
                 raise TimeoutError(
                     f"stream {request_id} not finished within "
                     f"{deadline_s}s")

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import pickle
 import sys
-import time
 from typing import TYPE_CHECKING, Dict, List, Optional, Sequence
 
 import cloudpickle
@@ -39,9 +38,13 @@ class RemoteRuntime(Runtime):
     def __init__(self, client, *, user: str = "local-user",
                  token: Optional[str] = None,
                  poll_period_s: float = 0.05, stream_logs: bool = True,
-                 graph_timeout_s: float = 600.0):
+                 graph_timeout_s: float = 600.0, clock=None):
         import os
 
+        from lzy_tpu.utils.clock import SYSTEM_CLOCK
+
+        # injectable time (utils/clock): the graph poll loop reads it
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         self._client = client
         self._user = user
         # env var contract mirrors the reference (LZY_USER/LZY_KEY_PATH,
@@ -186,7 +189,7 @@ class RemoteRuntime(Runtime):
 
     def _poll_until_done(self, workflow: "LzyWorkflow", graph_op_id: str,
                          calls: Sequence["LzyCall"]) -> None:
-        deadline = time.time() + self._graph_timeout_s
+        deadline = self._clock.time() + self._graph_timeout_s
         while True:
             status = self._client.graph_status(
                 workflow.execution_id, graph_op_id, token=self._token
@@ -197,14 +200,14 @@ class RemoteRuntime(Runtime):
                 return
             if status["status"] == "FAILED":
                 self._raise_remote(workflow, status, calls)
-            if time.time() > deadline:
+            if self._clock.time() > deadline:
                 self._client.stop_graph(
                     workflow.execution_id, graph_op_id, token=self._token
                 )
                 raise TimeoutError(
                     f"graph {graph_op_id} still running after {self._graph_timeout_s}s"
                 )
-            time.sleep(self._poll_period_s)
+            self._clock.sleep(self._poll_period_s)
 
     def _pump_logs(self, workflow: "LzyWorkflow") -> None:
         try:

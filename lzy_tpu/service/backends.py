@@ -72,9 +72,9 @@ class ThreadVmBackend(VmBackend):
 
         def boot() -> None:
             if self._launch_delay_s:
-                import time
+                from lzy_tpu.utils.clock import SYSTEM_CLOCK
 
-                time.sleep(self._launch_delay_s)
+                SYSTEM_CLOCK.sleep(self._launch_delay_s)
             spill = None
             if self._spill_root is not None:
                 spill = os.path.join(self._spill_root, vm.id)

@@ -35,9 +35,9 @@ import json
 import os
 import re
 import shutil
-import time
 from typing import Dict, List, Optional
 
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.durable.runner import OperationRunner, OperationsExecutor, StepResult
 from lzy_tpu.durable.store import FAILED, OperationStore
 from lzy_tpu.utils.ids import gen_id
@@ -375,7 +375,8 @@ class _CreateDiskAction(OperationRunner):
             id=self.state["disk_id"],
             spec=DiskSpec.from_doc(self.state["spec"]),
             meta=DiskMeta.from_doc(self.state["meta"]),
-            created_ts=self.state.setdefault("created_ts", time.time()),
+            created_ts=self.state.setdefault("created_ts",
+                                             SYSTEM_CLOCK.time()),
         )
 
     def _create(self):

@@ -13,9 +13,9 @@ program on each host.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Any, Dict, List, Optional
 
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.durable import (
     DONE,
     FAILED,
@@ -324,7 +324,7 @@ class _ExecTaskAction(OperationRunner):
         except KeyError:
             return False
         return vm.status == VM_RUNNING and (
-            time.time() - vm.heartbeat_ts
+            SYSTEM_CLOCK.time() - vm.heartbeat_ts
             < self.svc._allocator.HEARTBEAT_TIMEOUT_S
         )
 

@@ -12,7 +12,6 @@ local single-machine deployment mode, not just a test rig.
 from __future__ import annotations
 
 import os
-import time
 from typing import List, Optional, Sequence
 
 from lzy_tpu.channels.manager import ChannelManager
@@ -25,6 +24,7 @@ from lzy_tpu.service.backends import ThreadVmBackend
 from lzy_tpu.service.graph_executor import GraphExecutor
 from lzy_tpu.service.workflow_service import WorkflowService
 from lzy_tpu.storage import DefaultStorageRegistry, StorageConfig
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.storage.registry import client_for
 from lzy_tpu.types import PoolSpec, TpuPoolSpec, VmSpec
 
@@ -97,7 +97,8 @@ class InProcessCluster:
                     f"metadata store {db_path!r} is already driven by "
                     f"control plane {holder[0] if holder else '?'} (lease "
                     f"expires in "
-                    f"{holder[1] - time.time():.0f}s); exactly one plane "
+                    f"{holder[1] - SYSTEM_CLOCK.time():.0f}s); "
+                    f"exactly one plane "
                     f"per store — stop it, or wait for its lease to lapse"
                     if holder else
                     f"could not acquire the control-plane lease on "

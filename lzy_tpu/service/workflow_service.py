@@ -11,7 +11,6 @@ methods directly; a gRPC binding can wrap this object 1:1.
 from __future__ import annotations
 
 import os
-import time
 from typing import Any, Dict, List, Optional
 
 from lzy_tpu.channels.manager import ChannelManager
@@ -20,6 +19,7 @@ from lzy_tpu.service.allocator import AllocatorService
 from lzy_tpu.service.graph import GraphDesc, build_dependencies
 from lzy_tpu.service.graph_executor import GraphExecutor
 from lzy_tpu.storage.api import StorageClient, join_uri
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.utils.ids import gen_id
 from lzy_tpu.utils.log import get_logger
 from lzy_tpu.types import PoolSpec
@@ -67,7 +67,12 @@ class WorkflowService:
         graph_executor: GraphExecutor,
         storage_client: StorageClient,
         iam=None,                        # Optional[IamService]; None = open access
+        clock=None,
     ):
+        # injectable time (utils/clock): idempotency TTLs, execution
+        # timestamps and the dedup wait loop run on it, so control-plane
+        # tests replay deterministically on a virtual clock
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
         self._store = store
         self._executor = executor
         self._allocator = allocator
@@ -159,12 +164,18 @@ class WorkflowService:
             # is still running (ADVICE r3). The CAS-refresh also detects
             # the converse: if someone DID reclaim us, the heartbeat loses
             # the CAS and stops, leaving completion to the new owner.
-            stop = threading.Event()
+            # the stop event and the wait both come from the clock: the
+            # reclaim deadline is stamped in CLOCK time, so the refresh
+            # cadence must tick on the same axis — a real-time wait
+            # against a virtual deadline would let a sim driver age the
+            # record past its TTL before the first heartbeat fires
+            stop = self._clock.event()
             deadline_box = [owned_deadline]
 
             def heartbeat() -> None:
-                while not stop.wait(self.IDEM_INFLIGHT_TTL_S / 3):
-                    fresh = time.time() + self.IDEM_INFLIGHT_TTL_S
+                while not self._clock.wait(stop,
+                                           self.IDEM_INFLIGHT_TTL_S / 3):
+                    fresh = self._clock.time() + self.IDEM_INFLIGHT_TTL_S
                     if self._store.reclaim(record_id, deadline_box[0], fresh):
                         deadline_box[0] = fresh
                     else:
@@ -197,7 +208,7 @@ class WorkflowService:
             return result
 
         op_id = gen_id(f"idem-{kind}")
-        first_deadline = time.time() + self.IDEM_INFLIGHT_TTL_S
+        first_deadline = self._clock.time() + self.IDEM_INFLIGHT_TTL_S
         rec = self._store.create(op_id, f"idem.{kind}", {},
                                  idempotency_key=key,
                                  deadline=first_deadline)
@@ -210,20 +221,20 @@ class WorkflowService:
             raise ValueError(
                 f"idempotency key {key!r} was already used for "
                 f"{rec.kind.removeprefix('idem.')!r}, not {kind!r}")
-        wait_deadline = time.time() + wait_s
+        wait_deadline = self._clock.time() + wait_s
         while rec.status == RUNNING:
-            if rec.deadline is not None and time.time() > rec.deadline:
-                takeover_deadline = time.time() + self.IDEM_INFLIGHT_TTL_S
+            if rec.deadline is not None and self._clock.time() > rec.deadline:
+                takeover_deadline = self._clock.time() + self.IDEM_INFLIGHT_TTL_S
                 if self._store.reclaim(rec.id, rec.deadline,
                                        takeover_deadline):
                     _LOG.warning(
                         "taking over orphaned idempotent %s (key %s)",
                         kind, key)
                     return run_and_record(rec.id, takeover_deadline)
-            elif time.time() > wait_deadline:
+            elif self._clock.time() > wait_deadline:
                 raise RuntimeError(
                     f"request with idempotency key {key!r} still in flight")
-            time.sleep(0.05)
+            self._clock.sleep(0.05)
             rec = self._store.load(rec.id)
         if rec.error is not None:
             raise _replay_error(rec.error)
@@ -270,7 +281,7 @@ class WorkflowService:
             "session_id": session_id,
             "status": ACTIVE,
             "graphs": [],
-            "started_at": time.time(),
+            "started_at": self._clock.time(),
         })
         _LOG.info("started execution %s (session %s)", execution_id, session_id)
         return execution_id
@@ -309,7 +320,7 @@ class WorkflowService:
         self._channels.destroy_all(execution_id)
         self._allocator.delete_session(exec_doc["session_id"])
         exec_doc["status"] = final_status
-        exec_doc["finished_at"] = time.time()
+        exec_doc["finished_at"] = self._clock.time()
         self._store.kv_put("executions", execution_id, exec_doc)
 
     def _execution(self, execution_id: str) -> Dict[str, Any]:
@@ -441,7 +452,7 @@ class WorkflowService:
         keyed mutation creates one, so without retention the store grows
         one row per graph submission forever (the reference TTLs its
         idempotency keys the same way)."""
-        now = now if now is not None else time.time()
+        now = now if now is not None else self._clock.time()
         purged = self._store.purge_done_ops("idem.", idem_ttl_s)
         if purged:
             _LOG.info("gc purged %d settled idempotency records", purged)

@@ -19,7 +19,6 @@ disaggregated output bit-identical, greedy and sampled.
 
 from __future__ import annotations
 
-import time
 
 from lzy_tpu.serving.disagg.kv_export import export_kv
 from lzy_tpu.serving.engine import _REQUESTS, PagedInferenceEngine
@@ -75,7 +74,7 @@ class PrefillEngine(PagedInferenceEngine):
         engine's — thread, so no concurrent prefill can donate the pool
         buffers mid-read), then finish the request WITHOUT emitting the
         sampled token (see module docstring)."""
-        now = time.monotonic()
+        now = self._clock.now()
         req.first_token_at = now            # "time to KV ready" here
         _PREFILL_SECONDS.observe(now - req.submitted_at)
         try:

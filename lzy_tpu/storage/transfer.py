@@ -21,13 +21,13 @@ from __future__ import annotations
 import dataclasses
 import os
 import tempfile
-import time
 from concurrent import futures
 from typing import Callable, Optional
 
 from lzy_tpu.chaos.faults import CHAOS
 from lzy_tpu.storage.api import StorageClient
 from lzy_tpu.utils.backoff import RetryPolicy
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
 from lzy_tpu.utils.log import get_logger
 
 _LOG = get_logger(__name__)
@@ -108,7 +108,7 @@ def log_progress(name: str, period_s: float = 5.0) -> Progress:
     state = {"t": 0.0}
 
     def cb(done: int, total: int) -> None:
-        now = time.monotonic()
+        now = SYSTEM_CLOCK.now()
         if done >= total or now - state["t"] >= period_s:
             state["t"] = now
             pct = 100.0 * done / total if total else 100.0

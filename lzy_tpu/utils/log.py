@@ -14,7 +14,6 @@ import logging
 import os
 import sys
 import threading
-import time
 from typing import Any, Dict, Iterator
 
 _LOG_CTX: contextvars.ContextVar[Dict[str, str]] = contextvars.ContextVar(
@@ -75,10 +74,12 @@ class MetricEventLogger:
 
     @contextlib.contextmanager
     def timed(self, event: str, **tags: Any) -> Iterator[None]:
-        t0 = time.monotonic()
+        from lzy_tpu.utils.clock import SYSTEM_CLOCK
+
+        t0 = SYSTEM_CLOCK.now()
         try:
             yield
         finally:
-            dt = (time.monotonic() - t0) * 1000
+            dt = (SYSTEM_CLOCK.now() - t0) * 1000
             self._log.info("metric %s took_ms=%.1f %s", event, dt,
                            " ".join(f"{k}={v}" for k, v in tags.items()))

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import http.server
 import threading
-import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
@@ -98,13 +97,15 @@ class Histogram:
     def time(self, **labels: str):
         hist = self
 
+        from lzy_tpu.utils.clock import SYSTEM_CLOCK
+
         class _Timer:
             def __enter__(self):
-                self._t0 = time.monotonic()
+                self._t0 = SYSTEM_CLOCK.now()
                 return self
 
             def __exit__(self, *exc):
-                hist.observe(time.monotonic() - self._t0, **labels)
+                hist.observe(SYSTEM_CLOCK.now() - self._t0, **labels)
 
         return _Timer()
 

@@ -1,0 +1,7 @@
+# corpus: a disable comment with no justification neither silences the
+# finding nor passes suppression hygiene.
+import time
+
+
+def nap():
+    time.sleep(0.1)  # lzy-lint: disable=clock-raw-time

@@ -1,0 +1,24 @@
+# corpus: the PR 12 class — blocking/expensive work performed while a
+# shared lock is held. Every other thread serializes behind the sleep,
+# the storage read, and the event wait.
+import threading
+import time  # lzy-lint: disable=clock-raw-time -- corpus twin exercises the LOCK rule; the clock rule has its own pair
+
+
+class Blocky:
+    def __init__(self, storage):
+        self._lock = threading.Lock()
+        self._storage = storage
+        self._done = threading.Event()
+
+    def slow_tick(self):
+        with self._lock:
+            time.sleep(0.05)  # lzy-lint: disable=clock-raw-time -- corpus twin exercises the LOCK rule; the clock rule has its own pair
+
+    def fetch_state(self, uri):
+        with self._lock:
+            return self._storage.read_bytes(uri)
+
+    def wait_done(self):
+        with self._lock:
+            return self._done.wait(1.0)

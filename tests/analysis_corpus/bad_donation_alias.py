@@ -1,0 +1,21 @@
+# corpus: the PR 5 segfault shape — jnp.asarray zero-copies host numpy
+# memory, then the resulting leaf is donated; XLA may receive the same
+# buffer twice (or free memory the host still mirrors).
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(cache, tokens):
+    return cache, tokens
+
+
+def drive(cache, tokens):
+    vals = np.zeros((4,), np.int32)
+    leaves = jnp.asarray(vals)       # zero-copy view of host memory
+    out = step(leaves, tokens)       # ...donated: host mirror aliases it
+    dup = step(cache, cache)         # same expression donated AND passed
+    return out, dup

@@ -1,0 +1,21 @@
+# corpus: raw wall-clock reads and sleeps — the PR 12 injectable-clock
+# invariant regressed. Under a VirtualClock fleet these stall at the
+# real-time backstop and make every test slow and racy.
+import time
+from time import sleep
+
+
+class Poller:
+    def __init__(self):
+        self._last = time.time()
+
+    def wait_for(self, probe, timeout_s):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if probe():
+                return True
+            time.sleep(0.05)
+        return False
+
+    def idle(self):
+        sleep(1.0)

@@ -1,0 +1,19 @@
+# corpus: the PR 6 self-deadlock shape — a method holding its own
+# non-reentrant Lock calls a helper that re-acquires the same lock.
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+
+    def submit(self, item):
+        with self._lock:
+            self._queue.append(item)
+            # computing the backoff hint under our own lock re-enters it
+            return self.retry_after_s()
+
+    def retry_after_s(self):
+        with self._lock:
+            return 0.1 * len(self._queue)

@@ -1,0 +1,13 @@
+# corpus: Python control flow on a traced value inside a jitted
+# function — a trace-time ConcretizationTypeError at best, silent
+# specialization at worst.
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=())
+def clamp(x, limit):
+    if x > limit:            # traced comparison in Python `if`
+        return limit
+    return x

@@ -1,0 +1,31 @@
+# corpus: broken chaos contracts — a typed error no degradation path
+# catches, a registered point nothing hits, a hit of an unregistered
+# (typo'd) name, and a crash_ok point with no death handler in its
+# hit module.
+from lzy_tpu.chaos.faults import CHAOS, CRASH, DELAY, ERROR, SLOW
+
+
+class BadCorpusError(RuntimeError):
+    pass
+
+
+_FP_LOOSE = CHAOS.register(
+    "corpus.uncaught", error=BadCorpusError,
+    doc="declared error is caught nowhere")
+_FP_DEAD = CHAOS.register(
+    "corpus.dead", error=KeyError,
+    doc="registered but never hit")
+_FP_CRASHY = CHAOS.register(
+    "corpus.crashy", crash_ok=True, modes=(ERROR, DELAY, SLOW, CRASH),
+    doc="survivable crash declared, no BaseException handler here")
+
+
+def boundary(payload):
+    CHAOS.hit("corpus.uncaught")
+    CHAOS.hit("corpus.typo")             # nobody registers this name
+    return payload
+
+
+def crash_boundary(payload):
+    CHAOS.hit("corpus.crashy")
+    return payload

@@ -1,0 +1,30 @@
+# corpus: the correct shapes — blocking work happens OUTSIDE the lock
+# (snapshot under the lock, I/O after), and a Condition.wait on the
+# held condition is exempt (wait releases it).
+import threading
+
+
+class Tidy:
+    def __init__(self, storage, clock):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._storage = storage
+        self._clock = clock
+        self._pending = []
+
+    def slow_tick(self):
+        self._clock.sleep(0.05)          # nothing held
+        with self._lock:
+            self._pending.append(1)
+
+    def fetch_state(self, uri):
+        with self._lock:
+            pending = list(self._pending)
+        data = self._storage.read_bytes(uri)     # outside the lock
+        return pending, data
+
+    def wait_work(self):
+        with self._cv:
+            while not self._pending:
+                self._cv.wait(1.0)       # releases the held condition
+            return self._pending.pop()

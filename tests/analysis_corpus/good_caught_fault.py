@@ -1,0 +1,36 @@
+# corpus: honest chaos contracts — the declared typed error is caught
+# on the caller's degradation path, the point is hit, and the crash_ok
+# point's module has the death handler its declaration promises.
+from lzy_tpu.chaos.faults import CHAOS, CRASH, DELAY, ERROR, SLOW
+
+
+class GoodCorpusError(RuntimeError):
+    pass
+
+
+_FP_TIGHT = CHAOS.register(
+    "corpus.caught", error=GoodCorpusError,
+    doc="error caught right below")
+_FP_SAFE_CRASH = CHAOS.register(
+    "corpus.safe_crash", crash_ok=True, modes=(ERROR, DELAY, SLOW, CRASH),
+    doc="loop death handled in this module")
+
+
+def boundary(payload):
+    CHAOS.hit("corpus.caught")
+    return payload
+
+
+def caller(payload):
+    try:
+        return boundary(payload)
+    except GoodCorpusError:
+        return None                      # the degradation path
+
+
+def loop(payload):
+    try:
+        CHAOS.hit("corpus.safe_crash")
+        return payload
+    except BaseException:                # noqa: BLE001 — death handler
+        return None

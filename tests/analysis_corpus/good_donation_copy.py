@@ -1,0 +1,21 @@
+# corpus: the fixed shape — jnp.array COPIES, so the donated leaf
+# shares no buffer with the retained host mirror, and distinct
+# arguments are passed at distinct positions.
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(cache, tokens):
+    return cache, tokens
+
+
+def drive(cache, tokens):
+    vals = np.zeros((4,), np.int32)
+    leaves = jnp.array(vals)         # copy: safe to donate
+    out = step(leaves, tokens)
+    ok = step(cache, tokens)
+    return out, ok

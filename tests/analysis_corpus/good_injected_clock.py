@@ -1,0 +1,20 @@
+# corpus: the injectable-clock idiom — components read time only
+# through a Clock, so the load plane can drive them virtually.
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
+
+
+class Poller:
+    def __init__(self, clock=None):
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
+        self._last = self._clock.time()
+
+    def wait_for(self, probe, timeout_s):
+        deadline = self._clock.now() + timeout_s
+        while self._clock.now() < deadline:
+            if probe():
+                return True
+            self._clock.sleep(0.05)
+        return False
+
+    def idle(self):
+        SYSTEM_CLOCK.sleep(1.0)

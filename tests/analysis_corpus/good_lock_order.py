@@ -1,0 +1,23 @@
+# corpus: the same two locks, always acquired in the same order —
+# a consistent hierarchy, no cycle.
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def also_forward(self):
+        with self._a:
+            with self._b:
+                return 2
+
+    def only_b(self):
+        with self._b:
+            return 3

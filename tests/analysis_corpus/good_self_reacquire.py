@@ -1,0 +1,33 @@
+# corpus: two good twins of the self-reacquire shape — an RLock is
+# reentrant by contract, and the _locked-helper idiom re-enters nothing.
+import threading
+
+
+class ReentrantEngine:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._queue = []
+
+    def submit(self, item):
+        with self._lock:
+            self._queue.append(item)
+            return self.retry_after_s()
+
+    def retry_after_s(self):
+        with self._lock:                 # RLock: re-entry is the contract
+            return 0.1 * len(self._queue)
+
+
+class LockedHelperEngine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+
+    def submit(self, item):
+        with self._lock:
+            self._queue.append(item)
+            return self._retry_after_locked()
+
+    def _retry_after_locked(self):
+        # caller holds the lock; this helper never takes it
+        return 0.1 * len(self._queue)

@@ -1,0 +1,8 @@
+# corpus: a justified suppression silences exactly its rule on its
+# line (and would cover the line below a standalone comment).
+import time  # lzy-lint: disable=clock-raw-time -- corpus fixture: demonstrates the justified-suppression syntax
+
+
+def nap():
+    # lzy-lint: disable=clock-raw-time -- corpus fixture: real wall pause demanded by the scenario
+    time.sleep(0.1)

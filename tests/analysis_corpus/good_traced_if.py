@@ -1,0 +1,24 @@
+# corpus: the good twins — static arguments may branch, and the
+# is-None / shape / isinstance / len idioms are trace-time static.
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def clamp(x, limit):
+    if limit > 0:                        # static: fine
+        return jnp.minimum(x, limit)
+    return x
+
+
+@jax.jit
+def norm(x, scale=None):
+    if scale is None:                    # identity check: trace-static
+        scale = 1.0
+    if x.ndim > 1:                       # shape metadata: trace-static
+        x = x.reshape(-1)
+    if len(x) == 0:                      # length: trace-static
+        return x
+    return x * scale

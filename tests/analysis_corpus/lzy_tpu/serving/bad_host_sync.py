@@ -1,0 +1,15 @@
+# corpus: per-item host-device sync inside an engine decode loop —
+# each .item()/np.asarray forces a device round trip per row instead of
+# one batched transfer per scheduling round.
+import numpy as np
+
+
+class HotEngine:
+    def decode_step(self, logits_rows, slots):
+        out = []
+        for row in logits_rows:
+            tok = row.argmax().item()        # sync per row
+            out.append(tok)
+        for slot in slots:
+            slot.host = np.asarray(slot.dev)  # transfer per slot
+        return out

@@ -1,0 +1,12 @@
+# corpus: the correct shape — one batched host transfer per scheduling
+# round, outside the per-item loop; the loop touches host data only.
+import numpy as np
+
+
+class BatchedEngine:
+    def decode_step(self, logits_batch, slots):
+        nxt = np.asarray(logits_batch.argmax(-1))   # ONE transfer
+        out = []
+        for i, slot in enumerate(slots):
+            out.append(int(nxt[i]))                 # host-side indexing
+        return out
