@@ -492,6 +492,15 @@ def run() -> None:
     if extra:
         detail.update(extra)
         emit()
+    extra = gateway_restart_measurement(
+        jax, cfg, params,
+        replicas=2,
+        slots=2,
+        prompt_len=32 if is_tpu else 12,
+        new_tokens=24 if is_tpu else 16)
+    if extra:
+        detail.update(extra)
+        emit()
     extra = capacity_curve_measurement()
     if extra:
         detail.update(extra)
@@ -1595,6 +1604,105 @@ def stream_measurement(jax, cfg, params, *, slots: int, prompt_len: int,
             svc.close()
     except Exception as e:  # noqa: BLE001 — diagnostics only
         _log(f"stream skipped: {type(e).__name__}: {e}")
+        return {}
+
+
+def gateway_restart_measurement(jax, cfg, params, *, replicas: int,
+                                slots: int, prompt_len: int,
+                                new_tokens: int):
+    """Best-effort control-plane recovery point (docs/serving.md
+    "Control-plane recovery"): kill a journal-backed gateway mid-stream,
+    recover a successor (lease re-adoption + fence resubmission), and
+    time kill → FIRST post-restart token at the fence — the
+    client-visible blackout of a gateway death. Also checks the resumed
+    stream is byte-identical to the pre-kill prefix + an uninterrupted
+    continuation (greedy), so the number is only reported for a CORRECT
+    recovery. Rides the CPU-fallback path like every serving probe."""
+    try:
+        import numpy as np
+
+        from lzy_tpu.durable.store import OperationStore
+        from lzy_tpu.gateway import (
+            GatewayJournal, GatewayService, PrefixAffinityRouter,
+            ReplicaFleet, recover_gateway, simulate_gateway_death)
+        from lzy_tpu.serving import InferenceEngine
+
+        _log(f"gwreco: building {replicas} journal-backed replicas...")
+        journal = GatewayJournal(OperationStore(":memory:"))
+
+        def factory():
+            return InferenceEngine(cfg, params, slots=slots)
+
+        fleet = ReplicaFleet(factory)
+        gw = GatewayService(fleet, router=PrefixAffinityRouter(8),
+                            model_name="bench", journal=journal)
+        for _ in range(replicas):
+            fleet.add_replica()
+        rng = np.random.default_rng(5)
+        prompt = [int(t) for t in rng.integers(1, cfg.vocab_size,
+                                               prompt_len)]
+        # warm the decode path so the timed window measures RECOVERY,
+        # not a first-compile
+        gw.generate(prompt, max_new_tokens=2, greedy=True,
+                    timeout_s=600)
+        opened = gw.streams.open(prompt, max_new_tokens=new_tokens,
+                                 greedy=True, timeout_s=600)
+        rid = opened["request_id"]
+        pos, seen = 0, []
+        deadline = time.perf_counter() + 300
+        # fast short polls: the kill must land MID-decode, before the
+        # tiny bench model races through the whole budget
+        while len(seen) < 2 and time.perf_counter() < deadline:
+            frame = gw.streams.poll(rid, pos, wait_s=0.02)
+            seen.extend(frame["tokens"])
+            pos += len(frame["tokens"])
+            if frame["done"]:
+                break
+        if pos >= new_tokens:
+            _log("gwreco skipped: generation finished before the kill "
+                 "(model too fast for a mid-decode death)")
+            gw.close()
+            return {}
+        _log(f"gwreco: killing the gateway at fence {pos}...")
+        engines = {r.id: r.engine for r in fleet.replicas()}
+        t_kill = time.perf_counter()
+        simulate_gateway_death(gw)
+        fleet2 = ReplicaFleet(factory)
+        gw2 = GatewayService(fleet2, router=PrefixAffinityRouter(8),
+                             model_name="bench", journal=journal)
+        report = recover_gateway(
+            gw2, engine_source=lambda r, vms: engines.get(r))
+        # first post-restart token AT THE FENCE via the original token
+        first_token_ms = None
+        final = list(seen)
+        while time.perf_counter() < deadline:
+            frame = gw2.streams.poll(rid, pos, wait_s=1.0)
+            if frame["tokens"] and first_token_ms is None:
+                first_token_ms = 1000 * (time.perf_counter() - t_kill)
+            final.extend(frame["tokens"])
+            pos += len(frame["tokens"])
+            if frame["done"]:
+                break
+        gw2.close()
+        if first_token_ms is None or len(final) != new_tokens:
+            _log("gwreco skipped: the resumed stream never finished")
+            return {}
+        if final[:len(seen)] != seen:
+            _log("gwreco skipped: fence divergence (NOT reporting a "
+                 "broken recovery as a latency number)")
+            return {}
+        _log(f"gwreco: kill -> first post-restart token "
+             f"{first_token_ms:.1f} ms ({len(report.adopted)} adopted, "
+             f"{len(report.resubmitted)} resubmitted, recovery "
+             f"{1000 * report.recovery_s:.1f} ms)")
+        return {
+            "gateway_restart_recovery_ms": round(first_token_ms, 3),
+            "gateway_restart_adopted": len(report.adopted),
+            "gateway_restart_recovery_internal_ms": round(
+                1000 * report.recovery_s, 3),
+        }
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        _log(f"gwreco skipped: {type(e).__name__}: {e}")
         return {}
 
 

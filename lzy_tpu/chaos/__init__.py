@@ -28,7 +28,7 @@ from lzy_tpu.chaos.faults import (
     CHAOS, CRASH, DELAY, ERROR, FaultPlan, FaultPoint, InjectedFault, SLOW)
 from lzy_tpu.chaos.invariants import (
     FenceAuditor, InvariantViolation, audit_engine, audit_fleet_leases,
-    audit_kv_tier, audit_pool, audit_radix)
+    audit_kv_tier, audit_pool, audit_radix, audit_recovery)
 
 __all__ = [
     "CHAOS",
@@ -46,4 +46,5 @@ __all__ = [
     "audit_kv_tier",
     "audit_pool",
     "audit_radix",
+    "audit_recovery",
 ]

@@ -32,6 +32,12 @@ The invariants:
   failovers a request's emitted stream only ever extends — the final
   reply starts with every snapshot fenced at a failover, and the retry
   prompt carried exactly prompt+fenced.
+- **crash-recovery completeness** (:func:`audit_recovery`): after a
+  gateway recovery, every request the journal held LIVE at the death is
+  exactly one of re-attached/re-submitted-at-fence (a session with its
+  id exists on the successor) or terminally failed with a typed status
+  — none silently dropped, and a resubmitted session's fence still
+  starts with everything the predecessor served.
 """
 
 from __future__ import annotations
@@ -263,6 +269,52 @@ def audit_fleet_leases(fleet, allocator=None) -> None:
                     raise InvariantViolation(
                         f"replica {replica.id} leases vm {vm_id} in "
                         f"status {vm.status}")
+
+
+# -- crash recovery ---------------------------------------------------------
+
+def audit_recovery(journal, gateway,
+                   pre_live: Dict[str, dict]) -> None:
+    """Recovery completeness over a recovered ``GatewayService``.
+
+    ``pre_live`` is the journal's live-request snapshot taken BEFORE
+    recovery ran (``journal.live_requests()`` at the death). The
+    contract: every one of those requests is now exactly one of
+
+    - **re-attached / re-submitted-at-fence** — a session with its id
+      exists on the successor's stream manager, and its channel's
+      prefix is byte-identical to the journaled fence (the resume
+      token keeps reading the same bytes);
+    - **terminally failed with a typed status** — the journal record
+      is terminal and names a status (``orphaned_by_restart``, a real
+      terminal outcome, or ``error`` with a message).
+
+    Anything else is a silently-dropped request — the exact bug class
+    this auditor exists to catch."""
+    live_sessions = set(gateway.streams.sessions())
+    docs = journal.requests()
+    for rid in sorted(pre_live):
+        if rid in live_sessions:
+            sess = gateway.streams._get(rid)
+            fence = [int(t) for t in pre_live[rid].get("fence") or ()]
+            got = sess.channel.tokens()[:len(fence)]
+            if got != fence:
+                raise InvariantViolation(
+                    f"recovered session {rid} diverges from its "
+                    f"journaled fence: journal {fence}, channel prefix "
+                    f"{got}")
+            continue
+        doc = docs.get(rid)
+        if doc is None:
+            raise InvariantViolation(
+                f"journaled live request {rid} vanished in recovery — "
+                f"neither re-attached nor terminally settled")
+        if doc.get("status") != "terminal" or not doc.get("terminal"):
+            raise InvariantViolation(
+                f"journaled live request {rid} was silently dropped: "
+                f"no successor session and no typed terminal status "
+                f"(journal says {doc.get('status')!r}/"
+                f"{doc.get('terminal')!r})")
 
 
 # -- fenced tokens ----------------------------------------------------------

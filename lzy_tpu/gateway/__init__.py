@@ -27,6 +27,13 @@ almost entirely provides. This package composes it:
   dies mid-stream on one replica is resubmitted to another with the
   already-emitted tokens *fenced* (the retry continues from them), so the
   client-visible stream stays correct across a failover.
+- ``journal`` / ``recovery`` — control-plane crash recovery: a durable
+  session journal (births, routed attempts, fence advances, leases) over
+  the ``durable/`` store plane, and the successor's boot path — re-adopt
+  still-leased gangs, rehydrate stream sessions so the resume token
+  ``(request_id, position)`` survives a gateway death, resubmit in-flight
+  generations as ``prompt + fenced_tokens``, settle the rest with typed
+  statuses.
 """
 
 from lzy_tpu.gateway.autoscale import Autoscaler, ScaleDecision
@@ -34,7 +41,10 @@ from lzy_tpu.gateway.disagg import DisaggGatewayService
 from lzy_tpu.gateway.fleet import (
     DEAD, DRAINING, READY, STARTING, Replica, ReplicaFleet)
 from lzy_tpu.gateway.health import HealthPolicy, HealthTracker
+from lzy_tpu.gateway.journal import GatewayJournal, JournalError
 from lzy_tpu.gateway.kv_index import GlobalKVIndex
+from lzy_tpu.gateway.recovery import (
+    RecoveryReport, recover_gateway, simulate_gateway_death)
 from lzy_tpu.gateway.router import (
     PrefixAffinityRouter, RoundRobinRouter, chunk_hashes)
 from lzy_tpu.gateway.service import GatewayService
@@ -44,16 +54,21 @@ __all__ = [
     "DEAD",
     "DRAINING",
     "DisaggGatewayService",
+    "GatewayJournal",
     "GatewayService",
     "GlobalKVIndex",
     "HealthPolicy",
     "HealthTracker",
+    "JournalError",
     "PrefixAffinityRouter",
     "READY",
     "Replica",
     "ReplicaFleet",
+    "RecoveryReport",
     "RoundRobinRouter",
     "STARTING",
     "ScaleDecision",
     "chunk_hashes",
+    "recover_gateway",
+    "simulate_gateway_death",
 ]

@@ -94,6 +94,14 @@ class DisaggGatewayService(GatewayService):
     ):
         super().__init__(fleet, page_size=page_size, **kwargs)
         self.prefill_fleet = prefill_fleet
+        # crash-recovery journal covers BOTH pools: prefill leases are
+        # journaled (pool-tagged) so a successor re-adopts warm prefill
+        # caches too, not just the decode fleet
+        self.prefill_fleet.journal = self.journal
+        if self.journal is not None:
+            for replica in (prefill_fleet.replicas()
+                            + prefill_fleet.replicas(state="DRAINING")):
+                prefill_fleet.journal_lease(replica)
         self.prefill_router = (prefill_router if prefill_router is not None
                                else PrefixAffinityRouter(page_size))
         self.transport = transport if transport is not None \

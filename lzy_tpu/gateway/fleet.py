@@ -105,6 +105,10 @@ class ReplicaFleet:
         self._seq = 0
         self._lock = threading.RLock()
         self._closed = False
+        #: crash-recovery journal (gateway/journal.py), set by the
+        #: owning GatewayService: add/adopt record the gang lease,
+        #: retirement forgets it — what a successor re-adopts from
+        self.journal = None
         # terminal counters of retired replicas: fleet aggregates must
         # stay MONOTONIC across scale-downs/failovers (a stats consumer
         # computing rates over InferStats would otherwise see negative
@@ -166,9 +170,75 @@ class ReplicaFleet:
                     pass
             raise RuntimeError("fleet is closed")
         self.health.record_success(rid)       # fresh streak
+        self.journal_lease(replica)
         _LOG.info("fleet: replica %s up (lease %s)", rid, vm_ids or "none")
         self._update_gauges()
         return replica
+
+    def journal_lease(self, replica: Replica) -> None:
+        """Record (or re-record) one replica's gang lease in the
+        crash-recovery journal; no-op without one."""
+        journal = self.journal
+        if journal is None:
+            return
+        with self._lock:
+            session = self._session_id
+        journal.record_lease(replica.id, replica.vm_ids, session,
+                             pool=self._replica_prefix)
+
+    def adopt_replica(self, replica_id: str, engine,
+                      vm_ids: Optional[List[str]] = None) -> Replica:
+        """Crash-recovery adoption: register an ALREADY-RUNNING engine
+        (and its existing gang lease) under the predecessor's replica
+        id, without leasing or starting anything. The warm engine keeps
+        its radix cache and host KV tier — the whole point of adopting
+        instead of re-leasing. The id sequence is advanced past the
+        adopted id so later ``add_replica`` calls never collide."""
+        vm_ids = list(vm_ids or ())
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("fleet is closed")
+            if replica_id in self._replicas:
+                raise ValueError(
+                    f"replica {replica_id!r} already in the fleet")
+            tail = replica_id.rsplit("-", 1)[-1]
+            if tail.isdigit():
+                self._seq = max(self._seq, int(tail))
+            replica = Replica(id=replica_id, engine=engine,
+                              vm_ids=vm_ids,
+                              created_ts=self._clock.time())
+            self._replicas[replica_id] = replica
+        self.health.record_success(replica_id)   # fresh streak
+        self.journal_lease(replica)
+        _LOG.info("fleet: adopted replica %s (lease %s)", replica_id,
+                  vm_ids or "none")
+        self._update_gauges()
+        return replica
+
+    def adopt_session(self, session_id: Optional[str]) -> None:
+        """Adopt the predecessor's allocator session: drains keep
+        freeing into the same warm-gang cache, and close() deletes the
+        right session instead of orphaning it."""
+        with self._lock:
+            if self._session_id is None:
+                self._session_id = session_id
+
+    def release_for_handoff(self) -> List[str]:
+        """Rolling-restart handoff: strip the replica table WITHOUT
+        closing engines or freeing leases (a successor fleet adopted
+        them) and disown the allocator session (the successor owns it
+        now — our close() must not delete it). Returns the released
+        replica ids; the caller then drains/closes an empty fleet."""
+        with self._lock:
+            replicas = list(self._replicas.values())
+            self._replicas.clear()
+            self._session_id = None
+        for replica in replicas:
+            self.health.forget(replica.id)
+        self._update_gauges()
+        _LOG.info("fleet: released %d replica(s) for handoff",
+                  len(replicas))
+        return [r.id for r in replicas]
 
     def _lease(self) -> List[str]:
         with self._lock:
@@ -233,6 +303,9 @@ class ReplicaFleet:
             if self._replicas.pop(replica.id, None) is None:
                 return
             replica.state = DEAD
+        journal = self.journal
+        if journal is not None:
+            journal.forget_lease(replica.id)
         try:
             # bank the terminal counters BEFORE closing: aggregates must
             # not go backwards when this replica's engine is dropped

@@ -1,0 +1,461 @@
+"""Durable gateway journal: the control-plane state that must survive a
+gateway death.
+
+Everything the gateway keeps in memory — stream fences, in-flight
+request parameters, router affinity, replica leases — evaporates when
+the process dies, stranding leased gangs and breaking every open resume
+token. This module records the minimal durable shadow of that state in
+the existing ``durable/store.py`` plane (the same SQLite/Postgres
+``OperationStore`` the allocator and workflow service persist through;
+a plain SQLite file path is the single-process-serve backend), so a
+successor gateway (``gateway/recovery.py``) can:
+
+- **re-adopt** still-leased replica gangs instead of re-leasing (the
+  lease rows name the gang and the allocator session);
+- **rehydrate** streaming sessions so the PR 10 resume token
+  ``(request_id, position)`` keeps working across the restart — the
+  journaled fence is exactly the tokens the client has been served, so
+  a resubmission as ``prompt + fence`` splices byte-identically;
+- **settle** non-resumable requests with a typed terminal status
+  instead of silently dropping them (the recovery auditor's contract).
+
+Write discipline — *degrade, never fail*: every durable append runs
+through the ``journal.append`` chaos point and catches **any** failure
+(injected or real: a full disk, a lost Postgres connection). The
+in-memory mirror is updated first and stays authoritative for the
+running process; a failed append is one counted
+``lzy_gwreco_journal_degraded_total`` tick and a warning — the request
+it was journaling never notices. A degraded journal only narrows what a
+*future* recovery can restore; failing live traffic to protect a replay
+record would invert the priority.
+
+Fence ordering contract: a fence advance is journaled **before** the
+frame carrying those tokens is returned to the client (the streaming
+front calls :meth:`advance_fence` on the poll path), so the durable
+fence always covers everything the client has seen. After a crash the
+resubmitted generation re-feeds exactly the journaled fence; tokens the
+engine emitted but no client ever read are regenerated (byte-identical
+under greedy decode, freshly sampled otherwise — either way the client
+splice is exact because nothing past the fence was ever delivered).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from lzy_tpu.chaos.faults import CHAOS
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
+from lzy_tpu.utils.ids import gen_id
+from lzy_tpu.utils.log import get_logger
+from lzy_tpu.utils.metrics import REGISTRY
+
+_LOG = get_logger(__name__)
+
+JOURNAL_APPENDS = REGISTRY.counter(
+    "lzy_gwreco_journal_appends_total",
+    "durable gateway-journal writes, by record kind "
+    "(birth/attempt/fence/finish/lease)")
+JOURNAL_DEGRADED = REGISTRY.counter(
+    "lzy_gwreco_journal_degraded_total",
+    "gateway-journal appends that failed durably and degraded to the "
+    "in-memory mirror (the request never fails; recovery fidelity "
+    "narrows)")
+
+
+class JournalError(RuntimeError):
+    """A durable journal append failed. Raised only by the injected
+    fault (and storage backends); ALWAYS caught inside the journal —
+    the degradation contract is memory-only recording, never a failed
+    request."""
+
+
+# chaos boundary: error mode is a failed durable write (disk full, lost
+# DB connection). The journal catches it right here and degrades to its
+# in-memory mirror with a counted warning — no request ever fails
+# because its journal record did.
+_FP_APPEND = CHAOS.register(
+    "journal.append", error=JournalError,
+    doc="one durable gateway-journal write (failure degrades to the "
+        "in-memory mirror with a counted warning; never a failed "
+        "request)")
+
+#: kv namespaces in the durable store, scoped per journal name so two
+#: gateways (e.g. a disagg plane next to a monolithic one) can share a
+#: store without clobbering each other
+_NS_REQUESTS = "gwj.requests"
+#: fence advances live as DELTA parts (`<request_id>/<start>` → the
+#: tokens from that offset): the poll path appends O(frame) bytes, not
+#: an O(stream) doc rewrite per frame. The read side reassembles the
+#: contiguous prefix; the finish record carries the full fence again
+#: (one write), self-healing any part a degraded append lost.
+_NS_FENCE = "gwj.fence"
+#: routed attempts live in their own small record (`request_id` → the
+#: replica-id list): journaling an attempt must not rewrite the whole
+#: birth doc (prompt included) on the serving path
+_NS_ROUTED = "gwj.routed"
+_NS_LEASES = "gwj.leases"
+_NS_META = "gwj.meta"
+
+#: terminal statuses recovery may settle a request with; the recovery
+#: auditor treats anything else as a silently-dropped request
+ORPHANED = "orphaned_by_restart"
+
+
+class GatewayJournal:
+    """Session + lease journal over an ``OperationStore``-shaped backend
+    (``kv_put``/``kv_get``/``kv_del``/``kv_list``).
+
+    One instance per gateway process. The in-memory mirror tracks what
+    THIS process wrote; the read side (:meth:`requests`, :meth:`leases`)
+    reads the STORE, which is what a successor process recovers from —
+    the two views coincide unless appends degraded.
+    """
+
+    def __init__(self, store, *, name: str = "gateway", clock=None):
+        self._store = store
+        self.name = name
+        self._clock = clock if clock is not None else SYSTEM_CLOCK
+        self._lock = threading.Lock()
+        self._mem_requests: Dict[str, dict] = {}
+        self._mem_leases: Dict[str, dict] = {}
+        self._degraded = 0
+
+    # -- append plumbing -----------------------------------------------------
+
+    def _key(self, ns: str) -> str:
+        return f"{ns}.{self.name}"
+
+    def _append(self, kind: str, ns: str, key: str,
+                doc: Optional[dict]) -> None:
+        """One durable write (or delete, ``doc=None``). Never raises:
+        failure — injected or real — is a counted degradation. Runs with
+        NO journal lock held (the store takes its own; a slow or
+        fault-delayed write must not serialize the serving path behind
+        this journal's mirror lock)."""
+        JOURNAL_APPENDS.inc(kind=kind)
+        try:
+            CHAOS.hit("journal.append")
+            if doc is None:
+                self._store.kv_del(self._key(ns), key)
+            else:
+                self._store.kv_put(self._key(ns), key, doc)
+        except Exception as e:  # noqa: BLE001 — degrade, never fail
+            # covers the injected JournalError and every real store
+            # failure alike: one counted degradation, never a raise
+            self._note_degraded(kind, key, e)
+
+    def _note_degraded(self, kind: str, key: str,
+                       exc: BaseException) -> None:
+        with self._lock:
+            self._degraded += 1
+        JOURNAL_DEGRADED.inc()
+        _LOG.warning(
+            "gateway journal: %s append for %r failed (%s: %s); "
+            "degraded to memory-only — recovery fidelity narrows, the "
+            "request is unaffected", kind, key, type(exc).__name__, exc)
+
+    @property
+    def degraded(self) -> int:
+        with self._lock:
+            return self._degraded
+
+    # -- request records -----------------------------------------------------
+
+    def record_birth(self, request_id: Optional[str] = None, *,
+                     prompt: Sequence[int], max_new_tokens: int,
+                     greedy: Optional[bool] = None,
+                     tenant: Optional[str] = None,
+                     priority: Optional[int] = None,
+                     session: Optional[str] = None,
+                     deadline_s: Optional[float] = None,
+                     timeout_s: Optional[float] = None,
+                     streamed: bool = False,
+                     subject_id: Optional[str] = None) -> str:
+        """Journal a session birth; returns the request id (generated
+        for unary callers, the stream id for streamed ones). The doc
+        carries everything a resubmission needs: prompt, params, the
+        SLO identity, and the conversation pin."""
+        rid = request_id or gen_id("gwreq")
+        doc = {
+            "status": "live",
+            "streamed": bool(streamed),
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(max_new_tokens),
+            "greedy": greedy,
+            "tenant": tenant,
+            "priority": priority,
+            "session": session,
+            "deadline_s": deadline_s,
+            "timeout_s": timeout_s,
+            "subject_id": subject_id,
+            "fence": [],
+            "routed": [],
+            "born_at": self._clock.time(),
+        }
+        with self._lock:
+            self._mem_requests[rid] = doc
+        self._append("birth", _NS_REQUESTS, rid, doc)
+        return rid
+
+    def hydrate_request(self, request_id: str, doc: dict) -> None:
+        """Seed the in-memory mirror with a record read from the STORE
+        (recovery adopting a predecessor's session into a FRESH journal
+        instance). Without this, every later mutation — fence advances,
+        the worker's finish — would no-op against the empty mirror and
+        the store record would stay live-with-a-stale-fence forever."""
+        with self._lock:
+            self._mem_requests.setdefault(request_id, {
+                **doc,
+                "fence": [int(t) for t in doc.get("fence") or ()],
+                "routed": list(doc.get("routed") or ()),
+            })
+
+    def record_attempt(self, request_id: str, replica_id: str) -> None:
+        """One routed submission (first attempt or failover retry).
+        Durable as its own SMALL record — the replica-id list only,
+        never a rewrite of the prompt-bearing birth doc."""
+        with self._lock:
+            doc = self._mem_requests.get(request_id)
+            if doc is None:
+                return
+            doc["routed"].append(replica_id)
+            routed = list(doc["routed"])
+        self._append("attempt", _NS_ROUTED, request_id,
+                     {"routed": routed})
+
+    def advance_fence(self, request_id: str, start: int,
+                      tokens: Sequence[int]) -> None:
+        """Advance the durable fence with the frame just served:
+        ``tokens`` begin at position ``start`` (exactly the poll
+        frame's shape, so the whole path — argument, comparison, and
+        the durable part record — is O(frame), never O(stream)).
+        Monotonic and splice-safe: an already-covered range is a no-op,
+        a range that would leave a gap is refused, and an overlap that
+        disagrees with the recorded fence is dropped with a warning
+        (the fence stays SHORTER than reality — conservative, never a
+        wrong splice)."""
+        toks = [int(t) for t in tokens]
+        start = int(start)
+        with self._lock:
+            doc = self._mem_requests.get(request_id)
+            if doc is None:
+                return
+            fence = doc["fence"]
+            if start + len(toks) <= len(fence):
+                return                    # re-polled old range: no-op
+            if start > len(fence):
+                return                    # gap: cannot splice
+            overlap = len(fence) - start
+            if toks[:overlap] != fence[start:]:
+                _LOG.warning(
+                    "gateway journal: fence advance for %s diverges "
+                    "from the recorded prefix at %d; ignored (the "
+                    "durable fence stays short, never wrong)",
+                    request_id, start)
+                return
+            new = toks[overlap:]
+            part_start = len(fence)
+            fence.extend(new)
+        self._append("fence", _NS_FENCE,
+                     f"{request_id}/{part_start:08d}", {"tokens": new})
+
+    def finish(self, request_id: str, status: str, *,
+               error: Optional[str] = None,
+               fence: Optional[Sequence[int]] = None,
+               reply: Optional[dict] = None) -> None:
+        """Settle a request with a typed terminal status. Keeps the
+        record (it is the lost-final-frame resume window: a rehydrated
+        TERMINAL session answers the done frame the predecessor never
+        delivered) until :meth:`forget` or :meth:`prune_terminal`."""
+        with self._lock:
+            doc = self._mem_requests.get(request_id)
+            if doc is None:
+                return
+            doc["status"] = "terminal"
+            doc["terminal"] = status
+            if error is not None:
+                doc["error"] = str(error)
+            if fence is not None:
+                toks = [int(t) for t in fence]
+                if len(toks) > len(doc["fence"]):
+                    doc["fence"] = toks
+            if reply is not None:
+                doc["reply"] = reply
+            doc["finished_at"] = self._clock.time()
+            snap = dict(doc)
+        self._append("finish", _NS_REQUESTS, request_id, snap)
+
+    def forget(self, request_id: str) -> None:
+        """Drop a settled record (the streaming front's terminal GC)."""
+        self.forget_many((request_id,))
+
+    def forget_many(self, request_ids: Sequence[str]) -> None:
+        """Batched :meth:`forget`: one fence-namespace scan for the
+        whole batch (the per-id scan is what a busy GC must not pay
+        N times)."""
+        if not request_ids:
+            return
+        with self._lock:
+            for rid in request_ids:
+                self._mem_requests.pop(rid, None)
+        for rid in request_ids:
+            self._append("forget", _NS_REQUESTS, rid, None)
+            self._append("forget", _NS_ROUTED, rid, None)
+        self._forget_fence_parts(tuple(request_ids))
+
+    def prune_terminal(self, older_than_s: float) -> int:
+        """Retention for terminal records past the resume window."""
+        cutoff = self._clock.time() - older_than_s
+        doomed: List[str] = []
+        with self._lock:
+            for rid, doc in list(self._mem_requests.items()):
+                if doc.get("status") == "terminal" and \
+                        doc.get("finished_at", 0.0) < cutoff:
+                    self._mem_requests.pop(rid)
+                    doomed.append(rid)
+        for rid in doomed:
+            self._append("forget", _NS_REQUESTS, rid, None)
+            self._append("forget", _NS_ROUTED, rid, None)
+        self._forget_fence_parts(doomed)
+        return len(doomed)
+
+    def _forget_fence_parts(self, request_ids: Sequence[str]) -> None:
+        if not request_ids:
+            return
+        try:
+            parts = self._store.kv_list(self._key(_NS_FENCE))
+        except Exception:  # noqa: BLE001 — degraded store
+            return
+        prefixes = tuple(f"{rid}/" for rid in request_ids)
+        for key in parts:
+            if key.startswith(prefixes):
+                self._append("forget", _NS_FENCE, key, None)
+
+    def _assembled_fences(self) -> Dict[str, List[int]]:
+        """Reassemble the per-request fence from its durable delta
+        parts: the longest CONTIGUOUS prefix (a part a degraded append
+        lost truncates the fence there — conservative, never a wrong
+        splice)."""
+        try:
+            parts = self._store.kv_list(self._key(_NS_FENCE))
+        except Exception:  # noqa: BLE001 — degraded store
+            return {}
+        grouped: Dict[str, List] = {}
+        for key, doc in parts.items():
+            rid, _, start = key.rpartition("/")
+            try:
+                grouped.setdefault(rid, []).append(
+                    (int(start), [int(t) for t in doc["tokens"]]))
+            except (ValueError, KeyError, TypeError):
+                continue
+        out: Dict[str, List[int]] = {}
+        for rid, rows in grouped.items():
+            buf: List[int] = []
+            for start, toks in sorted(rows):
+                if start > len(buf):
+                    break                 # gap: a lost part ends the prefix
+                buf[start:] = toks
+            out[rid] = buf
+        return out
+
+    # -- lease records -------------------------------------------------------
+
+    def record_lease(self, replica_id: str, vm_ids: Sequence[str],
+                     session_id: Optional[str], *,
+                     pool: Optional[str] = None) -> None:
+        """One replica's gang lease (written when the fleet adds or
+        adopts the replica). ``vm_ids`` empty = unleased (thread-mode)
+        replica — still journaled so recovery can adopt its engine.
+        ``pool`` is the owning fleet's replica prefix (``replica`` /
+        ``decode`` / ``prefill``): a disagg recovery adopts each lease
+        back into the pool it came from."""
+        doc = {"vm_ids": list(vm_ids), "session_id": session_id,
+               "pool": pool, "leased_at": self._clock.time()}
+        with self._lock:
+            self._mem_leases[replica_id] = doc
+        self._append("lease", _NS_LEASES, replica_id, doc)
+
+    def forget_lease(self, replica_id: str) -> None:
+        with self._lock:
+            self._mem_leases.pop(replica_id, None)
+        self._append("lease", _NS_LEASES, replica_id, None)
+
+    # -- read side (what a successor recovers from) --------------------------
+
+    def requests(self) -> Dict[str, dict]:
+        """Every journaled request in the STORE (the successor's view),
+        with each doc's fence overlaid from the delta parts (fence
+        advances never rewrite the doc — see :meth:`advance_fence`).
+        Falls back to the in-memory mirror when the store read fails —
+        a degraded journal still recovers everything THIS process saw
+        (the in-process rolling-restart path)."""
+        try:
+            out = self._store.kv_list(self._key(_NS_REQUESTS))
+        except Exception:  # noqa: BLE001 — degraded store, mirror wins
+            out = {}
+        if out:
+            fences = self._assembled_fences()
+            for rid, fence in fences.items():
+                doc = out.get(rid)
+                if doc is not None and \
+                        len(fence) > len(doc.get("fence") or ()):
+                    doc = dict(doc)
+                    doc["fence"] = fence
+                    out[rid] = doc
+            try:
+                routed_rows = self._store.kv_list(self._key(_NS_ROUTED))
+            except Exception:  # noqa: BLE001 — degraded store
+                routed_rows = {}
+            for rid, row in routed_rows.items():
+                doc = out.get(rid)
+                routed = list(row.get("routed") or ())
+                if doc is not None and \
+                        len(routed) > len(doc.get("routed") or ()):
+                    doc = dict(doc)
+                    doc["routed"] = routed
+                    out[rid] = doc
+        with self._lock:
+            merged = dict(out)
+            for rid, doc in self._mem_requests.items():
+                # the mirror wins for records THIS process wrote (it is
+                # strictly fresher when appends degraded); the store
+                # only adds a predecessor's records
+                merged[rid] = dict(doc)
+        return merged
+
+    def live_requests(self) -> Dict[str, dict]:
+        return {rid: doc for rid, doc in self.requests().items()
+                if doc.get("status") == "live"}
+
+    def leases(self) -> Dict[str, dict]:
+        try:
+            out = self._store.kv_list(self._key(_NS_LEASES))
+        except Exception:  # noqa: BLE001 — degraded store, mirror wins
+            out = {}
+        with self._lock:
+            merged = dict(out)
+            for rid, doc in self._mem_leases.items():
+                merged[rid] = dict(doc)       # mirror wins (fresher)
+        return merged
+
+    def record_meta(self, key: str, value: Any) -> None:
+        self._append("meta", _NS_META, key, {"value": value})
+
+    def meta(self, key: str, default: Any = None) -> Any:
+        try:
+            doc = self._store.kv_get(self._key(_NS_META), key)
+        except Exception:  # noqa: BLE001 — degraded store
+            doc = None
+        return doc["value"] if doc else default
+
+    def stats(self) -> dict:
+        with self._lock:
+            live = sum(1 for d in self._mem_requests.values()
+                       if d.get("status") == "live")
+            return {
+                "requests": len(self._mem_requests),
+                "live": live,
+                "leases": len(self._mem_leases),
+                "degraded_appends": self._degraded,
+            }

@@ -1,0 +1,358 @@
+"""Control-plane crash recovery: a gateway that can die.
+
+Every failure mode *below* the gateway is survivable — replica death
+fails over at the fence, dead clients are reaped, overload sheds — but
+the gateway process itself held the last unreplicated state: stream
+fences, in-flight request parameters, router affinity and the lease
+table all died with it. With the durable journal
+(:mod:`lzy_tpu.gateway.journal`) that state has a shadow, and this
+module is the successor's boot path:
+
+- :func:`recover_gateway` — run against a freshly-built (empty-fleet)
+  ``GatewayService`` sharing the predecessor's journal store:
+
+  1. **lease re-adoption**: journaled replica leases whose gangs are
+     still RUNNING (and whose engines ``engine_source`` can reach) are
+     ADOPTED into the successor's fleet — warm engines, radix caches
+     and host KV tiers survive the restart; no re-lease, no re-warm.
+     Unreachable leases are dropped: the journal row is forgotten, the
+     global KV index forgets the replica's chains, and the gang is
+     freed back to the allocator session cache (the next scale-up
+     reuses it warm).
+  2. **KV-index rebuild**: the fleet-global prefix index is
+     force-refreshed from every adopted replica BEFORE the first
+     routed request — a cold index would route the first wave of
+     requests blind and re-prefill work the fleet already holds.
+  3. **session rehydration**: every journaled live *streamed* request
+     is re-submitted as ``prompt + fenced_tokens`` through the
+     ordinary failover path (the fence is pre-published into a fresh
+     channel, so the client's next ``InferStreamPoll`` at its old
+     position splices byte-identically); journaled *terminal* streams
+     are rehydrated closed (the lost-final-frame resume window); live
+     *unary* requests — whose reply channel died with the process —
+     are settled with the typed ``orphaned_by_restart`` status. The
+     recovery auditor (:func:`lzy_tpu.chaos.invariants.audit_recovery`)
+     asserts every journaled live request took exactly one of those
+     three paths.
+
+- :func:`simulate_gateway_death` — the in-process stand-in for
+  ``kill -9`` used by tests, the chaos soak and the bench probe: the
+  journal is detached FIRST (a dead process runs no ``finally``
+  blocks, so nothing may settle journal records on the way down), then
+  sessions are marked dead (engines reap their requests within one
+  decode round, exactly as if the gateway's liveness probes vanished)
+  and the tick thread stops. Fleet, engines and leases are left
+  untouched — they are the survivors recovery adopts.
+
+**Rolling restart** composes the two: build the successor against the
+same journal, ``recover_gateway`` it with ``engine_source`` reading the
+predecessor's fleet, swap traffic over, then let the predecessor drain
+(``ReplicaFleet.release_for_handoff`` strips its replica table without
+closing the shared engines or freeing the adopted leases). The load
+plane's ``gateway_restart`` event and ``serve.py --gateway-journal``
+both ride this path; ``RpcInferenceClient``'s reconnect ladder covers
+the client side (backoff on connection-refused, resume-at-fence on the
+new process).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence
+
+from lzy_tpu.gateway.journal import ORPHANED, GatewayJournal
+from lzy_tpu.utils.log import get_logger
+from lzy_tpu.utils.metrics import REGISTRY
+
+_LOG = get_logger(__name__)
+
+RECO_ADOPTIONS = REGISTRY.counter(
+    "lzy_gwreco_adoptions_total",
+    "replica gangs re-adopted (not re-leased) by a recovering gateway")
+RECO_DROPPED_LEASES = REGISTRY.counter(
+    "lzy_gwreco_dropped_leases_total",
+    "journaled leases a recovery could not adopt (gang gone, engine "
+    "unreachable) — freed back to the session cache")
+RECO_RESUBMITS = REGISTRY.counter(
+    "lzy_gwreco_resubmits_at_fence_total",
+    "journaled live streams re-submitted as prompt + fenced_tokens by "
+    "a recovering gateway (the resume token keeps working)")
+RECO_ORPHANS = REGISTRY.counter(
+    "lzy_gwreco_orphaned_total",
+    "journaled live unary requests settled with the typed "
+    "orphaned_by_restart status (their reply channel died with the "
+    "predecessor)")
+RECO_SECONDS = REGISTRY.histogram(
+    "lzy_gwreco_recovery_seconds",
+    "one gateway recovery: journal read to every session re-attached, "
+    "re-submitted, or settled",
+    buckets=(0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0))
+
+#: dispositions :func:`recover_gateway` assigns per journaled request —
+#: the exact partition the recovery auditor checks
+RESUBMITTED = "resubmitted_at_fence"
+REHYDRATED = "rehydrated_terminal"
+ORPHAN = "orphaned"
+
+
+@dataclasses.dataclass
+class RecoveryReport:
+    """What one recovery did (also the bench probe's raw material)."""
+
+    adopted: List[str]
+    dropped_leases: List[str]
+    resubmitted: List[str]
+    rehydrated_terminal: List[str]
+    orphaned: List[str]
+    recovery_s: float
+    #: request_id -> RESUBMITTED | REHYDRATED | ORPHAN
+    dispositions: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def doc(self) -> dict:
+        return {
+            "adopted": list(self.adopted),
+            "dropped_leases": list(self.dropped_leases),
+            "resubmitted": list(self.resubmitted),
+            "rehydrated_terminal": list(self.rehydrated_terminal),
+            "orphaned": list(self.orphaned),
+            "recovery_s": round(self.recovery_s, 6),
+        }
+
+
+def simulate_gateway_death(gw) -> None:
+    """Kill a gateway the way a process death would (tests/soak/bench).
+
+    Order matters: the journal is detached FIRST — a real crash runs no
+    ``finally`` blocks, so no in-flight worker may settle its journal
+    record as terminal on the way down (that would rob the successor of
+    its resubmission). Then every live session is marked dead (its
+    liveness probe goes False, so the engines reap the request within
+    one decode round — the same thing that happens when a real
+    gateway's poll-driven liveness vanishes) and the tick thread stops.
+    The fleet object, its engines and its leases are deliberately NOT
+    touched: they are what recovery re-adopts."""
+    gw.journal = None
+    streams = getattr(gw, "streams", None)
+    if streams is not None:
+        streams.journal = None
+    if getattr(gw, "fleet", None) is not None:
+        gw.fleet.journal = None
+    if getattr(gw, "prefill_fleet", None) is not None:
+        gw.prefill_fleet.journal = None
+    gw._draining = True                      # refuse anything new
+    gw._stop.set()
+    if gw._thread is not None:
+        gw._thread.join(timeout=10.0)
+        gw._thread = None
+    if streams is not None:
+        for sid in streams.sessions():
+            try:
+                sess = streams._get(sid)
+            except KeyError:
+                continue
+            sess.mark_dead("gateway process died")
+            req = sess.channel.attached_request
+            if req is not None:
+                try:
+                    req.cancel()
+                except Exception:  # noqa: BLE001 — request may be done
+                    pass
+
+
+def recover_gateway(
+    gw,
+    *,
+    engine_source: Optional[Callable[[str, Sequence[str]], object]] = None,
+    allocator=None,
+    resume_sessions: bool = True,
+    leases: Optional[Dict[str, dict]] = None,
+) -> RecoveryReport:
+    """Recover a freshly-built gateway from its journal (see module
+    docstring). ``gw`` must carry a :class:`GatewayJournal` sharing the
+    predecessor's store and an EMPTY fleet; ``engine_source(replica_id,
+    vm_ids)`` reconnects a still-running replica engine (None = not
+    reachable — the in-process fleet hands over live engine objects, a
+    remote deployment would dial the replica endpoint). Returns the
+    :class:`RecoveryReport`; the caller starts the tick loop after.
+
+    ``resume_sessions=False`` is the ROLLING-restart variant: the
+    predecessor is alive and draining — it finishes (and journals) its
+    own in-flight requests, so the successor must adopt leases and the
+    KV index but MUST NOT resubmit or orphan requests the predecessor
+    is still legitimately serving. Crash recovery (the predecessor is
+    dead) keeps the default ``True``.
+
+    ``leases`` overrides the lease table to recover from: the serve.py
+    boot path snapshots the PREDECESSOR's rows before building its own
+    fleet (whose ``add_replica`` overwrites the colliding
+    ``replica-1..N`` keys) and passes the snapshot here, so stale gangs
+    are still found and released."""
+    journal: Optional[GatewayJournal] = gw.journal
+    if journal is None:
+        raise ValueError("recover_gateway needs a gateway built with a "
+                         "journal (the predecessor's store)")
+    clock = gw._clock
+    t0 = clock.now()
+    # the completeness audit only applies when WE own the sessions' fate
+    # (crash recovery); a rolling restart's predecessor is alive and
+    # settles its own in-flight requests
+    pre_live = sorted(journal.live_requests()) if resume_sessions else []
+    if leases is None:
+        leases = journal.leases()
+
+    # the disagg gateway journals both pools; each lease adopts back
+    # into the fleet it came from, matched by the pool tag (the plain
+    # gateway has one fleet and every lease lands there)
+    fleets = {gw.fleet._replica_prefix: gw.fleet}
+    prefill_fleet = getattr(gw, "prefill_fleet", None)
+    if prefill_fleet is not None:
+        fleets[prefill_fleet._replica_prefix] = prefill_fleet
+
+    # the predecessor's allocator sessions, PER POOL: each fleet owns
+    # its own session (disagg-decode vs disagg-prefill have different
+    # owners) — adopting one session into both fleets would free gangs
+    # into the wrong pool's cache and double-delete on shutdown
+    sessions_by_pool: Dict[str, str] = {}
+    default_pool = gw.fleet._replica_prefix
+    for doc in leases.values():
+        sid = doc.get("session_id")
+        if sid:
+            sessions_by_pool.setdefault(doc.get("pool") or default_pool,
+                                        sid)
+    for pool, fleet in fleets.items():
+        sid = sessions_by_pool.get(pool)
+        if sid:
+            fleet.adopt_session(sid)
+
+    adopted: List[str] = []
+    dropped: List[str] = []
+    for rid in sorted(leases):
+        doc = leases[rid]
+        vm_ids = list(doc.get("vm_ids") or ())
+        fleet = fleets.get(doc.get("pool") or "", gw.fleet)
+        live = fleet.get(rid)
+        if live is not None:
+            if not vm_ids or list(live.vm_ids) == vm_ids:
+                # the successor already runs a replica under this id
+                # with the SAME gang: the lease is the live replica's
+                # own row — nothing to adopt, and dropping it would
+                # forget the journal row and free a gang the fleet is
+                # actively using
+                continue
+            # id collision with a PREDECESSOR lease (the boot path
+            # journals fresh leases under replica-1..N before recovery
+            # runs; the snapshot in ``leases`` still names the old
+            # gang): the stale gang is freed back to its session
+            # cache, but the journal row and the KV-index rows now
+            # belong to the LIVE replica — touch neither
+            if allocator is not None:
+                try:
+                    allocator.free(vm_ids)
+                except Exception:  # noqa: BLE001 — gang may be gone
+                    pass
+            dropped.append(rid)
+            RECO_DROPPED_LEASES.inc()
+            continue
+        engine = engine_source(rid, vm_ids) if engine_source else None
+        ok = engine is not None and not getattr(engine, "closed", False)
+        if ok and allocator is not None and vm_ids:
+            from lzy_tpu.service.allocator import RUNNING
+
+            for vm_id in vm_ids:
+                try:
+                    vm = allocator.vm(vm_id)
+                except KeyError:
+                    ok = False
+                    break
+                if vm.status != RUNNING:
+                    ok = False
+                    break
+        if ok:
+            fleet.adopt_replica(rid, engine, vm_ids=vm_ids)
+            adopted.append(rid)
+            RECO_ADOPTIONS.inc()
+        else:
+            # the lease died with the old process: forget its journal
+            # row AND its rows in the global KV index (a retired
+            # replica's cache is gone with it), and free any VMs back
+            # to the session cache so the next scale-up reuses them
+            dropped.append(rid)
+            journal.forget_lease(rid)
+            if gw.kv_index is not None:
+                gw.kv_index.forget(rid)
+            if allocator is not None and vm_ids:
+                try:
+                    allocator.free(vm_ids)
+                except Exception:  # noqa: BLE001 — gang may be gone
+                    pass
+            RECO_DROPPED_LEASES.inc()
+    if dropped:
+        _LOG.warning("recovery: dropped %d unadoptable lease(s): %s",
+                     len(dropped), dropped)
+
+    # the fleet-global prefix index must be whole BEFORE the first
+    # routed request — waiting for the periodic tick would route the
+    # first post-restart wave blind and re-prefill what siblings hold.
+    # The flag makes the first tick force-refresh again (belt and
+    # braces: an engine whose advertisement landed mid-adoption is
+    # re-read even if its memoized object identity matches).
+    gw.refresh_kv_index(force=True)
+    gw._kv_force_refresh = True
+
+    resubmitted: List[str] = []
+    rehydrated: List[str] = []
+    orphaned: List[str] = []
+    dispositions: Dict[str, str] = {}
+    requests = journal.requests() if resume_sessions else {}
+    for rid, doc in sorted(requests.items()):
+        # seed the successor journal's mirror FIRST: a fresh journal
+        # instance (the cross-process path) must keep journaling fence
+        # advances and the terminal settle for the sessions it adopts
+        journal.hydrate_request(rid, doc)
+        if doc.get("status") == "terminal":
+            if doc.get("streamed"):
+                # the lost-final-frame window: the predecessor finished
+                # the generation but the client never read the done
+                # frame — rehydrate the session closed so the old
+                # resume token still reads the tail + done
+                gw.streams.adopt(rid, doc)
+                rehydrated.append(rid)
+                dispositions[rid] = REHYDRATED
+            continue
+        if doc.get("streamed"):
+            gw.streams.adopt(rid, doc)
+            resubmitted.append(rid)
+            dispositions[rid] = RESUBMITTED
+            RECO_RESUBMITS.inc()
+        else:
+            # unary: the reply channel died with the predecessor's RPC
+            # connection — nothing to resume INTO. Typed terminal
+            # status, never a silent drop.
+            journal.finish(
+                rid, ORPHANED,
+                error="non-resumable request orphaned by gateway "
+                      "restart (its reply channel died with the "
+                      "predecessor process)")
+            orphaned.append(rid)
+            dispositions[rid] = ORPHAN
+            RECO_ORPHANS.inc()
+
+    dt = max(0.0, clock.now() - t0)
+    RECO_SECONDS.observe(dt)
+    _LOG.info(
+        "recovery: adopted %d replica(s) (%d dropped), resubmitted %d "
+        "stream(s) at their fences, rehydrated %d terminal, orphaned %d "
+        "unary, in %.3fs", len(adopted), len(dropped), len(resubmitted),
+        len(rehydrated), len(orphaned), dt)
+    report = RecoveryReport(
+        adopted=adopted, dropped_leases=dropped,
+        resubmitted=resubmitted, rehydrated_terminal=rehydrated,
+        orphaned=orphaned, recovery_s=dt, dispositions=dispositions)
+    # auditable tail: every pre-recovery live request must have landed
+    # in exactly one disposition (the invariants module re-checks this
+    # from journal + gateway state; here we record what we DID)
+    for rid in pre_live:
+        if rid not in dispositions:
+            _LOG.error("recovery: journaled live request %s has no "
+                       "disposition — auditor will flag it", rid)
+    return report

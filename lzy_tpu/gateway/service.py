@@ -55,6 +55,20 @@ _FP_DISPATCH = CHAOS.register(
     "gateway.dispatch", error=AdmissionError,
     doc="routed submit to one replica (degrades to the next candidate)")
 
+# chaos boundary: the gateway process itself dying. Pure-crash point
+# (no error mode): an InjectedCrash raised on the request path IS the
+# simulated process death, survivable BY CONSTRUCTION when a journal is
+# wired — the death handler is gateway/recovery.py (adopt leases,
+# resubmit streams at their journaled fences), which the chaos soak
+# runs on every injected death. Only hit on journal-backed gateways:
+# without a journal there is nothing to recover from, and the older
+# soaks' zero-failure contracts must keep holding.
+_FP_CRASH = CHAOS.register(
+    "gateway.crash", crash_ok=True, modes=(),
+    doc="the gateway process dying mid-request (survivable by "
+        "construction: the journal + recovery path restores fences, "
+        "sessions and leases)")
+
 #: engine-side failure prefixes that indicate the REPLICA failed, not the
 #: request — safe (and required) to resubmit elsewhere with fenced tokens
 _FAILOVER_ERRORS = ("engine loop died", "preempted", "engine shutting down")
@@ -83,6 +97,7 @@ class GatewayService:
         kv_index=None,
         kv_transport=None,
         clock=None,
+        journal=None,
     ):
         # injectable time (utils/clock): request deadlines, failover
         # budgets, tick cadence and the drain loop all run on it — the
@@ -143,6 +158,24 @@ class GatewayService:
         from lzy_tpu.serving.streams import StreamSessionManager
 
         self.streams = StreamSessionManager(self, clock=self._clock)
+        #: durable crash-recovery journal (gateway/journal.py): session
+        #: births, routed attempts, fence advances and replica leases —
+        #: what gateway/recovery.py restores a successor from. None
+        #: (the default) costs nothing on the request path.
+        self.journal = journal
+        self.streams.journal = journal
+        self.fleet.journal = journal
+        if journal is not None:
+            # replicas added BEFORE the gateway existed (test harnesses
+            # build fleet-first) get their leases journaled now; ones
+            # added later ride the fleet's own add/adopt hooks
+            for replica in (self.fleet.replicas()
+                            + self.fleet.replicas(state="DRAINING")):
+                self.fleet.journal_lease(replica)
+        #: set by recovery: the first post-restart tick force-refreshes
+        #: the global KV index from every adopted replica (the memoized
+        #: advertisement identity check is skipped once)
+        self._kv_force_refresh = False
 
     # -- request surface -----------------------------------------------------
 
@@ -216,7 +249,9 @@ class GatewayService:
                  tenant: Optional[str] = None,
                  priority: Optional[int] = None,
                  session: Optional[str] = None,
-                 stream=None, liveness=None) -> dict:
+                 stream=None, liveness=None,
+                 resume_tokens: Optional[List[int]] = None,
+                 journal_rid: Optional[str] = None) -> dict:
         """Blocking generate over the fleet; same contract as the single
         engine's RPC surface plus route metadata (``replica``,
         ``routed_by``, ``failovers``) in the reply. Backpressure is
@@ -244,19 +279,42 @@ class GatewayService:
         channel's client probe, carried into every replica submission
         (and checked between failover attempts): a disconnected or
         cancelled client terminates the request within one decode round
-        wherever it sits."""
+        wherever it sits.
+
+        ``resume_tokens`` is the crash-recovery entry
+        (``gateway/recovery.py``): the journaled fence of a request the
+        predecessor gateway was serving when it died. The generation
+        restarts as ``prompt + resume_tokens`` through the ordinary
+        failover machinery (``emitted`` pre-seeded, the stream
+        re-attached at the fence), so the client's old resume token
+        splices byte-identically. A resumed request was authenticated
+        and SLO-charged at its ORIGINAL admission — recovery re-submits
+        under the journaled tenant without a bearer token and without a
+        second rate-bucket charge. ``journal_rid`` names this call's
+        existing journal record (the streaming front passes the stream
+        id); without one, a journal-backed gateway births a fresh unary
+        record — settled with a typed status by recovery if the process
+        dies before the reply."""
+        if self.journal is not None:
+            CHAOS.hit("gateway.crash")
         if self.kv_index is not None:
             self._kvtier_tls.meta = {}   # fresh per call (failovers restage)
-        subject = self._auth(token)
+        resumed = resume_tokens is not None
+        subject = self._auth(token) if not resumed else None
         from lzy_tpu.rpc.core import Unavailable
 
+        jrid = journal_rid
         try:
-            tenant = self._resolve_tenant(subject, tenant)
+            if not resumed:
+                tenant = self._resolve_tenant(subject, tenant)
+            else:
+                tenant = tenant or DEFAULT_TENANT
             prompt = any_to_tokens(prompt)
             self._check_prompt_len(prompt, int(max_new_tokens))
-            policy = self._slo_admit(tenant, prompt)
-            if policy is not None:
-                priority = policy.effective_priority(priority)
+            if not resumed:
+                policy = self._slo_admit(tenant, prompt)
+                if policy is not None:
+                    priority = policy.effective_priority(priority)
             if self._draining:
                 raise self._shed_error(
                     Unavailable,
@@ -273,25 +331,63 @@ class GatewayService:
                     Unavailable,
                     "all gateway waiter threads are busy; retry later",
                     reason="waiters_busy", retry_after_s=0.25)
+            if self.journal is not None and jrid is None:
+                # unary birth (streamed calls carry the stream manager's
+                # record id), BELOW the draining/waiter shed gates: a
+                # fast-rejected request never ran and its reply is
+                # synchronous — journaling it would turn the cheap shed
+                # path into a per-rejection disk write under exactly
+                # the overload it absorbs. LEAN on purpose — a unary
+                # request can only ever be settled as orphaned on
+                # recovery (its reply channel dies with this process),
+                # so the record carries the identity the auditor needs
+                # and NOT the prompt/token payload
+                jrid = self.journal.record_birth(
+                    prompt=(), max_new_tokens=int(max_new_tokens),
+                    greedy=greedy, tenant=tenant, priority=priority,
+                    session=session, deadline_s=deadline_s,
+                    timeout_s=timeout_s, streamed=False,
+                    subject_id=subject.id if subject is not None
+                    else None)
             with self._lock:
                 self._inflight += 1
             try:
-                return self._generate(prompt,
-                                      int(max_new_tokens),
-                                      timeout_s=timeout_s or 120.0,
-                                      deadline_s=deadline_s,
-                                      greedy=greedy,
-                                      tenant=tenant,
-                                      priority=priority,
-                                      session=session,
-                                      stream=stream,
-                                      liveness=liveness)
+                reply = self._generate(prompt,
+                                       int(max_new_tokens),
+                                       timeout_s=timeout_s or 120.0,
+                                       deadline_s=deadline_s,
+                                       greedy=greedy,
+                                       tenant=tenant,
+                                       priority=priority,
+                                       session=session,
+                                       stream=stream,
+                                       liveness=liveness,
+                                       resume_tokens=resume_tokens,
+                                       journal_rid=jrid)
             finally:
                 with self._lock:
                     self._inflight -= 1
                 if gated:
                     self._waiters.release()
+            if self.journal is not None and jrid is not None \
+                    and journal_rid is None:
+                # settle the unary record we birthed (streamed records
+                # are settled by the session manager, which also owns
+                # the reply metadata); lean like the birth — status
+                # only, no token payload
+                self.journal.finish(jrid, reply.get("status", "ok"))
+            return reply
         except BaseException as e:
+            from lzy_tpu.durable.failures import InjectedCrash
+
+            if self.journal is not None and jrid is not None \
+                    and journal_rid is None \
+                    and not isinstance(e, InjectedCrash):
+                # a real process death runs no except blocks: the
+                # injected stand-in must leave the record live for
+                # recovery to settle with its typed status
+                self.journal.finish(
+                    jrid, "error", error=f"{type(e).__name__}: {e}")
             from lzy_tpu.channels.token_stream import fail_if_touched
 
             fail_if_touched(stream, e)
@@ -312,14 +408,26 @@ class GatewayService:
                   tenant: str = DEFAULT_TENANT,
                   priority: Optional[int] = None,
                   session: Optional[str] = None,
-                  stream=None, liveness=None) -> dict:
+                  stream=None, liveness=None,
+                  resume_tokens: Optional[List[int]] = None,
+                  journal_rid: Optional[str] = None) -> dict:
         from lzy_tpu.rpc.core import Unavailable
 
         t0 = self._clock.now()
         wall_deadline = t0 + timeout_s
         fence = (self.fence_auditor.session(prompt)
                  if self.fence_auditor is not None else None)
-        emitted: List[int] = []          # fenced: already streamed tokens
+        # fenced: already streamed tokens. A crash-recovery resubmission
+        # seeds the fence with the predecessor's journaled tokens — the
+        # loop below then behaves exactly like a failover retry: the
+        # effective prompt is prompt + emitted and the stream
+        # re-attaches at the fence position.
+        emitted: List[int] = ([int(t) for t in resume_tokens]
+                              if resume_tokens else [])
+        if fence is not None and emitted:
+            # the auditor must see the recovered fence as the baseline,
+            # not as freshly-generated tokens
+            fence.on_failover(emitted, prompt + emitted)
         failovers = 0
         tried_after_failure: set = set()
         route = None                     # (replica, reason) that SERVED it
@@ -379,6 +487,8 @@ class GatewayService:
                 tenant=tenant, priority=priority, session=session,
                 liveness=liveness)
             route = (replica.id, routed_by)
+            if self.journal is not None and journal_rid is not None:
+                self.journal.record_attempt(journal_rid, replica.id)
             if stream is not None:
                 # the fence is the stream position: this attempt's tokens
                 # land at len(emitted) + i, so a resumed attempt continues
@@ -816,23 +926,14 @@ class GatewayService:
             if self.kv_index is not None:
                 self.kv_index.forget(rid)
                 self._kvtier_last_adv.pop(rid, None)
-        if self.kv_index is not None:
-            # refresh the fleet-global prefix index from each replica's
-            # advertisement (chains by tier); pull-based and advisory —
-            # a stale entry costs one pointless import attempt at worst.
-            # Engines memoize the advertisement by cache-structure
-            # version (unchanged cache → SAME object), so a quiet fleet
-            # skips the re-hash entirely tick after tick.
-            from lzy_tpu.gateway.kv_index import chains_of
-
-            for replica in self.fleet.replicas():
-                chains = chains_of(replica.engine)
-                if not chains:
-                    continue
-                if self._kvtier_last_adv.get(replica.id) is chains:
-                    continue
-                self.kv_index.update_replica(replica.id, chains)
-                self._kvtier_last_adv[replica.id] = chains
+        force = self._kv_force_refresh
+        self._kv_force_refresh = False
+        self.refresh_kv_index(force=force)
+        if self.journal is not None:
+            # terminal journal records age out with the same ttl as the
+            # stream manager's resume window — past it nothing can
+            # re-poll them, so keeping the rows only grows the store
+            self.journal.prune_terminal(self.streams.terminal_ttl_s)
         if self.autoscaler is None:
             return None
         ready = len(self.fleet.replicas())
@@ -878,6 +979,30 @@ class GatewayService:
             self._scale_downs += 1
         _SCALE.inc(direction="down")
         return DOWN
+
+    def refresh_kv_index(self, force: bool = False) -> None:
+        """Refresh the fleet-global prefix index from each replica's
+        advertisement (chains by tier); pull-based and advisory — a
+        stale entry costs one pointless import attempt at worst.
+        Engines memoize the advertisement by cache-structure version
+        (unchanged cache → SAME object), so a quiet fleet skips the
+        re-hash entirely tick after tick. ``force=True`` (a recovered
+        gateway's cold start) skips the identity memo and re-reads every
+        replica — the index must be whole BEFORE the first routed
+        request, not after the first periodic tick."""
+        if self.kv_index is None:
+            return
+        from lzy_tpu.gateway.kv_index import chains_of
+
+        for replica in self.fleet.replicas():
+            chains = chains_of(replica.engine)
+            if not chains:
+                continue
+            if not force and \
+                    self._kvtier_last_adv.get(replica.id) is chains:
+                continue
+            self.kv_index.update_replica(replica.id, chains)
+            self._kvtier_last_adv[replica.id] = chains
 
     def _coldest_replica(self) -> Optional[str]:
         """Drain victim: the replica with the least routing heat (fewest
@@ -1030,6 +1155,8 @@ class GatewayService:
                 "kvtier_host_blocks": agg.get("kv_host_tier_blocks", 0),
                 "kvtier_index": self.kv_index.stats(),
             })
+        if self.journal is not None:
+            doc["journal"] = self.journal.stats()
         return doc
 
     def fleet_stats(self, *, token: Optional[str] = None) -> dict:

@@ -96,6 +96,14 @@ class FleetConfig:
     #: heavy-tail tenants with finite rate limits so shedding is real
     tenant_policies: Optional[dict] = None
     default_policy: Optional[dict] = None
+    #: virtual second at which the gateway performs a zero-downtime
+    #: rolling restart mid-trace (None = never): a journal-backed
+    #: successor is built, adopts the predecessor's replica engines
+    #: (warm caches kept) through ``gateway/recovery.py``, traffic
+    #: swaps over, and the predecessor drains its in-flight requests —
+    #: the SLO contract under test is zero failed requests and bounded
+    #: added TTFT. Deterministic: the swap fires on the virtual clock.
+    gateway_restart_at_s: Optional[float] = None
 
 
 def default_tenant_policies(tenants: int = 8) -> dict:
@@ -115,9 +123,13 @@ def default_tenant_policies(tenants: int = 8) -> dict:
 
 
 def build_fleet(cfg: FleetConfig, clock: VirtualClock,
-                collector: "Collector"):
+                collector: "Collector", *, journal=None,
+                replicas: Optional[int] = None):
     """A fleet-in-threads gateway over SimEngine replicas, everything on
-    the injected virtual clock."""
+    the injected virtual clock. ``journal`` wires control-plane crash
+    recovery (built automatically when ``cfg.gateway_restart_at_s`` is
+    scheduled); ``replicas`` overrides the fleet size (0 = the empty
+    successor a restart recovers into)."""
     table = TenantTable(default=TenantPolicy(
         **(cfg.default_policy or {})))
     policies = (cfg.tenant_policies
@@ -130,6 +142,12 @@ def build_fleet(cfg: FleetConfig, clock: VirtualClock,
         return SimEngine(cfg.profile, clock=clock, tenants=table,
                          collector=collector)
 
+    if journal is None and cfg.gateway_restart_at_s is not None:
+        from lzy_tpu.durable.store import OperationStore
+        from lzy_tpu.gateway.journal import GatewayJournal
+
+        journal = GatewayJournal(OperationStore(":memory:", clock=clock),
+                                 clock=clock)
     fleet = ReplicaFleet(factory, clock=clock)
     scaler = (Autoscaler(**cfg.autoscaler)
               if cfg.autoscaler is not None else None)
@@ -145,8 +163,9 @@ def build_fleet(cfg: FleetConfig, clock: VirtualClock,
         max_waiters=cfg.max_waiters,
         tick_period_s=cfg.tick_period_s,
         clock=clock,
+        journal=journal,
     )
-    for _ in range(cfg.replicas):
+    for _ in range(cfg.replicas if replicas is None else replicas):
         fleet.add_replica()
     return gw, fleet
 
@@ -212,6 +231,10 @@ class LoadDriver:
         self._busy_until: Dict[str, float] = {}
         #: guard tripped: clients stop issuing turns/retries and drain
         self._stopping = False
+        #: rolling-restart event (fleet_cfg.gateway_restart_at_s):
+        #: filled with the RecoveryReport once the swap has happened
+        self.restart_report = None
+        self._retiring: List[GatewayService] = []
 
     # -- client side ---------------------------------------------------------
 
@@ -306,6 +329,71 @@ class LoadDriver:
 
     # -- driver side ---------------------------------------------------------
 
+    def _restart_gateway(self) -> None:
+        """Zero-downtime rolling restart at the scheduled virtual time:
+        build a journal-backed successor, adopt the predecessor's
+        replica ENGINES (warm radix caches and queue state survive —
+        adopted, not re-leased), swap client traffic over, and leave
+        the predecessor draining its in-flight requests
+        (:meth:`_reap_retired` closes it once empty). Contract under
+        test: zero failed requests, bounded added TTFT."""
+        from lzy_tpu.gateway.recovery import recover_gateway
+
+        old_gw, old_fleet = self.gateway, self.fleet
+        engines = {r.id: r.engine
+                   for r in (old_fleet.replicas()
+                             + old_fleet.replicas(state=DRAINING))}
+        new_gw, new_fleet = build_fleet(
+            self.fleet_cfg, self.clock, self.collector,
+            journal=old_gw.journal, replicas=0)
+        # rolling variant: the predecessor is alive and will finish (and
+        # journal) its own in-flight requests — adopt leases + KV index
+        # only, never resubmit or orphan what it is still serving
+        self.restart_report = recover_gateway(
+            new_gw,
+            engine_source=lambda rid, vms: engines.get(rid),
+            resume_sessions=False)
+        self.gateway, self.fleet = new_gw, new_fleet
+        old_gw._draining = True            # stragglers shed -> retry -> us
+        # release the predecessor's replica table AT SWAP TIME: from
+        # here the successor owns the engines, and the draining shell
+        # must hold no retire authority over them — a health-triggered
+        # _retire would close a shared engine and forget_lease the
+        # successor's journal row. An in-flight request that fails over
+        # on the empty table sheds with a retry hint and lands on us.
+        old_gw.fleet.release_for_handoff()
+        self._retiring.append(old_gw)
+        _LOG.info("load: gateway rolling restart at %.1fs — %d "
+                  "replica(s) adopted, predecessor draining",
+                  self.clock.now(), len(self.restart_report.adopted))
+
+    def close(self) -> None:
+        """Close the CURRENT gateway and any draining predecessors.
+        A rolling restart swaps ``self.gateway``, so callers must tear
+        down through the driver — a pre-restart handle would close the
+        (already-released) predecessor shell and leak the successor
+        with every adopted engine."""
+        for gw in self._retiring + [self.gateway]:
+            try:
+                gw.close()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self._retiring = []
+
+    def _reap_retired(self) -> None:
+        """Close drained predecessors (replica tables already released
+        at swap time — the successor owns the engines): once the last
+        in-flight request finishes, the empty shell shuts down."""
+        still = []
+        for gw in self._retiring:
+            with gw._lock:
+                inflight = gw._inflight
+            if inflight == 0:
+                gw.close()
+            else:
+                still.append(gw)
+        self._retiring = still
+
     def _engines(self):
         """Live (replica_id, engine) pairs — keyed by the fleet's OWN
         unambiguous ids, never ``id(engine)`` (a scaled-down engine's
@@ -364,7 +452,12 @@ class LoadDriver:
             if t_next > now:
                 clock.advance_to(t_next)
                 now = clock.now()
+            restart_at = self.fleet_cfg.gateway_restart_at_s
+            if restart_at is not None and self.restart_report is None \
+                    and now + 1e-9 >= restart_at:
+                self._restart_gateway()
             if now + 1e-9 >= next_tick:
+                self._reap_retired()
                 self.gateway.tick(now=clock.time())
                 live = self._engines()
                 agg_depth = sum(e.stats().queue_depth for _, e in live)
@@ -394,6 +487,7 @@ class LoadDriver:
             stalled = 0 if progressed else stalled + 1
         for t in threads:
             t.join(timeout=30.0)
+        self._reap_retired()            # drained predecessors close now
         virtual_s = clock.now()
         wall_s = max(1e-9, _time.perf_counter() - wall0)
         LOAD_VIRTUAL_SECONDS.inc(virtual_s)
@@ -436,6 +530,11 @@ class LoadReport:
     #: "retries": n}} — what the shed-honoring and WFQ assertions read
     outcomes_by_tenant: Dict[str, Dict[str, int]] = dataclasses.field(
         default_factory=dict)
+    #: rolling-restart facts (fleet_cfg.gateway_restart_at_s): how many
+    #: restarts fired and how many replicas the successor ADOPTED (vs
+    #: re-leased — adopted keeps the warm caches)
+    gateway_restarts: int = 0
+    restart_adopted: int = 0
 
     @classmethod
     def build(cls, driver: LoadDriver, virtual_s: float,
@@ -480,6 +579,11 @@ class LoadReport:
             speedup_x=round(virtual_s / wall_s, 1),
             tenants=dict(sorted(col.tokens_by_tenant.items())),
             outcomes_by_tenant=dict(sorted(by_tenant.items())),
+            gateway_restarts=1 if driver.restart_report is not None
+            else 0,
+            restart_adopted=(len(driver.restart_report.adopted)
+                             if driver.restart_report is not None
+                             else 0),
         )
 
     def metrics(self) -> dict:
@@ -503,6 +607,7 @@ def replay(trace_cfg: TraceConfig,
     clock = VirtualClock()
     collector = Collector()
     gw, fleet = build_fleet(fleet_cfg, clock, collector)
+    driver = None
     try:
         driver = LoadDriver(gw, fleet, clock, trace_cfg,
                             fleet_cfg=fleet_cfg, collector=collector,
@@ -510,7 +615,13 @@ def replay(trace_cfg: TraceConfig,
                             max_virtual_s=max_virtual_s)
         return driver.run()
     finally:
-        gw.close()
+        # through the driver: a rolling restart swapped driver.gateway,
+        # and closing the stale pre-restart handle would leak the
+        # successor with every adopted engine
+        if driver is not None:
+            driver.close()
+        else:
+            gw.close()
 
 
 def sweep_replicas(trace_cfg: TraceConfig, fleet_cfg: FleetConfig,

@@ -784,7 +784,8 @@ class RpcInferenceClient:
     ``stats`` is read-only and retries transparently."""
 
     def __init__(self, address: Optional[str] = None, *, token=None,
-                 client: Optional[JsonRpcClient] = None, clock=None):
+                 client: Optional[JsonRpcClient] = None, clock=None,
+                 reconnect=None):
         # injectable time (utils/clock): the iter_stream poll deadline
         self._clock = clock if clock is not None else SYSTEM_CLOCK
         if client is None:
@@ -796,6 +797,18 @@ class RpcInferenceClient:
             self._owns_client = False
         self._client = client
         self._token = token
+        # the reconnect ladder (utils/backoff.RetryPolicy): consecutive
+        # stream-poll failures — connection refused while the gateway
+        # restarts, a dropped LB — back off exponentially with full
+        # jitter before re-polling the SAME fence position. Resume
+        # tokens are idempotent reads, so the ladder is pure patience:
+        # once the successor process answers, the poll splices
+        # byte-identically at the fence.
+        from lzy_tpu.utils.backoff import RetryPolicy
+
+        self._reconnect = (reconnect if reconnect is not None
+                           else RetryPolicy(attempts=8, base_s=0.1,
+                                            cap_s=2.0))
 
     def generate(self, prompt, *, max_new_tokens: int = 64,
                  timeout_s: Optional[float] = None,
@@ -896,27 +909,36 @@ class RpcInferenceClient:
 
     def iter_stream(self, request_id: str, position: int = 0, *,
                     wait_s: float = 5.0, deadline_s: float = 180.0,
-                    max_poll_failures: int = 8):
+                    max_poll_failures: Optional[int] = None):
         """Generator over a stream's frames from ``position`` — ALSO the
         resume surface: after a client crash or connection death, a new
         client iterates from the last position it durably consumed and
         the frames are byte-identical. Transient poll failures
-        (UNAVAILABLE, deadline) re-poll the same position; only
-        ``max_poll_failures`` CONSECUTIVE failures give up."""
-        from lzy_tpu.rpc.core import Unavailable
-
+        (UNAVAILABLE — including connection-refused while the gateway
+        rolls over to a successor process — and deadline) climb the
+        reconnect ladder: exponential full-jitter backoff between
+        re-polls of the SAME position, so a journal-backed gateway
+        restart is one quiet pause followed by a byte-identical resume
+        at the fence. Only ``max_poll_failures`` (default: the ladder's
+        attempt budget) CONSECUTIVE failures give up."""
         pos = int(position)
         failures = 0
+        budget = (max_poll_failures if max_poll_failures is not None
+                  else self._reconnect.attempts)
         deadline = self._clock.time() + deadline_s
         while True:
             try:
                 frame = self.stream_poll(request_id, pos, wait_s=wait_s)
                 failures = 0
-            except (Unavailable, TimeoutError):
+            except (ConnectionError, TimeoutError):
+                # Unavailable IS a ConnectionError; a refused dial to a
+                # restarting gateway lands here too
                 failures += 1
-                if failures > max_poll_failures or \
-                        self._clock.time() > deadline:
+                if failures > budget or self._clock.time() > deadline:
                     raise
+                self._clock.sleep(
+                    self._reconnect.delay_s(min(failures,
+                                                self._reconnect.attempts)))
                 continue
             yield frame
             pos += len(frame.get("tokens", ()))

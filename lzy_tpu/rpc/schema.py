@@ -345,6 +345,15 @@ REQUESTS: Dict[str, Schema] = {
     # InferCancel(request_id) propagates mid-stream through gateway →
     # disagg → engine; the stream terminates with status "cancelled"
     # and the tokens emitted so far.
+    # Crash recovery (docs/serving.md "Control-plane recovery"): on a
+    # journal-backed gateway the resume token additionally survives a
+    # GATEWAY PROCESS DEATH — the successor rehydrates the session from
+    # the journaled fence under the same request_id, so a client that
+    # rode out a restart (connection refused → the RpcInferenceClient
+    # reconnect ladder backs off and re-polls) reads a byte-identical
+    # continuation from the new process. An unknown request_id after a
+    # restart means the plane had no journal (or the record aged out of
+    # the resume window): NOT_FOUND, the honest signal to re-submit.
     "InferStream": Schema("InferStreamRequest", {
         "prompt": f(list, required=True),
         "max_new_tokens": f(int),

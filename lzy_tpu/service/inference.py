@@ -311,6 +311,7 @@ def build_gateway_service(
     start: bool = True,
     prefill_budget: Optional[int] = None,
     tenants=None,
+    journal=None,
 ):
     """Construct the serving fleet gateway (``serve.py --gateway``): N
     engine replicas behind one ``InferGenerate`` endpoint with
@@ -335,6 +336,11 @@ def build_gateway_service(
     ``tenants`` (a ``serving.tenancy.TenantTable``) turns on the
     multi-tenant SLO layer: token-bucket rate limits at the gateway,
     WFQ + per-tenant queue caps + KV quotas in every replica.
+    ``journal`` (a ``gateway.journal.GatewayJournal`` over the durable
+    store plane) turns on control-plane crash recovery: session births,
+    fence advances and replica leases are journaled so a successor
+    process restores them (``serve.py --gateway-journal``;
+    docs/serving.md "Control-plane recovery").
     """
     from lzy_tpu.gateway import (
         Autoscaler, GatewayService, PrefixAffinityRouter, ReplicaFleet,
@@ -407,6 +413,7 @@ def build_gateway_service(
         model_name=model,
         slo=slo,
         kv_index=kv_index,
+        journal=journal,
     )
     try:
         for _ in range(replicas):
@@ -458,6 +465,7 @@ def build_disagg_gateway_service(
     prefill_budget: Optional[int] = None,
     tenants=None,
     kv_global_index: Optional[bool] = None,
+    journal=None,
 ):
     """Construct the disaggregated serving gateway (``serve.py --disagg``):
     a pool of ``prefill_replicas`` :class:`~lzy_tpu.serving.PrefillEngine`
@@ -554,6 +562,7 @@ def build_disagg_gateway_service(
         model_name=model,
         slo=slo,
         kv_index=kv_index,
+        journal=journal,
     )
     try:
         for _ in range(decode_replicas):

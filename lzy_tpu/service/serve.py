@@ -247,6 +247,22 @@ def main(argv=None) -> int:
     parser.add_argument("--gateway-pool", default="cpu-small",
                         help="allocator pool the gateway leases replica "
                              "gangs from")
+    parser.add_argument("--gateway-journal", default=None, nargs="?",
+                        const="auto",
+                        help="control-plane crash recovery under "
+                             "--gateway/--disagg: journal session "
+                             "births, stream fences and replica leases "
+                             "to this SQLite path (bare flag derives "
+                             "<--db>.gwjournal). On boot, a journal "
+                             "holding a predecessor's state is "
+                             "recovered: live streams are re-submitted "
+                             "as prompt + fenced tokens (the resume "
+                             "token (request_id, position) keeps "
+                             "working), non-resumable requests are "
+                             "settled with a typed status, and stale "
+                             "leases are released to the warm-gang "
+                             "cache (docs/serving.md 'Control-plane "
+                             "recovery')")
     parser.add_argument("--disagg", action="store_true",
                         help="disaggregated prefill/decode serving: a "
                              "prefill replica pool exports paged KV blocks "
@@ -320,6 +336,24 @@ def main(argv=None) -> int:
     if warm_start:
         _enable_compile_cache()
 
+    if args.gateway_journal and not (args.gateway or args.disagg):
+        parser.error("--gateway-journal needs a fleet front "
+                     "(--gateway or --disagg)")
+    journal = None
+    predecessor_leases = None
+    if args.gateway_journal:
+        from lzy_tpu.durable.store import OperationStore
+        from lzy_tpu.gateway.journal import GatewayJournal
+
+        journal_path = (args.gateway_journal
+                        if args.gateway_journal != "auto"
+                        else args.db + ".gwjournal")
+        journal = GatewayJournal(OperationStore(journal_path))
+        # snapshot the PREDECESSOR's lease rows NOW: the fresh fleet's
+        # add_replica journals its own leases under the same
+        # replica-1..N keys, overwriting these before recovery runs
+        predecessor_leases = journal.leases()
+
     inference_service = None
     inference_factory = None
     if args.serve_model and args.disagg:
@@ -353,6 +387,7 @@ def main(argv=None) -> int:
                 warm_start=warm_start,
                 prefill_budget=prefill_budget,
                 tenants=tenants,
+                journal=journal,
             )
     elif args.serve_model and args.gateway:
         from lzy_tpu.service.inference import build_gateway_service
@@ -386,6 +421,7 @@ def main(argv=None) -> int:
                 warm_start=warm_start,
                 prefill_budget=prefill_budget,
                 tenants=tenants,
+                journal=journal,
             )
     elif args.serve_model:
         from lzy_tpu.service.inference import build_inference_service
@@ -446,6 +482,33 @@ def main(argv=None) -> int:
         streams.stall_grace_s = args.stream_stall_grace_s
         streams.liveness_timeout_s = args.stream_liveness_s
         streams.max_sessions = args.stream_max_sessions
+    if journal is not None and serving_now is not None:
+        # boot-time crash recovery: a journal holding a predecessor's
+        # state restores it BEFORE the port starts answering. With
+        # in-process engines there is nothing to re-adopt across a
+        # process death (engine_source=None: stale leases are released
+        # to the warm-gang session cache instead); live streams are
+        # re-submitted at their journaled fences onto the fresh fleet,
+        # so a client's old resume token answers on this process.
+        from lzy_tpu.gateway.recovery import recover_gateway
+
+        try:
+            report = recover_gateway(serving_now, engine_source=None,
+                                     allocator=cluster.allocator,
+                                     leases=predecessor_leases)
+            if report.resubmitted or report.orphaned or \
+                    report.rehydrated_terminal or report.dropped_leases:
+                print(f"gateway journal recovered: "
+                      f"{len(report.resubmitted)} stream(s) resumed at "
+                      f"their fences, "
+                      f"{len(report.rehydrated_terminal)} terminal "
+                      f"stream(s) rehydrated (lost-final-frame window), "
+                      f"{len(report.orphaned)} unary request(s) "
+                      f"orphaned, {len(report.dropped_leases)} stale "
+                      f"lease(s) released", flush=True)
+        except Exception as e:  # noqa: BLE001 — serve anyway
+            print(f"gateway journal recovery failed ({e}); serving "
+                  f"with a fresh control plane", flush=True)
 
     server = cluster.serve(args.port)
     model = f", model={args.serve_model}" if args.serve_model else ""

@@ -254,6 +254,13 @@ class StreamSessionManager:
         self.max_frame_wait_s = float(max_frame_wait_s)
         self._sessions: Dict[str, StreamSession] = {}
         self._lock = threading.Lock()
+        #: crash-recovery journal (gateway/journal.py), set by a
+        #: journal-backed GatewayService: session births, fence
+        #: advances (journaled BEFORE the frame is served, so the
+        #: durable fence always covers what the client has seen) and
+        #: terminal records all ride it. None costs one attribute
+        #: check per open/poll.
+        self.journal = None
 
     # -- auth scoping ---------------------------------------------------------
 
@@ -310,33 +317,45 @@ class StreamSessionManager:
                     reason="stream_sessions", retry_after_s=0.5)
             self._sessions[sid] = sess
         SESSIONS.set(float(live + 1))
-
-        def run():
+        call_kwargs = dict(
+            max_new_tokens=int(max_new_tokens), timeout_s=timeout_s,
+            deadline_s=deadline_s, greedy=greedy, tenant=tenant,
+            priority=priority, session=session, token=token)
+        if self.journal is not None:
+            # session birth, journaled BEFORE any work: a gateway death
+            # from here on can resubmit this exact request at its fence.
+            # The tenant is journaled RESOLVED (the IAM subject id when
+            # a bearer token names one): the resubmission runs without a
+            # token, so the record must carry the identity the original
+            # admission charged — not the raw wire field
             try:
-                sess.reply = self._service.generate(
-                    prompt, max_new_tokens=int(max_new_tokens),
-                    timeout_s=timeout_s, deadline_s=deadline_s,
-                    greedy=greedy, tenant=tenant, priority=priority,
-                    session=session, token=token,
-                    stream=sess.channel, liveness=sess.alive)
-            except BaseException as e:  # noqa: BLE001 — frame owns it
-                sess.error = e
-                # the service fails a TOUCHED stream itself; a virgin
-                # one (admission refusal, auth failure) is left open for
-                # the caller's retry policy — here the poller IS the
-                # caller, so terminate the channel for it
-                if not sess.channel.closed:
-                    sess.channel.fail(f"{type(e).__name__}: {e}")
-            finally:
-                sess.finished.set()
+                journal_tenant = tenant
+                resolve = getattr(self._service, "_resolve_tenant", None)
+                if resolve is not None:
+                    try:
+                        journal_tenant = resolve(subject, tenant)
+                    except Exception:  # noqa: BLE001 — generate re-raises
+                        pass
+                self.journal.record_birth(
+                    sid, prompt=[int(t) for t in prompt],
+                    max_new_tokens=int(max_new_tokens), greedy=greedy,
+                    tenant=journal_tenant, priority=priority,
+                    session=session, deadline_s=deadline_s,
+                    timeout_s=timeout_s, streamed=True,
+                    subject_id=subject.id if subject is not None
+                    else None)
+            except BaseException:
+                # a malformed prompt (or params) failed the record's
+                # OWN serialization before any worker existed: unwind
+                # the registered session — leaking it would count
+                # toward max_sessions forever — and let the caller get
+                # the typed error the worker's fast-fail path would
+                # have produced
                 with self._lock:
-                    live_now = sum(1 for s in self._sessions.values()
-                                   if not s.terminal)
-                SESSIONS.set(float(live_now))
-
-        thread = threading.Thread(target=run, name=f"stream-{sid}",
-                                  daemon=True)
-        thread.start()
+                    self._sessions.pop(sid, None)
+                raise
+            call_kwargs["journal_rid"] = sid
+        self._spawn_worker(sess, list(prompt), call_kwargs)
         # fast-path errors (queue full, quota, over-long prompt, bad
         # auth) surface on the open RPC with their own wire status
         # instead of an opened-then-dead session — but only while the
@@ -347,9 +366,131 @@ class StreamSessionManager:
                 and sess.channel.position == 0:
             with self._lock:
                 self._sessions.pop(sid, None)
+            if self.journal is not None:
+                # the caller got the failure synchronously; there is no
+                # session to recover
+                self.journal.forget(sid)
             raise sess.error
         return {"request_id": sid, "position": 0,
                 "model": getattr(self._service, "model_name", "custom")}
+
+    def _spawn_worker(self, sess: StreamSession, prompt,
+                      call_kwargs: dict) -> None:
+        """One session worker thread driving the blocking ``generate``
+        surface (shared by :meth:`open` and crash-recovery
+        :meth:`adopt`); settles the journal record on the way out."""
+
+        def run():
+            try:
+                sess.reply = self._service.generate(
+                    prompt, stream=sess.channel, liveness=sess.alive,
+                    **call_kwargs)
+            except BaseException as e:  # noqa: BLE001 — frame owns it
+                sess.error = e
+                # the service fails a TOUCHED stream itself; a virgin
+                # one (admission refusal, auth failure) is left open for
+                # the caller's retry policy — here the poller IS the
+                # caller, so terminate the channel for it
+                if not sess.channel.closed:
+                    sess.channel.fail(f"{type(e).__name__}: {e}")
+            finally:
+                from lzy_tpu.durable.failures import InjectedCrash
+
+                if not isinstance(sess.error, InjectedCrash):
+                    # an InjectedCrash IS the simulated process death:
+                    # a dead process runs no finally blocks, so the
+                    # journal record must stay LIVE for the successor
+                    # to resubmit at the fence
+                    self._journal_finish(sess)
+                sess.finished.set()
+                with self._lock:
+                    live_now = sum(1 for s in self._sessions.values()
+                                   if not s.terminal)
+                SESSIONS.set(float(live_now))
+
+        thread = threading.Thread(target=run, name=f"stream-{sess.id}",
+                                  daemon=True)
+        thread.start()
+
+    def _journal_finish(self, sess: StreamSession) -> None:
+        """Settle the session's journal record with its terminal status,
+        full fence and reply metadata (the lost-final-frame resume
+        window: a successor rehydrates terminal records closed, so a
+        re-poll still reads the tail + done frame). Reads ``journal``
+        at finish time on purpose: a simulated process death DETACHES
+        the journal first, exactly because a real crash runs no
+        ``finally`` blocks — a dying gateway must not settle records
+        its successor needs live."""
+        journal = self.journal
+        if journal is None:
+            return
+        if sess.error is not None:
+            journal.finish(
+                sess.id, "error",
+                error=f"{type(sess.error).__name__}: {sess.error}",
+                fence=sess.channel.tokens())
+            return
+        reply = sess.reply or {}
+        journal.finish(
+            sess.id, reply.get("status", "ok"),
+            fence=sess.channel.tokens(),
+            reply={k: v for k, v in reply.items() if k != "tokens"})
+
+    def adopt(self, request_id: str, doc: dict) -> StreamSession:
+        """Crash-recovery rehydration (``gateway/recovery.py``): rebuild
+        a session from its journal record under the SAME request id, so
+        the predecessor's resume token ``(request_id, position)`` keeps
+        working on this process.
+
+        - a **live** record re-submits the generation as ``prompt +
+          fenced_tokens`` through the ordinary failover path: the
+          journaled fence is pre-published into a fresh channel (every
+          position the old process ever served reads byte-identically)
+          and the worker's ``generate`` re-attaches at the fence;
+        - a **terminal** record rehydrates CLOSED — the resume window
+          for a final frame the predecessor never delivered.
+
+        Deliberately exempt from ``max_sessions``: recovery must not
+        shed the very sessions it exists to save (the predecessor
+        already admitted them under the cap)."""
+        sess = StreamSession(self, request_id, doc.get("subject_id"),
+                             doc.get("tenant"))
+        fence = [int(t) for t in doc.get("fence") or ()]
+        if fence:
+            sess.channel.publish(0, fence)
+            sess.channel.note_resumption()
+        with self._lock:
+            self._sessions[request_id] = sess
+        if doc.get("status") == "terminal":
+            status = doc.get("terminal") or "ok"
+            reply = dict(doc.get("reply") or {})
+            reply.setdefault("status", status)
+            reply["tokens"] = fence
+            sess.reply = reply
+            if status == "error" or doc.get("error"):
+                sess.channel.fail(doc.get("error") or "failed before "
+                                  "the gateway restart")
+            else:
+                sess.channel.close(status)
+            sess.finished.set()
+            return sess
+        # live: resume at the fence. The client deadline stays absolute
+        # from the ORIGINAL submission — recovery carries the remainder,
+        # never a reset budget.
+        deadline_s = doc.get("deadline_s")
+        if deadline_s is not None:
+            elapsed = max(0.0, self._clock.time()
+                          - float(doc.get("born_at") or 0.0))
+            deadline_s = max(0.001, float(deadline_s) - elapsed)
+        call_kwargs = dict(
+            max_new_tokens=int(doc["max_new_tokens"]),
+            timeout_s=doc.get("timeout_s"), deadline_s=deadline_s,
+            greedy=doc.get("greedy"), tenant=doc.get("tenant"),
+            priority=doc.get("priority"), session=doc.get("session"),
+            token=None, resume_tokens=fence, journal_rid=request_id)
+        self._spawn_worker(sess, [int(t) for t in doc["prompt"]],
+                           call_kwargs)
+        return sess
 
     def poll(self, request_id: str, position: int = 0, *,
              wait_s: float = 5.0, token: Optional[str] = None) -> dict:
@@ -364,6 +505,13 @@ class StreamSessionManager:
         delivered."""
         sess = self._get(request_id)
         self._check_owner(sess, token)
+        if self.journal is not None:
+            # the gateway process dying mid-stream (an InjectedCrash
+            # here is the simulated death while tokens are flowing);
+            # survivable by construction — the journaled fence covers
+            # every frame already served, so the recovered session
+            # answers this very poll byte-identically
+            CHAOS.hit("gateway.crash")
         # chaos: the frame path (drop/delay/connection death) — raising
         # here is exactly a dropped reply; the client re-polls the same
         # position and reads the identical frame
@@ -404,6 +552,14 @@ class StreamSessionManager:
                 sess.last_poll = self._clock.now()
                 sess._served = max(sess._served,
                                    pos + len(out["tokens"]))
+        journal = self.journal
+        if journal is not None and out["tokens"]:
+            # durable fence BEFORE the frame reaches the client: the
+            # journal must always cover everything the client has seen,
+            # or a post-crash resubmission could diverge below tokens
+            # the client already consumed. Delta form — exactly this
+            # frame — so the poll path stays O(frame)
+            journal.advance_fence(request_id, pos, out["tokens"])
         frame = {
             "request_id": request_id,
             "position": pos,
@@ -466,6 +622,11 @@ class StreamSessionManager:
                      and now - s.last_poll > self.terminal_ttl_s]
             for sid in stale:
                 del self._sessions[sid]
+        journal = self.journal
+        if journal is not None and stale:
+            # the resume window closed with these sessions (batched:
+            # one fence-namespace sweep for the whole GC round)
+            journal.forget_many(stale)
 
     def sessions(self) -> List[str]:
         with self._lock:

@@ -1,0 +1,25 @@
+# corpus: the ISSUE 15 class — a crash-recovery journal that performs
+# its durable append (storage I/O) while holding the mirror lock. Every
+# serving thread advancing a fence serializes behind the disk/DB write,
+# and a fault-delayed append parks the whole request path.
+import threading
+
+
+class BadJournal:
+    def __init__(self, storage):
+        self._lock = threading.Lock()
+        self._storage = storage
+        self._fences = {}
+
+    def advance_fence(self, request_id, tokens):
+        with self._lock:
+            self._fences[request_id] = list(tokens)
+            # durable append UNDER the mirror lock: the write's latency
+            # (or an injected journal.append delay) is now every
+            # caller's latency
+            self._storage.write_bytes(
+                f"gwj/{request_id}", bytes(self._fences[request_id]))
+
+    def load_fence(self, request_id):
+        with self._lock:
+            return self._storage.read_bytes(f"gwj/{request_id}")

@@ -1,0 +1,24 @@
+# corpus: the correct journal shape (what gateway/journal.py does) —
+# the in-memory mirror updates under the lock, the durable append runs
+# OUTSIDE it with the snapshot, so a slow or fault-delayed write never
+# serializes the serving path behind the journal.
+import threading
+
+
+class GoodJournal:
+    def __init__(self, storage):
+        self._lock = threading.Lock()
+        self._storage = storage
+        self._fences = {}
+
+    def advance_fence(self, request_id, tokens):
+        with self._lock:
+            self._fences[request_id] = list(tokens)
+            snap = list(self._fences[request_id])
+        self._storage.write_bytes(f"gwj/{request_id}", bytes(snap))
+
+    def load_fence(self, request_id):
+        data = self._storage.read_bytes(f"gwj/{request_id}")
+        with self._lock:
+            self._fences[request_id] = list(data)
+        return data

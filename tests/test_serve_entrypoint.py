@@ -257,3 +257,13 @@ class TestServeArgErrors:
         ])
         assert res.returncode == 2
         assert "--worker-image" in res.stdout
+
+    def test_gateway_journal_requires_fleet_front(self, tmp_path):
+        res = self._run([
+            "--db", str(tmp_path / "m.db"),
+            "--storage-uri", f"file://{tmp_path}/s",
+            "--serve-model", "tiny",
+            "--gateway-journal",
+        ])
+        assert res.returncode == 2
+        assert "--gateway-journal" in res.stdout

@@ -56,6 +56,11 @@ def registry_metrics():
     import lzy_tpu.gateway.kv_index  # noqa: F401
     import lzy_tpu.gateway.router  # noqa: F401
     import lzy_tpu.gateway.service  # noqa: F401
+    # control-plane crash recovery: journal appends/degraded, gang
+    # adoptions, fence resubmits, orphaned requests, recovery latency
+    # (lzy_gwreco_*)
+    import lzy_tpu.gateway.journal  # noqa: F401
+    import lzy_tpu.gateway.recovery  # noqa: F401
     # disagg: transfer bytes/latency, cache-skips, re-prefill fallbacks
     import lzy_tpu.gateway.disagg  # noqa: F401
     import lzy_tpu.serving.disagg.decode  # noqa: F401
