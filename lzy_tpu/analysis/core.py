@@ -65,6 +65,11 @@ RULES: Dict[str, str] = {
         "a host-device synchronization (.item(), np.asarray, "
         "device_get) inside a per-item loop of an engine "
         "step/prefill/decode function",
+    "jax-reupload-hot-loop":
+        "jnp.asarray/jnp.array of a host array inside a per-round loop "
+        "of an engine step/decode function when nothing in the loop "
+        "writes it — a per-round re-upload of unchanged bytes (cache a "
+        "device mirror, invalidated on writes)",
     "jax-traced-python-if":
         "a Python `if`/`while` branches on a traced argument inside a "
         "jitted function (trace-time error or silent specialization)",

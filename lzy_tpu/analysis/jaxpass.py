@@ -28,6 +28,13 @@ Rules:
   parameter of a jitted function.  Trace-time-static idioms are
   exempt: ``x is None`` / ``is not None``, ``isinstance``, ``len(x)``
   and ``.shape``/``.ndim``/``.size``/``.dtype`` access.
+- ``jax-reupload-hot-loop`` — ``jnp.asarray``/``jnp.array`` of a host
+  array inside a ``for``/``while`` body of a hot serving function when
+  nothing in the loop writes that array: every round pays a
+  host→device upload for bytes identical to last round's.  The correct
+  shape is upload-once (hoist, or cache a device mirror invalidated on
+  writes — the engine's ``_device_inputs`` discipline); a re-upload
+  after an in-loop write to the source array is exempt.
 """
 
 from __future__ import annotations
@@ -317,6 +324,76 @@ def _host_sync_violations(mod, qual: str, fn: ast.AST) -> List[Violation]:
     return out
 
 
+def _mutated_roots(loop: ast.AST) -> Set[str]:
+    """Dotted names a loop body writes: plain/aug/ann assignments, and
+    subscript stores attributed to their base (``self._pos[slot] = x``
+    mutates ``self._pos``).  A loop's own iteration targets count too."""
+    mutated: Set[str] = set()
+
+    def add_target(t: ast.AST) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                add_target(e)
+        elif isinstance(t, ast.Starred):
+            add_target(t.value)
+        elif isinstance(t, ast.Subscript):
+            name = dotted(t.value)
+            if name:
+                mutated.add(name)
+        else:
+            name = dotted(t)
+            if name:
+                mutated.add(name)
+
+    if isinstance(loop, ast.For):
+        add_target(loop.target)
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                add_target(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            add_target(node.target)
+    return mutated
+
+
+def _reupload_violations(mod, qual: str, fn: ast.AST) -> List[Violation]:
+    if not mod.path.startswith(_HOT_PATH_PREFIX):
+        return []
+    leaf = qual.rsplit(".", 1)[-1]
+    if not _HOT_FUNC_RE.search(leaf):
+        return []
+    out: List[Violation] = []
+    for loop in ast.walk(fn):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        mutated = _mutated_roots(loop)
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = dotted(node.func)
+            parts = name.split(".") if name else []
+            if len(parts) < 2 or parts[-1] not in ("asarray", "array") \
+                    or parts[0] in ("np", "numpy"):
+                continue
+            src = dotted(node.args[0])
+            if not src:
+                continue   # computed expression: freshness unknowable
+            # the chain or any prefix written in-loop ⇒ a legitimate
+            # rebuild of a dirtied mirror, not a blind re-upload
+            chain = src.split(".")
+            prefixes = {".".join(chain[:i + 1])
+                        for i in range(len(chain))}
+            if prefixes & mutated:
+                continue
+            out.append(Violation(
+                "jax-reupload-hot-loop", mod.path, node.lineno,
+                f"{name}({src}) inside a per-round loop of hot "
+                f"function {leaf}() re-uploads a host array nothing in "
+                f"the loop changes — hoist the upload or cache a "
+                f"device mirror invalidated on writes", qual))
+    return out
+
+
 def run(index: ProjectIndex) -> List[Violation]:
     out: List[Violation] = []
     seen: Set[Tuple[str, int, str]] = set()
@@ -328,6 +405,7 @@ def run(index: ProjectIndex) -> List[Violation]:
             if isinstance(fn, ast.FunctionDef):
                 out.extend(_traced_if_violations(mod, qual, fn))
             out.extend(_host_sync_violations(mod, qual, fn))
+            out.extend(_reupload_violations(mod, qual, fn))
     # nested walks can revisit the same call site via enclosing scopes;
     # a (path, line, rule) key dedups without losing distinct findings
     deduped: List[Violation] = []

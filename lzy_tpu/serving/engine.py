@@ -120,6 +120,29 @@ _PREFILL_ROUNDS = REGISTRY.counter(
     "lzy_inference_prefill_rounds_total",
     "bounded prefill rounds run between decode steps (chunked prefill)")
 
+# decode-round scheduling (docs/serving.md "Decode-round scheduling"):
+# each round dispatches ONE fused device program and takes ONE
+# device->host fence — the contract the transfer-count regression test
+# pins. Phase timers cover the round's anatomy: ``plan`` (host work
+# before the dispatch), ``overlap`` (host work run while the device
+# computes), ``fence`` (the single blocking transfer), ``emit`` (token
+# delivery + batched accounting after the fence).
+_ROUND_PHASE = REGISTRY.histogram(
+    "lzy_engine_round_phase_seconds",
+    "decode-round phase wall time (phase=plan|overlap|fence|emit)",
+    buckets=(0.0001, 0.0005, 0.001, 0.005, 0.02, 0.05, 0.25, 1.0))
+_ROUND_FENCES = REGISTRY.counter(
+    "lzy_engine_round_fences_total",
+    "device-to-host fences taken by decode rounds (contract: exactly "
+    "one per round)")
+_ROUNDS = REGISTRY.counter(
+    "lzy_engine_rounds_total",
+    "decode scheduling rounds by kind (kind=decode|verify)")
+_OVERLAP_COMMITS = REGISTRY.counter(
+    "lzy_engine_admission_plan_total",
+    "admission plans computed in the overlap window, by outcome "
+    "(outcome=committed|stale|empty)")
+
 
 @dataclasses.dataclass
 class _PrefillJob:
@@ -267,10 +290,44 @@ class InferenceEngine:
         if self.spec_tokens > 0:
             self._proposer = proposer if proposer is not None else \
                 NgramProposer(max_ngram=spec_ngram, gamma=self.spec_tokens)
-        # per-slot incremental lookup state (NgramIndex) — built at a
-        # row's first proposal, extended by the tokens emitted since, so
-        # drafting is O(suffix occurrences), not O(history), per round
+        # per-slot incremental lookup state (NgramIndex) — BUILT in the
+        # overlap window of the round after a row activates (the O(history)
+        # build is proposer bookkeeping, not critical-path work; a row's
+        # first round simply proposes nothing, which can change which
+        # rounds speculate but never what they emit), then extended by the
+        # tokens emitted since, so drafting is O(suffix occurrences), not
+        # O(history), per round
         self._spec_index: List[Optional[Any]] = [None] * slots
+
+        self._active: List[Optional[Request]] = [None] * slots
+        self._cur = np.zeros((slots,), np.int32)   # last token per slot
+        # host mirror of each slot's cache index (tokens resident in the
+        # row's KV cache); what speculation rolls back to after rejection
+        self._pos = np.zeros((slots,), np.int64)
+        # device-resident mirrors of the per-round jit inputs, uploaded
+        # once and reused until a host-side mutation invalidates them
+        # (None = stale). ``_cur_dev``/``_pos_dev`` are normally the
+        # PREVIOUS step's own outputs — the device keeps its own state
+        # between rounds and the host uploads nothing; only admission
+        # (``_finish_prefill``) forces a re-upload. Idle rows drift in
+        # the device copies (stale token/position garbage) — harmless by
+        # construction: rows are independent, idle writes land on masked
+        # positions (dense) or the scratch block (paged), and idle
+        # outputs are never read.
+        self._cur_dev: Any = None        # [slots] int32 last tokens
+        self._pos_dev: Any = None        # [slots] int32 cache positions
+        self._mask_dev: Any = None       # [slots] bool greedy mask
+        # device->host fences taken by decode rounds — public so the
+        # transfer-count regression test can pin the one-fence contract
+        self.host_fetches = 0
+        # admission plan computed in the overlap window (while the device
+        # runs): (queue.version, free slot, candidate-or-None); committed
+        # by the next round's _admit iff the queue did not move
+        self._admission_plan: Any = None
+        # per-round token accounting, flushed ONCE per round (metric
+        # counters take a lock per inc — per-token increments were
+        # measurable host overhead in the decode hot loop)
+        self._round_tokens: dict = {}
 
         self._build_decode_path(base)
 
@@ -293,21 +350,9 @@ class InferenceEngine:
 
         self.queue = RequestQueue(max_queue, policies=tenants,
                                   clock=self._clock)
-        self._active: List[Optional[Request]] = [None] * slots
-        self._cur = np.zeros((slots,), np.int32)   # last token per slot
-        # host mirror of each slot's cache index (tokens resident in the
-        # row's KV cache); what speculation rolls back to after rejection
-        self._pos = np.zeros((slots,), np.int64)
         self._finished = 0
         self._cancelled = 0
         self._tokens_out = 0
-        # True while the cache's per-layer index leaves may share ONE
-        # device buffer (a jitted step's outputs can be CSE'd together,
-        # and eager constant paths may intern equal arrays); a donating
-        # call must not see the same buffer twice, so verify rounds
-        # re-materialize the leaves first when set. Conservative: set by
-        # everything that touches the cache, cleared only by the rebuild.
-        self._index_aliased = True
         # speculation + throughput accounting (public: the gateway fleet
         # aggregates these across replicas, banking them on retirement)
         self.spec_proposed = 0
@@ -336,8 +381,8 @@ class InferenceEngine:
         slots = self.slots
         # decode model: [slots] per-row cache positions
         self._model = Llama(dataclasses.replace(base, decode_slot_index=True))
-        self._cache = init_cache(lambda: self._model.init(
-            jax.random.PRNGKey(0), jnp.zeros((slots, 1), jnp.int32)))
+        self._adopt_cache(init_cache(lambda: self._model.init(
+            jax.random.PRNGKey(0), jnp.zeros((slots, 1), jnp.int32))))
         # prefill model: batch-1, scalar index (what batched_prefill writes)
         self._prefill_model = Llama(base)
         self._prefill_step = make_prefill_step(self._prefill_model)
@@ -348,31 +393,143 @@ class InferenceEngine:
                 jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32))
         )["cache"]
 
-        def decode_step(cache, params, tokens, greedy_mask, rng):
+        # the jitted steps take the PAYLOAD leaves plus an explicit
+        # [slots] position vector and assemble the per-layer index leaves
+        # inside the trace (see _adopt_cache): only payload is donated,
+        # only payload (plus ONE advanced position vector) comes back, so
+        # the aliasing class that used to force per-round index rebuilds
+        # cannot exist — there is nothing to alias
+        def decode_step(payload, params, cur, pos, greedy_mask, rng):
+            cache = self._assemble_cache(payload, pos)
             logits, updated = self._model.apply(
-                {"params": params, "cache": cache}, tokens, mutable=["cache"]
-            )
+                {"params": params, "cache": cache}, cur[:, None],
+                mutable=["cache"])
             nxt, rng = self._pick_next(logits[:, -1], greedy_mask, rng)
-            return updated["cache"], nxt, rng
+            payload, new_pos = self._split_cache(updated["cache"])
+            return payload, new_pos, nxt, rng
 
         self._decode_step = jax.jit(decode_step, donate_argnums=(0,))
 
-        def verify_step(cache, params, tokens, greedy_mask, rng):
-            # speculative verify: ``tokens`` is [B, gamma+1] = the last
-            # emitted token plus each row's (padded) proposal. ONE chunked
-            # decode forward writes all positions into the cache and
-            # returns logits for all of them; argmax over every position
-            # is the acceptance reference, while sampled rows draw their
-            # single token from position 0 — the same logits (and the
-            # same one rng split) a 1-token step would have used
+        def verify_step(payload, params, cur, prop, prop_len, pos,
+                        greedy_mask, rng):
+            # speculative verify: the forward scores [B, gamma+1] = the
+            # last emitted token plus each row's (padded) proposal. ONE
+            # chunked decode forward writes all positions into the cache
+            # and returns logits for all of them; argmax over every
+            # position is the acceptance reference, while sampled rows
+            # draw their single token from position 0 — the same logits
+            # (and the same one rng split) a 1-token step would have
+            # used. Acceptance itself is computed HERE, on device
+            # (_accept): the round's only host transfer is the packed
+            # [B, gamma+2] emit matrix it returns.
+            cache = self._assemble_cache(payload, pos)
+            toks = jnp.concatenate([cur[:, None], prop], axis=1)
             logits, updated = self._model.apply(
-                {"params": params, "cache": cache}, tokens, mutable=["cache"]
-            )
+                {"params": params, "cache": cache}, toks, mutable=["cache"])
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             nxt, rng = self._pick_next(logits[:, 0], greedy_mask, rng)
-            return updated["cache"], greedy, nxt, rng
+            payload, _ = self._split_cache(updated["cache"])
+            packed, new_cur, new_pos = self._accept(prop, prop_len, greedy,
+                                                    nxt, pos)
+            return payload, packed, new_cur, new_pos, rng
 
         self._verify_step = jax.jit(verify_step, donate_argnums=(0,))
+
+    # -- cache payload/treedef split ---------------------------------------
+
+    def _adopt_cache(self, tree) -> None:
+        """Split the freshly built cache tree into PAYLOAD leaves (k/v —
+        whatever the model owns) and the per-layer ``index`` leaves. The
+        index leaves all mirror one [slots] position vector, so the
+        engine keeps exactly one (``_pos`` on the host, ``_pos_dev`` on
+        the device) and re-broadcasts it into the tree at every use: a
+        jitted step whose outputs were CSE'd into a shared index buffer
+        can no longer poison the next donation, because index leaves are
+        never round-tripped through a step at all."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        self._cache_treedef = treedef
+        self._leaf_is_index = [self._is_index(p) for p, _ in flat]
+        self._payload = [leaf for (p, leaf), idx
+                         in zip(flat, self._leaf_is_index) if not idx]
+
+    def _assemble_cache(self, payload, index_leaf):
+        """Full cache tree from payload leaves + ONE index value placed
+        at every index leaf (traced inside jit; eager callers must pass
+        distinct buffers per leaf if the result will be donated)."""
+        leaves, it = [], iter(payload)
+        for idx in self._leaf_is_index:
+            leaves.append(index_leaf if idx else next(it))
+        return jax.tree_util.tree_unflatten(self._cache_treedef, leaves)
+
+    def _split_cache(self, tree):
+        """Inverse of :meth:`_assemble_cache`: payload leaves plus the
+        FIRST index leaf (the model advances every layer's index
+        identically, so one survives as the step's new position)."""
+        leaves = jax.tree_util.tree_leaves(tree)
+        payload = [leaf for leaf, idx in zip(leaves, self._leaf_is_index)
+                   if not idx]
+        new_pos = next(leaf for leaf, idx
+                       in zip(leaves, self._leaf_is_index) if idx)
+        return payload, new_pos
+
+    @property
+    def _cache(self):
+        """The full cache tree, index leaves materialized from the host
+        positions — the compatibility surface for everything OFF the
+        decode hot path (prefill splices, KV export/import, tier
+        demotion/promotion). Each index leaf is a fresh device buffer
+        (``jnp.array`` copies), so a consumer that donates the result
+        can never hand one buffer in twice."""
+        vals = np.asarray(self._pos, np.int32)
+        leaves, it = [], iter(self._payload)
+        for idx in self._leaf_is_index:
+            leaves.append(jnp.array(vals) if idx else next(it))
+        return jax.tree_util.tree_unflatten(self._cache_treedef, leaves)
+
+    @_cache.setter
+    def _cache(self, tree) -> None:
+        """Adopt a consumer's updated tree: payload leaves are kept,
+        index leaves are DISCARDED — ``_pos`` (host) is the single
+        source of truth for positions, so a setter cannot desync them."""
+        leaves = jax.tree_util.tree_leaves(tree)
+        self._payload = [leaf for leaf, idx
+                         in zip(leaves, self._leaf_is_index) if not idx]
+
+    def _accept(self, prop, prop_len, greedy, nxt, pos):
+        """On-device speculative acceptance (traced inside verify_step).
+
+        Per row: the longest proposal prefix matching the model's own
+        argmax (``m``), the accepted tokens plus the bonus token after
+        them for speculating rows, or the single position-0 pick for
+        sampled/no-draft rows — bit-identical to the host loop it
+        replaces (``m`` via cumprod-of-matches is exactly the while-loop
+        prefix walk). Returns ``(packed [B, gamma+2], new_cur [B],
+        new_pos [B])`` where ``packed[:, :gamma+1]`` are emit tokens,
+        ``packed[:, gamma+1]`` the per-row emit count — ONE array, ONE
+        host transfer for the whole round."""
+        width = prop.shape[1] + 1            # gamma + 1
+        cols = jnp.arange(width - 1, dtype=jnp.int32)
+        ok = (prop == greedy[:, :-1]) & (cols[None, :] < prop_len[:, None])
+        m = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+        spec = prop_len > 0                  # rows with a live draft
+        bonus = jnp.take_along_axis(greedy, m[:, None], axis=1)[:, 0]
+        allc = jnp.arange(width, dtype=jnp.int32)
+        prop_w = jnp.pad(prop, ((0, 0), (0, 1)))
+        emit = jnp.where(allc[None, :] < m[:, None], prop_w,
+                         jnp.where(allc[None, :] == m[:, None],
+                                   bonus[:, None], 0))
+        # non-speculating rows emit exactly the position-0 pick (sampled
+        # rows keep their draw; greedy no-draft rows get argmax — which
+        # equals the m=0 bonus, so the where is a no-op for them)
+        emit = emit.at[:, 0].set(jnp.where(spec, emit[:, 0], nxt))
+        count = jnp.where(spec, m + 1, 1).astype(jnp.int32)
+        new_cur = jnp.take_along_axis(emit, (count - 1)[:, None],
+                                      axis=1)[:, 0]
+        packed = jnp.concatenate([emit, count[:, None]], axis=1)
+        # rows advance by exactly what they emit — the rollback the host
+        # used to do by rewriting index leaves after the fact is now the
+        # step's own output, exact by construction
+        return packed, new_cur, pos + count
 
     # -- sampling helpers --------------------------------------------------
 
@@ -592,7 +749,61 @@ class InferenceEngine:
                 return slot
         return None
 
+    def _try_stage(self, slot: int, req: Request) -> bool:
+        """Pop one admitted candidate and stage its prefill; a
+        request-scoped staging failure finishes the request in place.
+        True iff a prefill job was staged."""
+        self.queue.pop_request(req)
+        req.phase = "prefill"
+        try:
+            job = self._stage_prefill(slot, req)
+        except PoolCorruption:
+            raise        # engine-fatal: the shared pool was donated
+        except Exception as e:  # noqa: BLE001 — request-scoped
+            _LOG.warning("prefill staging failed for %s: %s", req.id, e)
+            _REQUESTS.inc(status="error")
+            TENANT_REQUESTS.inc(tenant=req.tenant, status="error")
+            self._tenant_count(req.tenant, "requests_error")
+            req.finish(error=f"{type(e).__name__}: {e}")
+            return False
+        self._prefill_jobs.append(job)
+        return True
+
+    def _commit_admission_plan(self) -> Optional[bool]:
+        """Commit the admission choice precomputed in the previous
+        round's overlap window (:meth:`_plan_admission`). Returns the
+        round's admission outcome, or None to fall back to the full
+        scan: the plan only commits when the queue version is untouched
+        AND the non-queue admission state (the slot, the resource
+        verdict, the candidate's liveness) re-verifies."""
+        plan, self._admission_plan = self._admission_plan, None
+        if plan is None:
+            return None
+        version, slot, choice = plan
+        if version != self.queue.version:
+            _OVERLAP_COMMITS.inc(outcome="stale")
+            return None
+        if choice is None:
+            # the overlap-window scan already ran against this exact
+            # queue state and found nothing admissible — skip the rescan
+            _OVERLAP_COMMITS.inc(outcome="empty")
+            return False
+        reserved = {job.slot for job in self._prefill_jobs}
+        if (self._active[slot] is not None or slot in reserved
+                or choice.reapable
+                or self._admit_verdict(choice) != "admit"):
+            # admission state moved without a queue mutation (deadline
+            # passed, block pool shrank): replan from scratch
+            _OVERLAP_COMMITS.inc(outcome="stale")
+            return None
+        _OVERLAP_COMMITS.inc(outcome="committed")
+        return True if self._try_stage(slot, choice) else None
+
     def _admit(self) -> bool:
+        fast = self._commit_admission_plan()
+        if fast is not None:
+            _BUSY.set(float(sum(r is not None for r in self._active)))
+            return fast
         admitted = False
         while True:
             slot = self._free_slot()
@@ -610,23 +821,10 @@ class InferenceEngine:
                     continue
                 if verdict == "wait":
                     break
-                self.queue.pop_request(req)
-                req.phase = "prefill"
-                try:
-                    job = self._stage_prefill(slot, req)
-                except PoolCorruption:
-                    raise    # engine-fatal: the shared pool was donated
-                except Exception as e:  # noqa: BLE001 — request-scoped
-                    _LOG.warning("prefill staging failed for %s: %s",
-                                 req.id, e)
-                    _REQUESTS.inc(status="error")
-                    TENANT_REQUESTS.inc(tenant=req.tenant, status="error")
-                    self._tenant_count(req.tenant, "requests_error")
-                    req.finish(error=f"{type(e).__name__}: {e}")
+                if self._try_stage(slot, req):
+                    admitted = True
+                else:
                     rescan = True
-                    break
-                self._prefill_jobs.append(job)
-                admitted = True
                 break
             if rescan:
                 continue
@@ -768,29 +966,124 @@ class InferenceEngine:
         # the prompt is now cache-resident; the first generated token is
         # not (the next decode step writes it at this position)
         self._pos[slot] = len(req.prompt)
-        self._index_aliased = True      # splice touched the index leaves
         self._emit(slot, req, first, active=False)
         if req.done:
             self._free(slot)      # one-token request: slot never activates
         else:
             self._active[slot] = req
             self._cur[slot] = first
+        # admission changed the live row set: the device-resident round
+        # inputs must be rebuilt from the host mirrors (the ONLY event
+        # that forces a re-upload — frees leave harmless idle-row
+        # garbage in place instead)
+        self._cur_dev = None
+        self._pos_dev = None
+        self._mask_dev = None
+        self._flush_token_accounting()
+
+    def _fetch(self, arr) -> np.ndarray:
+        """THE round fence: the one device→host transfer a decode round
+        is allowed. Counted (``host_fetches``) so the transfer-count
+        regression test can pin the contract at exactly one per round."""
+        self.host_fetches += 1
+        _ROUND_FENCES.inc()
+        return np.asarray(arr)
+
+    def _device_inputs(self):
+        """The per-round jit inputs, device-resident across rounds.
+        ``_cur_dev``/``_pos_dev`` are normally the previous step's own
+        outputs (nothing uploaded); after an admission they are rebuilt
+        from the host mirrors. ``jnp.array`` (an explicit copy), never
+        ``jnp.asarray``: asarray zero-copies the live numpy buffer, and
+        ``_emit``'s later host writes would mutate the device view."""
+        if self._cur_dev is None:
+            self._cur_dev = jnp.array(self._cur)
+        if self._pos_dev is None:
+            self._pos_dev = jnp.array(np.asarray(self._pos, np.int32))
+        if self._mask_dev is None:
+            self._mask_dev = jnp.array(self._greedy_mask())
+        return self._cur_dev, self._pos_dev, self._mask_dev
+
+    def _overlap_window(self) -> None:
+        """Host work run BETWEEN the round's dispatch and its fence —
+        while the device computes, for free on the wall clock: the next
+        round's admission plan and deferred proposer index builds."""
+        self._plan_admission()
+        self._drain_side_work()
+
+    def _plan_admission(self) -> None:
+        """Precompute the next round's admission choice (WFQ candidate
+        scan + resource verdict) and stamp it with the queue version;
+        ``_admit`` commits it next round iff the queue has not moved
+        since (any submit/pop/reap bumps the version)."""
+        slot = self._free_slot()
+        if slot is None:
+            self._admission_plan = None
+            return
+        version = self.queue.version
+        choice = None
+        for req in self.queue.candidates():
+            if req.reapable:
+                # reaping mutates terminal state — not overlap-safe;
+                # leave it for the next round's full scan
+                self._admission_plan = None
+                return
+            verdict = self._admit_verdict(req)
+            if verdict == "skip":
+                continue
+            if verdict == "admit":
+                choice = req
+            break
+        self._admission_plan = (version, slot, choice)
+
+    def _drain_side_work(self) -> None:
+        """Deferred proposer bookkeeping: build the per-slot NgramIndex
+        for rows that activated since the last round. O(history) per new
+        row — exactly the work that used to run on the critical path
+        before the dispatch; proposals never change emitted tokens (only
+        which rounds get to speculate), so deferral is output-invisible."""
+        if self._proposer is None:
+            return
+        index_fn = getattr(self._proposer, "index", None)
+        if index_fn is None:
+            return
+        for slot, req in enumerate(self._active):
+            if req is None or not self._row_greedy(req):
+                continue
+            if self._spec_index[slot] is None:
+                self._spec_index[slot] = index_fn(req.prompt + req.tokens)
+
+    def _flush_token_accounting(self) -> None:
+        """Batched per-round metric flush: one counter inc per tenant
+        per round instead of three lock acquisitions per TOKEN."""
+        if not self._round_tokens:
+            return
+        pending, self._round_tokens = self._round_tokens, {}
+        total = 0
+        for tenant, n in pending.items():
+            total += n
+            TENANT_TOKENS.inc(n, tenant=tenant)
+            self._tenant_count(tenant, "tokens_generated", n)
+        _TOKENS.inc(total)
 
     def _decode(self) -> bool:
         if not any(r is not None for r in self._active):
             return False
+        t_plan = self._clock.now()
         if not self._pre_decode():
             return False
         plan = self._spec_plan()
         if plan is not None:
-            return self._decode_verify(plan)
+            return self._decode_verify(plan, t_plan)
         t0 = self._clock.now()
-        tokens = jnp.asarray(self._cur[:, None])
-        mask = jnp.asarray(self._greedy_mask())
-        self._cache, nxt, self._rng = self._run_decode_step(tokens, mask)
-        self._index_aliased = True
-        nxt = np.asarray(nxt)        # one host transfer for the whole batch
-        dt = self._clock.now() - t0
+        (self._payload, self._pos_dev, self._cur_dev,
+         self._rng) = self._run_decode_step()
+        t1 = self._clock.now()
+        self._overlap_window()
+        t2 = self._clock.now()
+        nxt = self._fetch(self._cur_dev)   # the round's ONE fence
+        t3 = self._clock.now()
+        dt = t3 - t0
         _STEP.observe(dt)
         self._post_decode_step()
         emitted = rows = 0
@@ -802,6 +1095,8 @@ class InferenceEngine:
             rows += 1
         self._note_decode_round(emitted, rows, dt)
         _BUSY.set(float(sum(r is not None for r in self._active)))
+        self._note_round_phases("decode", t0 - t_plan, t2 - t1, t3 - t2,
+                                self._clock.now() - t3)
         return True
 
     # -- speculative decode (serving/spec.py) ------------------------------
@@ -843,74 +1138,73 @@ class InferenceEngine:
             return self._proposer.propose(hist)
         idx = self._spec_index[slot]
         if idx is None or len(idx) > len(hist):
-            idx = self._spec_index[slot] = index_fn(hist)
-        elif len(idx) < len(hist):
+            # no index yet (or a stale one): the O(history) build is
+            # overlap-window work (_drain_side_work), never plan-phase
+            # work — this round simply doesn't speculate for the row.
+            # Output-invisible: proposals only change which rounds get
+            # to speculate, never which tokens come out
+            self._spec_index[slot] = None
+            return []
+        if len(idx) < len(hist):
             idx.extend(hist[len(idx):])
         return idx.propose()
 
-    def _decode_verify(self, plan: dict) -> bool:
-        """One speculative round: a single ``[slots, gamma+1]`` verify
-        forward (last emitted token + each row's padded proposal), accept
-        per row the longest proposal prefix equal to the model's own
-        argmax plus the bonus token after it, then roll the cache back
-        over the rejected tail. Greedy rows emit 1..gamma+1 tokens;
+    def _decode_verify(self, plan: dict, t_plan: float) -> bool:
+        """One speculative round: a single fused verify program scores
+        ``[slots, gamma+1]`` positions (last emitted token + each row's
+        padded proposal), computes acceptance ON DEVICE (:meth:`_accept`)
+        and returns one packed ``[slots, gamma+2]`` emit matrix — the
+        round's only host transfer. Greedy rows emit 1..gamma+1 tokens;
         sampled/no-draft rows emit exactly one, drawn from the same
         position-0 logits (and the same single rng split) a plain step
-        would have produced."""
+        would have produced. The cache index comes back already rolled
+        over the rejected tail (``new_pos = pos + count``) — K/V written
+        at rejected positions stays in place as garbage beyond the
+        rewound index, invisible to every mask and overwritten before it
+        could surface."""
         t0 = self._clock.now()
-        width = self.spec_tokens + 1
-        toks = np.zeros((self.slots, width), np.int32)
-        toks[:, 0] = self._cur
+        gamma = self.spec_tokens
+        prop = np.zeros((self.slots, gamma), np.int32)
+        plen = np.zeros((self.slots,), np.int32)
         for slot, p in plan.items():
-            toks[slot, 1:1 + len(p)] = p
-        # re-materialize the index leaves before donating if a previous
-        # step's executable may have CSE'd the per-layer index outputs
-        # into ONE buffer — donating an aliased buffer twice into a
-        # different executable is rejected. Values are unchanged for
-        # active rows (_pos mirrors the device index); idle rows reset
-        # to 0, which stops their harmless drift.
-        if self._index_aliased:
-            self._rollback_indices()
-        mask = jnp.asarray(self._greedy_mask())
-        self._cache, greedy_all, nxt, self._rng = self._run_verify_step(
-            jnp.asarray(toks), mask)
-        self._index_aliased = True
-        greedy_all, nxt = jax.device_get((greedy_all, nxt))
-        dt = self._clock.now() - t0
+            prop[slot, :len(p)] = p
+            plen[slot] = len(p)
+        (self._payload, packed, self._cur_dev, self._pos_dev,
+         self._rng) = self._run_verify_step(jnp.asarray(prop),
+                                            jnp.asarray(plen))
+        t1 = self._clock.now()
+        self._overlap_window()
+        t2 = self._clock.now()
+        packed = self._fetch(packed)       # the round's ONE fence
+        t3 = self._clock.now()
+        dt = t3 - t0
         _STEP.observe(dt)
 
+        # unpack per-row emit lists from the packed matrix (host-side
+        # indexing only — no further device traffic)
         emit: dict = {}
+        prop_total = acc_total = 0
         for slot, req in enumerate(self._active):
             if req is None:
                 continue
+            n = int(packed[slot, gamma + 1])
+            emit[slot] = [int(t) for t in packed[slot, :n]]
             p = plan.get(slot)
-            if p and self._row_greedy(req):
-                m = 0
-                while m < len(p) and p[m] == int(greedy_all[slot, m]):
-                    m += 1
-                # accepted proposals plus the model's own next token
-                # after them (the "bonus": with m == 0 this is exactly
-                # the token a plain step would have emitted)
-                emit[slot] = list(p[:m]) + [int(greedy_all[slot, m])]
+            if p is not None:
                 self.spec_proposed += len(p)
-                self.spec_accepted += m
-                _SPEC_PROPOSED.inc(len(p))
-                _SPEC_ACCEPTED.inc(m)
-            else:
-                emit[slot] = [int(nxt[slot])]
+                self.spec_accepted += n - 1
+                prop_total += len(p)
+                acc_total += n - 1
+        if prop_total:
+            _SPEC_PROPOSED.inc(prop_total)
+        if acc_total:
+            _SPEC_ACCEPTED.inc(acc_total)
 
-        # roll back BEFORE emitting: the jitted step advanced every row's
-        # cache index by the full width; the true index is the old one
-        # plus the tokens actually entering the cache (accepted + the
-        # last-emitted token the step wrote at position 0). _free (via
-        # _emit on EOS/limit) then resets freed rows on top of this.
-        # A round where EVERY active row fully accepted needs no rewind
-        # (the device index already equals _pos; idle-row drift is
-        # harmless) — the common case on high-acceptance streams.
+        # advance positions BEFORE emitting: _free (via _emit on
+        # EOS/limit) resets freed rows on top of this, and the paged
+        # engine's rollback hook releases blocks past the new lengths
         for slot in emit:
             self._pos[slot] += len(emit[slot])
-        if any(len(emit[slot]) != width for slot in emit):
-            self._rollback_indices()
         self._post_verify_rollback()
 
         emitted = rows = 0
@@ -929,32 +1223,28 @@ class InferenceEngine:
         _SPEC_STEPS.inc()
         self._note_decode_round(emitted, rows, dt)
         _BUSY.set(float(sum(r is not None for r in self._active)))
+        self._note_round_phases("verify", t0 - t_plan, t2 - t1, t3 - t2,
+                                self._clock.now() - t3)
         return True
 
-    def _rollback_indices(self) -> None:
-        """Write the host-side per-row positions back into every cache
-        ``index`` leaf (host→device of a few ``[slots]`` int32 arrays —
-        noise next to the forward). K/V written at rejected positions
-        stays in place as garbage: it sits beyond the rewound index, so
-        no mask ever exposes it and later writes overwrite it before it
-        could become visible."""
-        vals = np.asarray(self._pos, np.int32)
-        # one fresh device buffer PER leaf — and an explicit COPY:
-        # ``jnp.asarray`` zero-copies the SAME numpy memory into every
-        # conversion (identical buffer pointers), and a donating step
-        # handed the same buffer twice corrupts memory or dies with
-        # "donate the same buffer twice" depending on timing
-        self._cache = jax.tree_util.tree_map_with_path(
-            lambda path, leaf: jnp.array(vals)
-            if self._is_index(path) else leaf,
-            self._cache)
-        self._index_aliased = False
+    def _note_round_phases(self, kind: str, plan_dt: float,
+                           overlap_dt: float, fence_dt: float,
+                           emit_dt: float) -> None:
+        """Round anatomy telemetry, observed AFTER the fence (the device
+        is already idle — these lock-taking observes never sit between
+        dispatch and transfer)."""
+        _ROUNDS.inc(kind=kind)
+        _ROUND_PHASE.observe(plan_dt, phase="plan")
+        _ROUND_PHASE.observe(overlap_dt, phase="overlap")
+        _ROUND_PHASE.observe(fence_dt, phase="fence")
+        _ROUND_PHASE.observe(emit_dt, phase="emit")
 
     def _post_verify_rollback(self) -> None:
         """Hook after the index rewind; the paged engine releases growth
         blocks that became wholly rejected."""
 
     def _note_decode_round(self, emitted: int, rows: int, dt: float) -> None:
+        self._flush_token_accounting()
         self.decode_steps += 1
         self.decode_rows += rows
         self.decode_tokens += emitted
@@ -974,13 +1264,15 @@ class InferenceEngine:
         """Pre-step resource work; False aborts the round (nothing left)."""
         return True
 
-    def _run_decode_step(self, tokens, greedy_mask):
-        return self._decode_step(self._cache, self.params, tokens,
-                                 greedy_mask, self._rng)
+    def _run_decode_step(self):
+        cur, pos, mask = self._device_inputs()
+        return self._decode_step(self._payload, self.params, cur, pos,
+                                 mask, self._rng)
 
-    def _run_verify_step(self, tokens, greedy_mask):
-        return self._verify_step(self._cache, self.params, tokens,
-                                 greedy_mask, self._rng)
+    def _run_verify_step(self, prop, prop_len):
+        cur, pos, mask = self._device_inputs()
+        return self._verify_step(self._payload, self.params, cur, prop,
+                                 prop_len, pos, mask, self._rng)
 
     def _post_decode_step(self) -> None:
         """Bookkeeping between the device step and token emission: the
@@ -1007,9 +1299,10 @@ class InferenceEngine:
                                req.id)
                 req.token_sink = None
         self._tokens_out += 1
-        _TOKENS.inc()
-        TENANT_TOKENS.inc(tenant=req.tenant)
-        self._tenant_count(req.tenant, "tokens_generated")
+        # metric counters are flushed once per round (side-queue
+        # accounting — see _flush_token_accounting), not per token
+        self._round_tokens[req.tenant] = \
+            self._round_tokens.get(req.tenant, 0) + 1
         hit_eos = self.eos_token is not None and token == self.eos_token
         if hit_eos or len(req.tokens) >= req.max_new_tokens:
             self._finished += 1
@@ -1025,17 +1318,17 @@ class InferenceEngine:
             self._cur[slot] = token
 
     def _free(self, slot: int) -> None:
+        """Host-mirror reset only: the freed row's DEVICE state (token,
+        position, greedy-mask bit) is left stale on purpose — idle rows
+        are garbage-tolerant (writes land on masked positions / the
+        scratch block, outputs are never read), and the re-admission
+        that makes the slot matter again rebuilds all three mirrors
+        (``_finish_prefill``). The next insertion overwrites the cache
+        rows wholesale."""
         self._active[slot] = None
         self._cur[slot] = 0
         self._pos[slot] = 0
         self._spec_index[slot] = None
-        # rewind the freed row's position: an idle slot must not keep
-        # attending over (or writing past) a dead request's cache, and the
-        # next insertion overwrites the rows wholesale anyway
-        self._cache = jax.tree_util.tree_map(
-            lambda leaf: leaf.at[slot].set(0) if leaf.ndim == 1 else leaf,
-            self._cache)
-        self._index_aliased = True
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -1049,22 +1342,24 @@ class InferenceEngine:
         in-process HLO-keyed compilation cache (and the persistent one
         serve.py enables) then makes the first real call's "compile" a
         lookup."""
-        sds = jax.tree_util.tree_map(
-            lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype),
-            self._cache)
+        payload = [jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+                   for leaf in self._payload]
+        vec = jax.ShapeDtypeStruct((self.slots,), jnp.int32)
         mask = jax.ShapeDtypeStruct((self.slots,), jnp.bool_)
         rng = jax.ShapeDtypeStruct(self._rng.shape, self._rng.dtype)
-        self._warm_compile(
-            self._decode_step, sds,
-            jax.ShapeDtypeStruct((self.slots, 1), jnp.int32), mask, rng)
+        self._warm_compile(self._decode_step, payload, (vec, vec),
+                           mask, rng)
         if self.spec_tokens > 0:
-            self._warm_compile(
-                self._verify_step, sds,
-                jax.ShapeDtypeStruct((self.slots, self.spec_tokens + 1),
-                                     jnp.int32), mask, rng)
+            prop = jax.ShapeDtypeStruct((self.slots, self.spec_tokens),
+                                        jnp.int32)
+            self._warm_compile(self._verify_step, payload,
+                               (vec, prop, vec, vec), mask, rng)
 
-    def _warm_compile(self, step, cache, tokens, mask, rng):
-        step.lower(cache, self.params, tokens, mask, rng).compile()
+    def _warm_compile(self, step, payload, mids, mask, rng):
+        """``mids`` are the step-specific args between ``params`` and the
+        greedy mask: ``(cur, pos)`` for decode, ``(cur, prop, prop_len,
+        pos)`` for verify (the paged engine inserts the page table)."""
+        step.lower(payload, self.params, *mids, mask, rng).compile()
 
     @property
     def closed(self) -> bool:
@@ -1371,6 +1666,10 @@ class PagedInferenceEngine(InferenceEngine):
         # page tables: [slots, pages_per_seq] block ids (0 = scratch pad);
         # _slot_blocks mirrors the allocated prefix of each row in python
         self._tables = np.zeros((slots, self._pages_per_seq), np.int32)
+        # device mirror of _tables, uploaded once and reused until a
+        # table write dirties it (upload-once discipline — see
+        # _page_table_dev); every _tables mutation site sets it to None
+        self._pt_dev = None
         self._slot_blocks: List[List[int]] = [[] for _ in range(slots)]
         # per-row cached-token counts live in the base engine's _pos
         self._admit_seq = np.zeros((slots,), np.int64)  # admission order
@@ -1389,9 +1688,9 @@ class PagedInferenceEngine(InferenceEngine):
         slots, pages = self.slots, self._pages_per_seq
         self._model = Llama(pcfg)
         dummy_pt = jnp.zeros((slots, pages), jnp.int32)
-        self._cache = init_cache(lambda: self._model.init(
+        self._adopt_cache(init_cache(lambda: self._model.init(
             jax.random.PRNGKey(0), jnp.zeros((slots, 1), jnp.int32),
-            page_table=dummy_pt))
+            page_table=dummy_pt)))
         # prefill reuses the SAME pool arrays with a batch-1 index; only
         # the index leaves differ between the two cache trees
         self._prefill_model = Llama(pcfg)
@@ -1409,28 +1708,36 @@ class PagedInferenceEngine(InferenceEngine):
 
         self._prefill_step = prefill_step
 
-        def decode_step(cache, params, tokens, page_table, greedy_mask, rng):
+        def decode_step(payload, params, cur, pos, page_table,
+                        greedy_mask, rng):
+            cache = self._assemble_cache(payload, pos)
             logits, updated = self._model.apply(
-                {"params": params, "cache": cache}, tokens,
+                {"params": params, "cache": cache}, cur[:, None],
                 page_table=page_table, mutable=["cache"])
             nxt, rng = self._pick_next(logits[:, -1], greedy_mask, rng)
-            return updated["cache"], nxt, rng
+            payload, new_pos = self._split_cache(updated["cache"])
+            return payload, new_pos, nxt, rng
 
         self._decode_step = jax.jit(decode_step, donate_argnums=(0,))
 
-        def verify_step(cache, params, tokens, page_table, greedy_mask,
-                        rng):
+        def verify_step(payload, params, cur, prop, prop_len, pos,
+                        page_table, greedy_mask, rng):
             # paged twin of the dense verify: the [B, gamma+1] chunk
             # scatters through the page table (positions past a row's
             # allocated blocks land on the scratch page — garbage nobody
             # can accept) and the gather-back keeps the score/mask path
             # literally the dense one, so acceptance is bit-identical
+            cache = self._assemble_cache(payload, pos)
+            toks = jnp.concatenate([cur[:, None], prop], axis=1)
             logits, updated = self._model.apply(
-                {"params": params, "cache": cache}, tokens,
+                {"params": params, "cache": cache}, toks,
                 page_table=page_table, mutable=["cache"])
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             nxt, rng = self._pick_next(logits[:, 0], greedy_mask, rng)
-            return updated["cache"], greedy, nxt, rng
+            payload, _ = self._split_cache(updated["cache"])
+            packed, new_cur, new_pos = self._accept(prop, prop_len,
+                                                    greedy, nxt, pos)
+            return payload, packed, new_cur, new_pos, rng
 
         self._verify_step = jax.jit(verify_step, donate_argnums=(0,))
 
@@ -1449,12 +1756,12 @@ class PagedInferenceEngine(InferenceEngine):
     def _merge_prefill(self, pre_cache, slot: int, length: int) -> None:
         """Fold a finished prefill back into the decode tree: pool k/v
         leaves are taken from the prefill output (the decode tree's were
-        donated), the slot's index row is set to the true prompt length
-        (rewinding any padded-chunk advance)."""
-        self._cache = jax.tree_util.tree_map_with_path(
-            lambda path, dec, pre: dec.at[slot].set(length)
-            if self._is_index(path) else pre,
-            self._cache, pre_cache)
+        donated). Index state needs no splice — the ``_cache`` setter
+        discards the prefill tree's batch-1 index leaves and the host
+        ``_pos`` mirror (set by ``_finish_prefill``; 0 while the job is
+        mid-flight) is the single source of truth for positions."""
+        del slot, length
+        self._cache = pre_cache
 
     # -- admission / prefill -------------------------------------------------
 
@@ -1613,7 +1920,6 @@ class PagedInferenceEngine(InferenceEngine):
                 job, cache, job.tokens_dev, run_chunk)
             if not finished:
                 self._merge_prefill(cache, job.slot, 0)
-                self._index_aliased = True
                 return False
             first, self._rng = self._pick_first(job.last, req)
             self._merge_prefill(cache, job.slot, t0)
@@ -1631,6 +1937,7 @@ class PagedInferenceEngine(InferenceEngine):
             self.kv.insert(req.prompt[:n_full * self._page], table[:n_full])
         self._tables[slot, :len(table)] = table
         self._tables[slot, len(table):] = 0
+        self._pt_dev = None
         self._slot_blocks[slot] = list(table)
         self._admissions += 1
         self._admit_seq[slot] = self._admissions
@@ -2066,6 +2373,7 @@ class PagedInferenceEngine(InferenceEngine):
                     continue
                 self._slot_blocks[slot].append(block)
                 self._tables[slot, len(self._slot_blocks[slot]) - 1] = block
+                self._pt_dev = None
 
     def _preempt_youngest(self) -> int:
         """Fail the most recently admitted active request (its waiter gets
@@ -2088,22 +2396,34 @@ class PagedInferenceEngine(InferenceEngine):
         # False when the squeeze preempted everyone
         return any(r is not None for r in self._active)
 
-    def _run_decode_step(self, tokens, greedy_mask):
-        pt = jnp.asarray(self._tables)
-        self._dispatches.inc(path=self.kernel_path)
-        return self._decode_step(self._cache, self.params, tokens, pt,
-                                 greedy_mask, self._rng)
+    def _page_table_dev(self):
+        """Device mirror of ``_tables``, uploaded once and reused until
+        a table mutation dirties it — the per-round ``jnp.asarray`` of
+        an unchanged page table was a textbook re-upload hot loop.
+        ``jnp.array`` (explicit copy): asarray would zero-copy the live
+        ``_tables`` buffer and later host writes would mutate the
+        device view mid-flight."""
+        if self._pt_dev is None:
+            self._pt_dev = jnp.array(self._tables)
+        return self._pt_dev
 
-    def _run_verify_step(self, tokens, greedy_mask):
-        pt = jnp.asarray(self._tables)
+    def _run_decode_step(self):
+        cur, pos, mask = self._device_inputs()
         self._dispatches.inc(path=self.kernel_path)
-        return self._verify_step(self._cache, self.params, tokens, pt,
-                                 greedy_mask, self._rng)
+        return self._decode_step(self._payload, self.params, cur, pos,
+                                 self._page_table_dev(), mask, self._rng)
 
-    def _warm_compile(self, step, cache, tokens, mask, rng):
+    def _run_verify_step(self, prop, prop_len):
+        cur, pos, mask = self._device_inputs()
+        self._dispatches.inc(path=self.kernel_path)
+        return self._verify_step(self._payload, self.params, cur, prop,
+                                 prop_len, pos, self._page_table_dev(),
+                                 mask, self._rng)
+
+    def _warm_compile(self, step, payload, mids, mask, rng):
         pt = jax.ShapeDtypeStruct((self.slots, self._pages_per_seq),
                                   jnp.int32)
-        step.lower(cache, self.params, tokens, pt, mask, rng).compile()
+        step.lower(payload, self.params, *mids, pt, mask, rng).compile()
 
     # -- speculative decode over the block pool -------------------------------
 
@@ -2154,6 +2474,7 @@ class PagedInferenceEngine(InferenceEngine):
                 break
             self._slot_blocks[slot].append(block)
             self._tables[slot, len(self._slot_blocks[slot]) - 1] = block
+            self._pt_dev = None
         covered = len(self._slot_blocks[slot]) * page
         return min(want, max(0, covered - pos - 1))
 
@@ -2180,6 +2501,7 @@ class PagedInferenceEngine(InferenceEngine):
                 tail = blocks[keep:]
                 del blocks[keep:]
                 self._tables[slot, keep:] = 0
+                self._pt_dev = None
                 self.kv.release(tail)
 
     def _free(self, slot: int) -> None:
@@ -2187,6 +2509,7 @@ class PagedInferenceEngine(InferenceEngine):
         blocks = self._slot_blocks[slot]
         self._slot_blocks[slot] = []
         self._tables[slot, :] = 0
+        self._pt_dev = None
         self._admit_seq[slot] = 0
         self.kv.release(blocks)
 

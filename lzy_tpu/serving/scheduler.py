@@ -337,6 +337,13 @@ class RequestQueue:
         self._seq = 0
         self._depth = 0
         self._head: Optional[Request] = None     # pinned by peek()
+        #: monotonic queue-mutation counter: bumped (under the lock) by
+        #: every membership change — submit, any removal, drain. The
+        #: engine stamps its overlap-window admission plan with this and
+        #: only commits the plan if the version is untouched, so a plan
+        #: computed while the device ran can never act on a queue that
+        #: moved underneath it.
+        self.version = 0
         self._lock = threading.Lock()
         # drain-rate estimate for the retry-after hint: EWMA of the
         # interval between pops (i.e. seconds per admitted request)
@@ -414,6 +421,7 @@ class RequestQueue:
             request._queued = True
             self._subq.setdefault(tenant, deque()).append(request)
             self._depth += 1
+            self.version += 1
             _QUEUE_DEPTH.set(float(self._depth))
             _TENANT_QUEUE.set(float(len(self._subq[tenant])), tenant=tenant)
         self.work_available.set()
@@ -443,6 +451,7 @@ class RequestQueue:
                 return
         req._queued = False
         self._depth -= 1
+        self.version += 1
         _TENANT_QUEUE.set(float(len(q)), tenant=req.tenant)
         if not q:
             del self._subq[req.tenant]
@@ -560,6 +569,7 @@ class RequestQueue:
             self._subq.clear()
             self._depth = 0
             self._head = None
+            self.version += 1
             _QUEUE_DEPTH.set(0.0)
         return out
 
