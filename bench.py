@@ -505,6 +505,10 @@ def run() -> None:
     if extra:
         detail.update(extra)
         emit()
+    extra = sharded_decode_measurement()
+    if extra:
+        detail.update(extra)
+        emit()
     if platform in ("tpu", "axon"):
         # each extra pass builds a whole second model+optimizer: evict the
         # previous one (buffers AND compiled executables) first or OOM
@@ -877,6 +881,172 @@ def paged_decode_measurement(jax, cfg, params, *, batch_size: int,
     except Exception as e:  # noqa: BLE001 — diagnostics only
         _log(f"paged decode skipped: {type(e).__name__}: {e}")
         return {}
+
+
+def sharded_decode_measurement():
+    """Best-effort gang-serving point: decode throughput of 1×2 and 1×4
+    CPU-mesh ``ShardedPagedInferenceEngine`` gangs next to a single-device
+    ``PagedInferenceEngine`` baseline, with mesh shape and per-shard KV
+    occupancy in the row. Runs in a CHILD process because the meshes need
+    ``--xla_force_host_platform_device_count`` set before backend init —
+    the parent's device topology (and every other probe's numbers) stays
+    untouched. The child pins ``JAX_PLATFORMS=cpu`` even on TPU rounds:
+    this row is a partitioning/scheduling-overhead trajectory riding the
+    CPU-fallback round, never a chip number."""
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8"
+                            ).strip()
+        _log("sharded decode: spawning 8-device cpu child...")
+        proc = subprocess.run(
+            [sys.executable, __file__, "--sharded-probe"],
+            stdout=subprocess.PIPE, timeout=480, env=env)
+        for line in reversed(
+                proc.stdout.decode("utf-8", "replace").splitlines()):
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if "error" in obj:
+                _log(f"sharded decode skipped: {obj['error']}")
+                return {}
+            return obj
+        _log(f"sharded decode skipped: no result line "
+             f"(rc={proc.returncode})")
+        return {}
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        _log(f"sharded decode skipped: {type(e).__name__}: {e}")
+        return {}
+
+
+def sharded_probe_child() -> None:
+    """Child half of ``sharded_decode_measurement`` (``--sharded-probe``):
+    drives the SAME request set through a single-device paged engine and
+    1×2 / 1×4 gangs, asserts the 1×2 stream is bit-identical to the
+    baseline (the gang contract, re-proven every bench round), and prints
+    one JSON row. The 1×4 gang needs ``n_kv_heads % 4 == 0``, which the
+    tiny config fails — it runs on a widened config with fresh params
+    against its OWN widened baseline, so its ratio is apples-to-apples
+    even though its absolute number is not comparable to the 1×2 one.
+
+    Compute dtype is pinned to float32: the gang's bit-identity is exact
+    in f32 (no contraction dim ever shards), but under bf16 compute the
+    partitioned program's different XLA fusion boundaries round
+    intermediates at different points — 1-ULP logit noise that can flip
+    argmax on near-tie prompts and would make this row's identity check
+    flaky. f32 keeps the assertion a hard invariant round over round."""
+    _apply_platform_contract()
+    try:
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        from lzy_tpu.models import llama, unbox
+        from lzy_tpu.models.llama import LlamaConfig
+        from lzy_tpu.serving import PagedInferenceEngine
+        from lzy_tpu.serving.sharded import ShardedPagedInferenceEngine
+
+        n_dev = len(jax.devices())
+        if n_dev < 4:
+            raise RuntimeError(
+                f"need >= 4 devices for a 1x4 gang, have {n_dev}")
+        slots, page_size, prompt_len, new_tokens = 4, 16, 32, 32
+        prompts = [[3 + i, 5, 7, 11 + i] * (prompt_len // 4)
+                   for i in range(slots)]
+
+        def build_params(cfg):
+            boxed, _ = llama.init_params(cfg, jax.random.PRNGKey(0))
+            return unbox(boxed)
+
+        def drive(make):
+            eng = make()
+            try:
+                # two warm requests: fresh-input then committed-layout
+                # compile, same reasoning as the spec probe
+                for i in (7, 9):
+                    warm = eng.submit([3, 5 + i] * (prompt_len // 2),
+                                      max_new_tokens=2)
+                    while not warm.done:
+                        eng.step()
+                reqs = [eng.submit(p, max_new_tokens=new_tokens)
+                        for p in prompts]
+                occ = None
+                t0 = time.perf_counter()
+                while not all(r.done for r in reqs):
+                    eng.step()
+                    if hasattr(eng, "shard_occupancy"):
+                        cur = eng.shard_occupancy()
+                        # keep the hottest mid-flight snapshot: occupancy
+                        # at peak residency, not after frees
+                        if occ is None or sum(cur) > sum(occ):
+                            occ = cur
+                dt = time.perf_counter() - t0
+                total = sum(len(r.tokens) for r in reqs)
+                toks = [list(r.tokens) for r in reqs]
+            finally:
+                eng.close()
+            return total / dt, occ, toks
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(vocab_size=2048),
+                                  dtype=jnp.float32)
+        params = build_params(cfg)
+        _log("sharded decode: single-device baseline...")
+        base_tps, _, base_toks = drive(lambda: PagedInferenceEngine(
+            cfg, params, slots=slots, page_size=page_size,
+            max_queue=2 * slots + 2))
+        _log(f"sharded decode: baseline {base_tps:.1f} tok/s; 1x2 gang...")
+        tps2, occ2, toks2 = drive(lambda: ShardedPagedInferenceEngine(
+            cfg, params, tp=2, slots=slots, page_size=page_size,
+            max_queue=2 * slots + 2))
+        if toks2 != base_toks:
+            raise AssertionError(
+                "1x2 gang stream diverged from the single-device engine "
+                "(bit-identity contract broken)")
+        _log(f"sharded decode: 1x2 {tps2:.1f} tok/s, per-shard KV {occ2}")
+        out = {
+            "sharded_decode_tokens_per_s": round(tps2, 1),
+            "sharded_decode_mesh": "1x2",
+            "sharded_decode_shard_kv_blocks": occ2,
+            "sharded_decode_baseline_tokens_per_s": round(base_tps, 1),
+            "sharded_decode_vs_single": round(tps2 / base_tps, 3),
+            "sharded_decode_bit_identical": True,
+            "sharded_decode_dtype": "float32",
+        }
+    except Exception as e:  # noqa: BLE001 — reported to the parent
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}), flush=True)
+        os._exit(1)
+    try:
+        # 1x4 rider: widened config (tiny n_kv_heads=2 fails the tp=4
+        # divisibility gate); a failure here must not lose the 1x2 row
+        wcfg = dataclasses.replace(cfg, n_kv_heads=4)
+        wparams = build_params(wcfg)
+        _log("sharded decode: widened 1x4 pair...")
+        wbase_tps, _, wbase_toks = drive(lambda: PagedInferenceEngine(
+            wcfg, wparams, slots=slots, page_size=page_size,
+            max_queue=2 * slots + 2))
+        tps4, occ4, toks4 = drive(lambda: ShardedPagedInferenceEngine(
+            wcfg, wparams, tp=4, slots=slots, page_size=page_size,
+            max_queue=2 * slots + 2))
+        if toks4 != wbase_toks:
+            raise AssertionError(
+                "1x4 gang stream diverged from its widened baseline")
+        _log(f"sharded decode: 1x4 {tps4:.1f} tok/s, per-shard KV {occ4}")
+        out.update({
+            "sharded_decode_1x4_tokens_per_s": round(tps4, 1),
+            "sharded_decode_1x4_mesh": "1x4",
+            "sharded_decode_1x4_shard_kv_blocks": occ4,
+            "sharded_decode_1x4_vs_single": round(tps4 / wbase_tps, 3),
+            "sharded_decode_1x4_widened_kv_heads": wcfg.n_kv_heads,
+        })
+    except Exception as e:  # noqa: BLE001 — rider is optional
+        _log(f"sharded decode 1x4 skipped: {type(e).__name__}: {e}")
+        out["sharded_decode_1x4_skipped"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out), flush=True)
+    # hard-exit like probe(): a hung backend teardown must not eat the
+    # parent's window
+    os._exit(0)
 
 
 def _sim_spec_tokens_per_step(proposer, prompt, cont):
@@ -1921,5 +2091,7 @@ if __name__ == "__main__":
         run()
     elif "--probe" in sys.argv:
         probe()
+    elif "--sharded-probe" in sys.argv:
+        sharded_probe_child()
     else:
         supervise()

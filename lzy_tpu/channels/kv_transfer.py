@@ -30,7 +30,7 @@ import dataclasses
 import io
 import json
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -53,12 +53,32 @@ class KVBlockExport:
     leaf's block rows ``[n_blocks, page_size, kv_heads, head_dim]`` in
     prefix order. Block *ids* never travel: they are pool-local, and the
     importer allocates its own.
+
+    Exports from a SHARDED pool (``serving/sharded``) additionally carry
+    ``mesh_shape`` (the pool's logical mesh, e.g. ``(1, 2)``) and
+    ``shard_axes`` (leaf key → the axis the pool shards that leaf on —
+    the kv_heads axis). In memory the leaves are always the FULL logical
+    arrays (``export_kv``'s gather assembles them regardless of
+    placement); the shard metadata is what the spill path uses to write
+    per-shard blobs and what the import gate checks fail-closed against
+    the importing pool's own mesh shape.
     """
 
     tokens: List[int]
     page_size: int
     leaves: Dict[str, np.ndarray]
     prefilled_by: Optional[str] = None
+    mesh_shape: Optional[Tuple[int, ...]] = None
+    shard_axes: Optional[Dict[str, int]] = None
+
+    @property
+    def n_shards(self) -> int:
+        if not self.mesh_shape:
+            return 1
+        n = 1
+        for d in self.mesh_shape:
+            n *= int(d)
+        return n
 
     @property
     def n_blocks(self) -> int:
@@ -70,22 +90,38 @@ class KVBlockExport:
 
 
 def build_kv_manifest(export: KVBlockExport,
-                      leaf_uris: Dict[str, str]) -> bytes:
+                      leaf_uris: Dict[str, object]) -> bytes:
     """The manifest document: token prefix + per-leaf uri/dtype/shape.
     Shard uris are absolute (sharded_spill convention) so any consumer
-    can fetch with just this document."""
+    can fetch with just this document.
+
+    A sharded export's leaf entry replaces the single ``uri`` with a
+    ``shards`` list (``[{"uri", "shard"}, ...]`` in shard order) plus
+    the ``shard_axis`` the blobs split on; ``shape`` stays the FULL
+    logical shape and the mesh shape is recorded top-level. Both forms
+    are version 1 — the shard fields are optional, so unsharded
+    manifests are unchanged bytes and old readers keep working."""
+    leaves = {}
+    for key, arr in export.leaves.items():
+        meta = {"dtype": str(arr.dtype), "shape": list(arr.shape)}
+        uri = leaf_uris[key]
+        if isinstance(uri, (list, tuple)):
+            axis = (export.shard_axes or {}).get(key)
+            meta["shard_axis"] = int(axis) if axis is not None else None
+            meta["shards"] = [{"uri": u, "shard": i}
+                              for i, u in enumerate(uri)]
+        else:
+            meta["uri"] = uri
+        leaves[key] = meta
     doc = {
         **_MAGIC,
         "page_size": export.page_size,
         "tokens": [int(t) for t in export.tokens],
         "prefilled_by": export.prefilled_by,
-        "leaves": {
-            key: {"uri": leaf_uris[key],
-                  "dtype": str(arr.dtype),
-                  "shape": list(arr.shape)}
-            for key, arr in export.leaves.items()
-        },
+        "leaves": leaves,
     }
+    if export.mesh_shape is not None:
+        doc["mesh_shape"] = [int(d) for d in export.mesh_shape]
     return json.dumps(doc).encode("utf-8")
 
 
@@ -118,16 +154,37 @@ def spill_kv_export(storage, base_uri: str, export: KVBlockExport) -> str:
 
     ser = JaxArraySerializer()
     keys = sorted(export.leaves)
-    uris = {key: join_uri(base_uri + ".kv", _leaf_key_to_name(i))
-            for i, key in enumerate(keys)}
+    n_shards = export.n_shards
+    shard_axes = export.shard_axes or {}
+    uris: Dict[str, object] = {}
+    jobs = []   # (uri, array-piece) upload units
+    for i, key in enumerate(keys):
+        arr = export.leaves[key]
+        name = _leaf_key_to_name(i)
+        axis = shard_axes.get(key)
+        if n_shards > 1 and axis is not None:
+            # per-shard blobs: each piece is the contiguous slice one
+            # shard of the pool holds along its sharded (kv_heads)
+            # axis — a future device-local export/import can move one
+            # shard's piece without ever assembling the logical array
+            pieces = np.split(arr, n_shards, axis=axis)
+            shard_uris = [join_uri(base_uri + ".kv", f"{name}_shard{s}")
+                          for s in range(n_shards)]
+            uris[key] = shard_uris
+            jobs.extend(zip(shard_uris, pieces))
+        else:
+            uri = join_uri(base_uri + ".kv", name)
+            uris[key] = uri
+            jobs.append((uri, arr))
 
-    def put(key: str) -> None:
+    def put(job) -> None:
+        uri, arr = job
         buf = io.BytesIO()
-        ser.serialize(export.leaves[key], buf)
-        upload_bytes(storage, uris[key], buf.getvalue())
+        ser.serialize(arr, buf)
+        upload_bytes(storage, uri, buf.getvalue())
 
-    with _futures.ThreadPoolExecutor(min(8, max(1, len(keys)))) as pool:
-        list(pool.map(put, keys))
+    with _futures.ThreadPoolExecutor(min(8, max(1, len(jobs)))) as pool:
+        list(pool.map(put, jobs))
     storage.write_bytes(base_uri, build_kv_manifest(export, uris))
     return base_uri
 
@@ -142,13 +199,25 @@ def fetch_kv_export(storage, manifest_uri: str) -> KVBlockExport:
     ser = JaxArraySerializer()
     doc = parse_kv_manifest(storage.read_bytes(manifest_uri))
 
-    def get(item):
-        key, meta = item
-        src = storage.open_read(meta["uri"])
+    def read_one(uri):
+        src = storage.open_read(uri)
         try:
-            arr = np.asarray(ser.deserialize(src))
+            return np.asarray(ser.deserialize(src))
         finally:
             src.close()
+
+    def get(item):
+        key, meta = item
+        if "shards" in meta:
+            # per-shard blobs reassemble by concatenation along the
+            # recorded axis — byte-exact inverse of the np.split spill
+            # (shard order is explicit in the entries, not the listing)
+            pieces = [None] * len(meta["shards"])
+            for entry in meta["shards"]:
+                pieces[int(entry["shard"])] = read_one(entry["uri"])
+            arr = np.concatenate(pieces, axis=int(meta["shard_axis"]))
+        else:
+            arr = read_one(meta["uri"])
         if list(arr.shape) != list(meta["shape"]):
             raise ValueError(
                 f"kv leaf {key} shape {list(arr.shape)} != manifest "
@@ -160,11 +229,17 @@ def fetch_kv_export(storage, manifest_uri: str) -> KVBlockExport:
     with _futures.ThreadPoolExecutor(min(8, max(1, len(items)))) as pool:
         for key, arr in pool.map(get, items):
             leaves[key] = arr
+    mesh_shape = doc.get("mesh_shape")
+    shard_axes = {key: int(meta["shard_axis"])
+                  for key, meta in doc["leaves"].items()
+                  if meta.get("shard_axis") is not None}
     return KVBlockExport(
         tokens=[int(t) for t in doc["tokens"]],
         page_size=int(doc["page_size"]),
         leaves=leaves,
         prefilled_by=doc.get("prefilled_by"),
+        mesh_shape=tuple(mesh_shape) if mesh_shape else None,
+        shard_axes=shard_axes or None,
     )
 
 
@@ -267,10 +342,13 @@ class StorageKVTransport:
             pass
         if doc:
             for meta in doc["leaves"].values():
-                try:
-                    self._storage.delete(meta["uri"])
-                except Exception:  # noqa: BLE001 — best-effort cleanup
-                    pass
+                leaf_uris = ([e["uri"] for e in meta["shards"]]
+                             if "shards" in meta else [meta["uri"]])
+                for uri in leaf_uris:
+                    try:
+                        self._storage.delete(uri)
+                    except Exception:  # noqa: BLE001 — best-effort cleanup
+                        pass
         try:
             self._storage.delete(ref)
         except Exception:  # noqa: BLE001 — best-effort cleanup

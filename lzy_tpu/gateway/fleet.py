@@ -282,7 +282,12 @@ class ReplicaFleet:
             hb = None
             if replica.leased and self._allocator is not None:
                 try:
-                    hb = self._allocator.vm(replica.vm_ids[0]).heartbeat_ts
+                    # the gang is one replica: its effective heartbeat is
+                    # the STALEST host's — any one host going quiet (or
+                    # vanishing) fails over the whole gang, never a
+                    # partial shard set
+                    hb = min(self._allocator.vm(v).heartbeat_ts
+                             for v in replica.vm_ids)
                 except KeyError:
                     dead.append((replica, "lease vanished"))
                     continue
@@ -358,6 +363,13 @@ class ReplicaFleet:
                 pass
         self.health.forget(replica.id)
         _RETIRED.inc(cause=cause)
+        if cause == "failed" and (len(replica.vm_ids) > 1 or
+                                  getattr(replica.engine, "gang_size", 1) > 1):
+            # a failure-retired gang replica is a whole-gang failover —
+            # lazy import: fleet must not pull serving.sharded (and its
+            # model stack) in at module load
+            from lzy_tpu.serving.sharded.metrics import GANG_FAILOVERS
+            GANG_FAILOVERS.inc()
         self._update_gauges()
 
     def close(self) -> None:

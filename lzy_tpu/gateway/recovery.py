@@ -186,7 +186,13 @@ def recover_gateway(
     boot path snapshots the PREDECESSOR's rows before building its own
     fleet (whose ``add_replica`` overwrites the colliding
     ``replica-1..N`` keys) and passes the snapshot here, so stale gangs
-    are still found and released."""
+    are still found and released.
+
+    Gang leases re-adopt ALL-OR-NOTHING: every journaled vm of the
+    lease must still be RUNNING and a sharded engine must report
+    ``gang_intact`` — one unreachable host (or dead shard) drops the
+    whole gang (lease freed, KV index rows forgotten). A partial shard
+    set is never adopted; the gang's SPMD programs span every shard."""
     journal: Optional[GatewayJournal] = gw.journal
     if journal is None:
         raise ValueError("recover_gateway needs a gateway built with a "
@@ -255,6 +261,12 @@ def recover_gateway(
             continue
         engine = engine_source(rid, vm_ids) if engine_source else None
         ok = engine is not None and not getattr(engine, "closed", False)
+        if ok and not getattr(engine, "gang_intact", True):
+            # sharded gang with a dead shard host: all-or-nothing —
+            # a partial shard set can never serve (the SPMD programs
+            # span every shard), so the whole gang is dropped below
+            # (lease freed, KV index rows forgotten), never adopted
+            ok = False
         if ok and allocator is not None and vm_ids:
             from lzy_tpu.service.allocator import RUNNING
 

@@ -430,7 +430,15 @@ class Attention(nn.Module):
                 out = paged_attention(
                     q, cache_k.value, cache_v.value, page_table, pos,
                     kernel=cfg.paged_kernel, dtype=cfg.dtype, quant=kvq)
-                return self._o_proj(out.reshape(b, t, h * d))
+                # gather head-sharded attention output BEFORE o_proj: the
+                # merged head dim is o_proj's contraction dim, and letting
+                # the partitioner keep it sharded would psum partial
+                # matmul products (a float reduction-order change — the
+                # sharded engine's bit-identity contract forbids it)
+                out = _anchor(out.reshape(b, t, h * d), self.anchor_mesh,
+                              "batch", "seq", "act_attn_out",
+                              rules=self.rules)
+                return self._o_proj(out)
             # legacy path: gather the row's blocks back into position
             # order: [B, P, page, KV, D] → [B, L, KV, D] — the dense
             # layout, so everything below is literally the dense code
@@ -471,7 +479,12 @@ class Attention(nn.Module):
         s = jnp.where(visible, s, -1e30)
         p = jax.nn.softmax(s, axis=-1).astype(cfg.dtype)
         out = jnp.einsum("bkgtl,blkd->btkgd", p, vals)
-        return self._o_proj(out.reshape(b, t, h * d))
+        # same contraction-dim gather as the native path above: replicate
+        # the merged head dim before o_proj so no psum-of-partials ever
+        # enters the decode forward
+        out = _anchor(out.reshape(b, t, h * d), self.anchor_mesh,
+                      "batch", "seq", "act_attn_out", rules=self.rules)
+        return self._o_proj(out)
 
 
 class Mlp(nn.Module):

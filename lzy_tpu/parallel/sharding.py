@@ -40,6 +40,11 @@ DEFAULT_RULES: Rules = {
     "act_vocab": "tp",
     "act_mlp": "tp",
     "act_heads": "tp",
+    # merged attention output entering o_proj: replicated by default so a
+    # head-sharded decode forward all-gathers BEFORE the o_proj matmul —
+    # sharding the contraction dim would make GSPMD psum partial products
+    # and break bit-identity with the single-device engine
+    "act_attn_out": None,
     "stage": "pp",
     # conv models
     "conv_spatial": None,

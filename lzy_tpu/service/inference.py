@@ -299,6 +299,7 @@ def build_gateway_service(
     kernel: str = "auto",
     kv_host_tier_bytes: Optional[int] = None,
     kv_storage_tier=None,
+    serve_mesh: Optional[int] = None,
     kv_global_index: Optional[bool] = None,
     routing: str = "prefix",
     allocator=None,
@@ -341,6 +342,13 @@ def build_gateway_service(
     fence advances and replica leases are journaled so a successor
     process restores them (``serve.py --gateway-journal``;
     docs/serving.md "Control-plane recovery").
+
+    ``serve_mesh`` (``--serve-mesh N``) makes every replica a GANG: a
+    ``ShardedPagedInferenceEngine`` running the forwards tensor-sharded
+    over a 1×N mesh (requires ``paged=True``; output stays bit-identical
+    to single-device — docs/serving.md "Sharded replicas"). Health and
+    recovery treat the gang as one replica: one dead host fails over the
+    whole gang.
     """
     from lzy_tpu.gateway import (
         Autoscaler, GatewayService, PrefixAffinityRouter, ReplicaFleet,
@@ -356,6 +364,9 @@ def build_gateway_service(
                       kv_pool_bytes=kv_pool_bytes,
                       kv_host_tier_bytes=kv_host_tier_bytes,
                       kv_storage_tier=kv_storage_tier)
+    if serve_mesh is not None and not paged:
+        raise ValueError("serve_mesh (sharded gang replicas) requires "
+                         "paged=True — the sharded engine is paged-only")
     cfg, params = _build_engine_parts(model, checkpoint=checkpoint,
                                       seed=seed)
     common = dict(slots=slots, max_queue=max_queue, eos_token=eos_token,
@@ -365,14 +376,19 @@ def build_gateway_service(
     storage_tier = _build_kv_storage_tier(kv_storage_tier, page_size)
 
     def engine_factory():
-        if paged:
-            engine = PagedInferenceEngine(
-                cfg, params, page_size=page_size, kv_blocks=kv_blocks,
-                kv_pool_bytes=kv_pool_bytes, kv_quant=kv_quant,
-                native_attention=native_attention, kernel=kernel,
-                kv_host_tier_bytes=kv_host_tier_bytes,
-                kv_storage_tier=storage_tier,
-                **common)
+        paged_kw = dict(
+            page_size=page_size, kv_blocks=kv_blocks,
+            kv_pool_bytes=kv_pool_bytes, kv_quant=kv_quant,
+            native_attention=native_attention, kernel=kernel,
+            kv_host_tier_bytes=kv_host_tier_bytes,
+            kv_storage_tier=storage_tier)
+        if serve_mesh is not None:
+            from lzy_tpu.serving.sharded import ShardedPagedInferenceEngine
+
+            engine = ShardedPagedInferenceEngine(
+                cfg, params, tp=serve_mesh, **paged_kw, **common)
+        elif paged:
+            engine = PagedInferenceEngine(cfg, params, **paged_kw, **common)
         else:
             engine = InferenceEngine(cfg, params, **common)
         if warm_start:
@@ -600,6 +616,7 @@ def build_inference_service(
     kernel: str = "auto",
     kv_host_tier_bytes: Optional[int] = None,
     kv_storage_tier=None,
+    serve_mesh: Optional[int] = None,
     spec_tokens: int = 0,
     warm_start: bool = False,
     start: bool = True,
@@ -646,21 +663,28 @@ def build_inference_service(
                       kv_pool_bytes=kv_pool_bytes,
                       kv_host_tier_bytes=kv_host_tier_bytes,
                       kv_storage_tier=kv_storage_tier)
+    if serve_mesh is not None and not paged:
+        raise ValueError("serve_mesh (sharded gang replicas) requires "
+                         "paged=True — the sharded engine is paged-only")
     cfg, params = _build_engine_parts(model, checkpoint=checkpoint,
                                       seed=seed)
     common = dict(slots=slots, max_queue=max_queue, eos_token=eos_token,
                   prefill_chunk=prefill_chunk, seed=seed,
                   spec_tokens=spec_tokens, prefill_budget=prefill_budget,
                   tenants=tenants)
-    if paged:
-        engine: InferenceEngine = PagedInferenceEngine(
-            cfg, params, page_size=page_size, kv_blocks=kv_blocks,
-            kv_pool_bytes=kv_pool_bytes, kv_quant=kv_quant,
-            native_attention=native_attention, kernel=kernel,
-            kv_host_tier_bytes=kv_host_tier_bytes,
-            kv_storage_tier=_build_kv_storage_tier(kv_storage_tier,
-                                                   page_size),
-            **common)
+    paged_kw = dict(
+        page_size=page_size, kv_blocks=kv_blocks,
+        kv_pool_bytes=kv_pool_bytes, kv_quant=kv_quant,
+        native_attention=native_attention, kernel=kernel,
+        kv_host_tier_bytes=kv_host_tier_bytes,
+        kv_storage_tier=_build_kv_storage_tier(kv_storage_tier, page_size))
+    if serve_mesh is not None:
+        from lzy_tpu.serving.sharded import ShardedPagedInferenceEngine
+
+        engine: InferenceEngine = ShardedPagedInferenceEngine(
+            cfg, params, tp=serve_mesh, **paged_kw, **common)
+    elif paged:
+        engine = PagedInferenceEngine(cfg, params, **paged_kw, **common)
     else:
         engine = InferenceEngine(cfg, params, **common)
     if warm_start:

@@ -140,6 +140,17 @@ def main(argv=None) -> int:
                              "cross-replica cache warm-up after "
                              "autoscale/failover is a storage read, not "
                              "a re-prefill")
+    parser.add_argument("--serve-mesh", type=int, default=None,
+                        metavar="N",
+                        help="serve every replica as a GANG: the "
+                             "prefill/decode/verify forwards run "
+                             "tensor-sharded over a 1xN device mesh "
+                             "(requires --serve-paged; composes with "
+                             "--gateway — health/recovery treat the "
+                             "gang as one replica, one dead host fails "
+                             "over the whole gang). Output is "
+                             "bit-identical to single-device serving "
+                             "(docs/serving.md 'Sharded replicas')")
     parser.add_argument("--serve-native-attention", action="store_true",
                         help="native paged-attention read path under "
                              "--serve-paged: attention reads K/V through "
@@ -303,6 +314,19 @@ def main(argv=None) -> int:
     if args.serve_kv_pool_mb is not None and args.serve_kv_blocks is not None:
         parser.error("pass --serve-kv-blocks or --serve-kv-pool-mb, "
                      "not both")
+    if args.serve_mesh is not None:
+        if args.serve_mesh < 2:
+            parser.error("--serve-mesh needs N >= 2 (a 1-device mesh is "
+                         "just --serve-paged)")
+        if not args.serve_paged:
+            parser.error("--serve-mesh requires --serve-paged (the "
+                         "sharded engine serves from the paged pool)")
+        if args.disagg:
+            parser.error("--serve-mesh does not compose with --disagg "
+                         "yet; use --gateway")
+        if args.serve_kernel == "pallas":
+            parser.error("--serve-kernel pallas cannot serve sharded "
+                         "(custom calls do not partition); use lax")
 
     warm_start = bool(args.serve_model) and not args.no_warm_start
     spec_tokens = args.spec_tokens if args.serve_spec else 0
@@ -414,6 +438,7 @@ def main(argv=None) -> int:
                 kernel=args.serve_kernel,
                 kv_host_tier_bytes=kv_host_tier_bytes,
                 kv_storage_tier=args.kv_storage_tier,
+                serve_mesh=args.serve_mesh,
                 routing=args.gateway_routing,
                 allocator=cluster.allocator,
                 pool_label=args.gateway_pool,
@@ -441,6 +466,7 @@ def main(argv=None) -> int:
             kernel=args.serve_kernel,
             kv_host_tier_bytes=kv_host_tier_bytes,
             kv_storage_tier=args.kv_storage_tier,
+            serve_mesh=args.serve_mesh,
             spec_tokens=spec_tokens,
             warm_start=warm_start,
             prefill_budget=prefill_budget,

@@ -71,10 +71,21 @@ def export_kv(engine, tokens: Sequence[int], *,
         for path, leaf in flat:
             if _is_index(path):
                 continue
+            # on a sharded pool leaf[ids] gathers the FULL logical rows
+            # (the host read assembles every shard) — the export is
+            # always logical; shard structure rides as metadata only
             leaves[jax.tree_util.keystr(path)] = np.asarray(leaf[ids])
         if on_pinned is not None:
             on_pinned()
-        return KVBlockExport(tokens=prefix, page_size=page, leaves=leaves)
+        mesh_shape = getattr(engine, "kv_mesh_shape", None)
+        shard_axes = None
+        if mesh_shape is not None:
+            # every payload leaf shards on its kv_heads axis: axis 2 of
+            # the pool leaf == axis 2 of the gathered block rows
+            # [n_blocks, page, kv_heads(, head_dim)]
+            shard_axes = {key: 2 for key in leaves}
+        return KVBlockExport(tokens=prefix, page_size=page, leaves=leaves,
+                             mesh_shape=mesh_shape, shard_axes=shard_axes)
     finally:
         engine.kv.release(blocks)
 
@@ -119,6 +130,21 @@ def import_kv(engine, export: KVBlockExport) -> int:
         # CODES into a pool that reads them as KV VALUES — garbage
         # served with no error anywhere. Mismatched kv_quant between
         # disagg pools therefore fails closed here (local re-prefill).
+        # mesh-shape gate, mirroring the kv_quant one: an export from a
+        # DIFFERENTLY-sharded pool fails closed (local re-prefill).
+        # Unsharded exports (mesh_shape None) import anywhere — the
+        # scatter replicates/slices per the destination's placement —
+        # but a sharded manifest names the exact pool geometry it came
+        # from, and a silent geometry change is how per-shard payload
+        # formats rot into garbage-served-with-no-error
+        if export.mesh_shape is not None and \
+                tuple(export.mesh_shape) != \
+                tuple(getattr(engine, "kv_mesh_shape", None) or ()):
+            raise ValueError(
+                f"kv export mesh_shape {tuple(export.mesh_shape)} does "
+                f"not match the importing pool's "
+                f"{getattr(engine, 'kv_mesh_shape', None)} — sharded "
+                f"imports are geometry-exact (fail closed)")
         flat, _ = jax.tree_util.tree_flatten_with_path(engine._cache)
         expected = {jax.tree_util.keystr(path)
                     for path, _ in flat if not _is_index(path)}

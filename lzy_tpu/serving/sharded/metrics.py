@@ -1,0 +1,20 @@
+"""Metrics for sharded gang replicas (``lzy_sharded_*``)."""
+
+from __future__ import annotations
+
+from lzy_tpu.utils.metrics import REGISTRY
+
+GANG_SIZE = REGISTRY.gauge(
+    "lzy_sharded_gang_size",
+    "Devices in a sharded replica's gang (tp width), by replica")
+SHARD_KV_BLOCKS = REGISTRY.gauge(
+    "lzy_sharded_shard_kv_blocks",
+    "Allocated KV blocks held by one shard of a sharded pool")
+SHARD_SKEW = REGISTRY.gauge(
+    "lzy_sharded_shard_skew",
+    "Max-min allocated-block spread across shards of one pool; the shared "
+    "logical block table makes this 0 by construction — nonzero means a "
+    "per-shard allocator has drifted")
+GANG_FAILOVERS = REGISTRY.counter(
+    "lzy_sharded_gang_failovers_total",
+    "Whole-gang failovers: one dead host retired an entire sharded replica")

@@ -1,0 +1,107 @@
+"""Partition rules for serving a model tensor-sharded over a gang mesh.
+
+The design constraint is **bit-identity** with the single-device engine
+(ISSUE 17 acceptance bar): only non-contraction dimensions are ever
+sharded, so every float reduction — matmul contractions, softmax sums,
+the final logits einsum — keeps its single-device operand order. GSPMD's
+psum-of-partial-products (the usual Megatron row-parallel trick) is a
+reduction-order change and therefore banned by construction:
+
+* q/k/v projection kernels shard on their **output** head dim; the
+  attention einsums treat kv_heads as a batch dim, so they stay
+  shard-local and exact.
+* gate/up projection kernels shard on their **output** d_ff dim; the
+  ``act_mlp`` anchor is overridden to replicate ``h`` before down_proj.
+* everything else — o_proj, down_proj, norms, embed, lm_head — is
+  replicated, and the activation anchors (``act_embed``/``act_vocab``/
+  ``act_attn_out``) gather sharded activations back to replicated
+  *before* each replicated contraction.
+
+The all-gathers this buys are exactly N-1 extra collectives per layer —
+the price of bit-identity; a throughput-first profile can relax these
+rules later without touching the engine.
+
+Scope: the no-sharded-contractions guarantee controls *operand order*,
+which makes the partitioned program bitwise exact under f32 compute.
+Under bf16 compute one residual hazard remains that no placement rule
+can remove: the partitioned program has different XLA fusion boundaries
+(collectives and constraints cut fusions), so bf16 intermediates round
+at different points — 1-ULP logit noise. Greedy/sampled/spec *streams*
+stay identical unless a prompt lands on an argmax near-tie; the strict
+bitwise tests therefore run f32 compute, and bf16 behaviour is pinned by
+fixed-seed stream tests.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from lzy_tpu.parallel.mesh import mesh_for
+from lzy_tpu.parallel.sharding import freeze_rules
+
+# Rule overrides threaded into Llama(cfg, rules=...): replicate the
+# residual stream, mlp hidden, and logits (training shards these over tp
+# — fine for throughput, fatal for exact-decode bit-identity because each
+# downstream matmul would contract over a sharded dim). "act_heads" stays
+# at its default ("tp") so q is head-sharded, and "act_attn_out" at its
+# default (None) so the merged attention output gathers before o_proj.
+SERVE_RULES = freeze_rules({
+    "act_embed": None,
+    "act_mlp": None,
+    "act_vocab": None,
+})
+
+# Param placement by flattened-path regex (first match wins). Kernel
+# layouts (models/llama.py): q_proj (d_model, n_heads, head_dim),
+# k/v_proj (d_model, n_kv_heads, head_dim), gate/up_proj (d_model, d_ff).
+_PARAM_RULES: Tuple[Tuple[str, P], ...] = (
+    (r"(q_proj|k_proj|v_proj).*kernel", P(None, "tp", None)),
+    (r"(gate_proj|up_proj).*kernel", P(None, "tp")),
+    (r".*", P()),
+)
+
+
+def serve_mesh_for(tp: int, devices=None) -> Mesh:
+    """A 1×tp serving mesh over the first ``tp`` local devices (all mesh
+    axes except tp are size 1, so batch/seq anchors are no-ops)."""
+    if devices is not None:
+        return mesh_for(devices=devices, tp=tp)
+    return mesh_for(tp, tp=tp)
+
+
+def spec_for_param(path: str) -> P:
+    for pattern, spec in _PARAM_RULES:
+        if re.search(pattern, path):
+            return spec
+    return P()
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Device-put a param tree onto ``mesh`` per the serving placement
+    table. Committed shardings make jit infer in_shardings — no
+    per-argument annotations needed downstream."""
+
+    def place(path, leaf):
+        spec = spec_for_param(jax.tree_util.keystr(path))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def pool_leaf_sharding(mesh: Mesh, path: Any, leaf: Any) -> NamedSharding:
+    """Sharding for one paged-KV-pool leaf: payload pages shard on the
+    kv_heads axis (axis 2 of ``(kv_pages, page, kv_heads, head_dim)``;
+    quant sidecars ``(kv_pages, page, kv_heads)`` likewise), scalar
+    index leaves replicate. The *block table* stays logical and shared —
+    one admission decision, N shard-local scatter/gather paths."""
+    del path
+    ndim = getattr(leaf, "ndim", 0)
+    if ndim == 4:
+        return NamedSharding(mesh, P(None, None, "tp", None))
+    if ndim == 3:
+        return NamedSharding(mesh, P(None, None, "tp"))
+    return NamedSharding(mesh, P())

@@ -1,0 +1,19 @@
+# corpus: gang-replica decode round fetching per SHARD — one host sync
+# per device of the mesh instead of one replicated fetch. On a 1xN gang
+# this turns the one-fence-per-round contract into N fences, and the
+# fence count scales with mesh width instead of staying constant.
+import jax
+import numpy as np
+
+
+class GangEngine:
+    def decode_step(self, emit_matrix, pool, shards):
+        toks = []
+        for shard in shards:
+            part = np.asarray(                     # sync per shard
+                emit_matrix.addressable_shards[shard].data)
+            toks.append(part)
+        for shard in shards:
+            self.host_kv[shard] = jax.device_get(  # transfer per shard
+                pool[shard])
+        return toks

@@ -1,0 +1,13 @@
+# corpus: the gang-correct shape — the emit matrix is REPLICATED (the
+# act_vocab anchor) before it leaves the jit, so ONE np.asarray per
+# round carries every shard's answer; the per-shard loop is host-only.
+import numpy as np
+
+
+class GangBatchedEngine:
+    def decode_step(self, emit_matrix, shards):
+        nxt = np.asarray(emit_matrix)      # ONE fence for the whole gang
+        out = []
+        for shard in shards:
+            out.append(int(nxt[shard]))    # host-side indexing only
+        return out

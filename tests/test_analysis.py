@@ -68,6 +68,9 @@ CLASS_PAIRS = [
     ("jax-host-sync-hot-loop",
      "lzy_tpu/serving/bad_host_sync.py",
      "lzy_tpu/serving/good_host_sync.py"),
+    ("jax-host-sync-hot-loop",
+     "lzy_tpu/serving/bad_shard_host_sync.py",
+     "lzy_tpu/serving/good_shard_host_sync.py"),
     ("jax-reupload-hot-loop",
      "lzy_tpu/serving/bad_reupload_hot_loop.py",
      "lzy_tpu/serving/good_reupload_once.py"),

@@ -52,13 +52,19 @@ def _oracle_tokens(cfg, params, prompt_ids, n, **kw):
 
 
 def _make_ctx(cfg, params, *, replicas=2, slots=2, paged=False,
-              allocator=None, store=None, **engine_kw):
+              sharded=False, allocator=None, store=None, **engine_kw):
     """A journal-backed gateway fleet plus everything a successor needs
     (the factory, the shared store, the fence auditor)."""
     store = store if store is not None else OperationStore(":memory:")
     journal = GatewayJournal(store)
 
     def factory():
+        if sharded:
+            from lzy_tpu.serving.sharded import ShardedPagedInferenceEngine
+
+            return ShardedPagedInferenceEngine(cfg, params, slots=slots,
+                                               page_size=PAGE, tp=2,
+                                               **engine_kw)
         if paged:
             return PagedInferenceEngine(cfg, params, slots=slots,
                                         page_size=PAGE, **engine_kw)
@@ -487,6 +493,59 @@ class TestKillTheGateway:
                 audit_recovery(journal, gw, pre_live)
         finally:
             gw.close()
+
+
+class TestGangRecovery:
+    """Sharded gang replicas recover ALL-OR-NOTHING: a journaled lease
+    whose gang lost even one shard host while the gateway was down is
+    never re-adopted — the SPMD programs span every shard, so a partial
+    gang has no degraded mode. The lease is dropped whole (journal row
+    forgotten, engine closed); intact gangs adopt exactly like
+    single-device replicas."""
+
+    def test_gang_with_dead_host_dropped_whole_intact_gang_adopted(
+            self, tiny_model):
+        cfg, params = tiny_model
+        ctx = _make_ctx(cfg, params, replicas=2, sharded=True)
+        gw = ctx["gw"]
+        engines = {}
+        try:
+            # both gangs serve before the crash
+            res = gw.generate([5, 9, 3], max_new_tokens=3, timeout_s=120)
+            assert res["status"] == "ok"
+            victim, survivor = gw.fleet.replicas()
+            engines.update({r.id: r.engine for r in gw.fleet.replicas()})
+
+            def src(rid, vms):
+                eng = engines.get(rid)
+                if rid == victim.id and eng is not None:
+                    # one shard host died WITH the gateway: the recovering
+                    # successor must see gang_intact False and refuse the
+                    # whole lease, not adopt a 1-of-2 gang
+                    eng.mark_host_dead(1, "host lost in the outage")
+                return eng
+
+            report, _ = _kill_and_recover(ctx, engine_source=src)
+            gw2 = ctx["gw"]
+            assert victim.id in report.dropped_leases
+            assert victim.id not in ctx["journal"].leases()
+            ids = [r.id for r in gw2.fleet.replicas()]
+            assert victim.id not in ids
+            # the intact gang was ADOPTED (same engine object, no
+            # rebuild) and still serves bit-identically
+            assert survivor.id in ids
+            adopted = next(r for r in gw2.fleet.replicas()
+                           if r.id == survivor.id)
+            assert adopted.engine is engines[survivor.id]
+            assert adopted.engine.gang_size == 2
+            res = gw2.generate([5, 9, 3], max_new_tokens=3,
+                               timeout_s=120)
+            assert res["status"] == "ok"
+        finally:
+            ctx["gw"].close()
+            for eng in engines.values():
+                if not getattr(eng, "closed", False):
+                    eng.close()
 
 
 class TestDisaggRecovery:

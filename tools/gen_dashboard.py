@@ -44,6 +44,9 @@ def registry_metrics():
     # native paged-attention kernels: dispatches by path, quantized
     # blocks resident, dequant-error EWMA (lzy_kernel_*)
     import lzy_tpu.ops.paged_attention  # noqa: F401
+    # sharded gang replicas: gang size by mesh, per-shard KV blocks,
+    # shard-skew tripwire, whole-gang failovers (lzy_sharded_*)
+    import lzy_tpu.serving.sharded.metrics  # noqa: F401
     # multi-tenant SLO: per-tenant requests/tokens/TTFT, queue depth,
     # KV blocks, rate-bucket levels, sheds (lzy_tenant_*)
     import lzy_tpu.serving.tenancy  # noqa: F401
