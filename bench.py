@@ -484,6 +484,20 @@ def run() -> None:
     if extra:
         detail.update(extra)
         emit()
+    extra = agent_pipeline_measurement(
+        jax, cfg, params,
+        replicas=2,
+        slots=4 if is_tpu else 2,
+        # page <= reply so the speculative prefill covers whole reply
+        # pages — the thing the fused TTFT number is measuring
+        page_size=32 if is_tpu else 8,
+        prompt_len=128 if is_tpu else 32,
+        new_tokens=32 if is_tpu else 8,
+        n_conversations=6 if is_tpu else 3,
+        steps=3)
+    if extra:
+        detail.update(extra)
+        emit()
     extra = stream_measurement(
         jax, cfg, params,
         slots=4 if is_tpu else 2,
@@ -1801,6 +1815,155 @@ def llm_op_pipeline_measurement(jax, cfg, params, *, replicas: int,
                 "llm_op_steps": steps}
     except Exception as e:  # noqa: BLE001 — diagnostics only
         _log(f"llm_op pipeline skipped: {type(e).__name__}: {e}")
+        return {}
+
+
+def agent_pipeline_measurement(jax, cfg, params, *, replicas: int,
+                               slots: int, page_size: int,
+                               prompt_len: int, new_tokens: int,
+                               n_conversations: int, steps: int):
+    """Workflow-aware scheduling point (lzy_tpu/llm/sched.py): the SAME
+    agent-pipeline trace — interleaved ``generate → tool op → generate``
+    chains — driven FUSED (KV parked across the tool gap + speculative
+    next-step prefill, the default) and UNFUSED (``LZY_WFSCHED_FUSE=0``),
+    reporting per-step TTFT past step 1 (where the pin and the
+    speculation can pay), pipeline throughput, and the admission fan-in
+    plane's dedup numbers (identical in-flight greedy rows reaching the
+    fleet as ONE engine request). Runs in the CPU-fallback round with
+    scaled-down shapes. Wrapped so a hiccup never loses the headline."""
+    try:
+        from lzy_tpu import Lzy, llm, op
+        from lzy_tpu.gateway import (
+            GatewayService, PrefixAffinityRouter, ReplicaFleet)
+        from lzy_tpu.serving import PagedInferenceEngine
+        from lzy_tpu.storage import DefaultStorageRegistry, StorageConfig
+
+        @op
+        def extend(g, extra: list) -> list:
+            return g.full_tokens() + list(extra)
+
+        base_len = max(page_size, prompt_len - prompt_len % page_size)
+        prompts = [list(range(1, base_len + 1)) + [i % 50 + 2]
+                   for i in range(n_conversations)]
+
+        def build_gw():
+            fleet = ReplicaFleet(lambda: PagedInferenceEngine(
+                cfg, params, slots=slots, page_size=page_size,
+                max_queue=4 * n_conversations))
+            gw = GatewayService(fleet,
+                                router=PrefixAffinityRouter(page_size),
+                                model_name="bench",
+                                max_waiters=replicas * slots + 2)
+            for _ in range(replicas):
+                fleet.add_replica()
+            # warm prefill buckets + decode once, off-clock
+            gw.generate(prompts[0], max_new_tokens=2, timeout_s=600)
+            return gw, fleet
+
+        def lzy_for(tag):
+            reg = DefaultStorageRegistry()
+            reg.register_storage(
+                "default", StorageConfig(uri=f"mem://bench-agent-{tag}"),
+                default=True)
+            return Lzy(storage_registry=reg)
+
+        def drive(tag, fused):
+            """The pipeline trace once; returns (tok/s, mean TTFT of
+            steps >= 2, scheduler stats)."""
+            saved = {k: os.environ.get(k)
+                     for k in ("LZY_WFSCHED_FUSE", "LZY_WFSCHED_SPECULATE")}
+            if not fused:
+                os.environ["LZY_WFSCHED_FUSE"] = "0"
+                os.environ["LZY_WFSCHED_SPECULATE"] = "0"
+            gw, fleet = build_gw()
+            try:
+                llm.configure(gw)      # scheduler reads the flags here
+                lzy = lzy_for(tag)
+                convs = [llm.Conversation(f"agent-{tag}-{i}")
+                         for i in range(n_conversations)]
+                step_ttft, total = [], 0
+                t0 = time.perf_counter()
+                with lzy.workflow(f"agent-{tag}") as wf:
+                    cur = [list(p) for p in prompts]
+                    for s in range(steps):
+                        gens = []
+                        for i, conv in enumerate(convs):
+                            g = llm.generate(
+                                cur[i], max_new_tokens=new_tokens,
+                                greedy=True, cache=False,
+                                conversation=conv, timeout_s=600)
+                            gens.append(g)
+                            cur[i] = extend(g, [60 + i + s])
+                        wf.barrier()
+                        if s >= 1:     # step 1 has no pin either way
+                            step_ttft += [g.ttft_ms for g in gens
+                                          if g.ttft_ms is not None]
+                        total += sum(len(list(g.tokens)) for g in gens)
+                dt = time.perf_counter() - t0
+                sched = llm.current_scheduler()
+                stats = sched.stats() if sched is not None else {}
+                ttft = (sum(step_ttft) / len(step_ttft)
+                        if step_ttft else None)
+                return total / dt, ttft, stats
+            finally:
+                llm.configure(None)
+                gw.close()
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+
+        _log(f"agent pipeline: {n_conversations} chains x {steps} steps "
+             f"x {new_tokens} tokens, {replicas} replicas, fused vs "
+             f"unfused...")
+        tps_fused, ttft_fused, fstats = drive("fused", True)
+        tps_plain, ttft_plain, _ = drive("plain", False)
+
+        # the fan-in plane: identical in-flight greedy rows must reach
+        # the fleet as exactly ONE engine request
+        gw, fleet = build_gw()
+        try:
+            llm.configure(gw)
+            lzy = lzy_for("fanin")
+            n_rows = max(4, n_conversations)
+            base = gw.stats()["requests_finished"]
+            with lzy.workflow("agent-fanin"):
+                outs = llm.generate_batch(
+                    [list(prompts[0])] * n_rows,
+                    max_new_tokens=new_tokens, greedy=True,
+                    cache=False, timeout_s=600)
+            n_rows = len(list(outs))
+            fanin_requests = gw.stats()["requests_finished"] - base
+            sched = llm.current_scheduler()
+            dedup_hits = (sched.stats()["dedup_hits"]
+                          if sched is not None else 0)
+        finally:
+            llm.configure(None)
+            gw.close()
+
+        _log(f"agent pipeline: fused {tps_fused:.1f} tok/s, step TTFT "
+             f"{ttft_fused} ms (unfused {tps_plain:.1f} tok/s, "
+             f"{ttft_plain} ms); parks {fstats.get('parks', 0)}, "
+             f"speculations {fstats.get('speculations', 0)}; fan-in "
+             f"{n_rows} rows -> {fanin_requests} engine requests "
+             f"({dedup_hits} dedup hits)")
+        out = {"agent_pipeline_fused_tokens_per_s": round(tps_fused, 1),
+               "agent_pipeline_unfused_tokens_per_s": round(tps_plain, 1),
+               "agent_pipeline_fused_parks": fstats.get("parks", 0),
+               "agent_pipeline_fused_speculations":
+                   fstats.get("speculations", 0),
+               "agent_pipeline_fanin_rows": n_rows,
+               "agent_pipeline_fanin_engine_requests": fanin_requests,
+               "agent_pipeline_dedup_hits": dedup_hits}
+        if ttft_fused is not None:
+            out["agent_pipeline_fused_step_ttft_ms"] = round(ttft_fused, 3)
+        if ttft_plain is not None:
+            out["agent_pipeline_unfused_step_ttft_ms"] = \
+                round(ttft_plain, 3)
+        return out
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        _log(f"agent pipeline skipped: {type(e).__name__}: {e}")
         return {}
 
 

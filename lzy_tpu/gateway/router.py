@@ -108,6 +108,7 @@ class PrefixAffinityRouter:
         self._clock = 0
         self._routed = 0
         self._routed_prefix = 0
+        self._fused_routed = 0
         self._lock = threading.Lock()
 
     # -- index ---------------------------------------------------------------
@@ -194,17 +195,32 @@ class PrefixAffinityRouter:
     # -- choice --------------------------------------------------------------
 
     def choose(self, tokens: Sequence[int], loads: Dict[str, int],
-               session: Optional[str] = None) -> Tuple[Optional[str], str]:
+               session: Optional[str] = None,
+               pinned: Optional[str] = None) -> Tuple[Optional[str], str]:
         """Pick a replica from ``loads`` (replica_id -> queue+busy).
-        Returns ``(replica_id, reason)`` with reason ``"session"``,
-        ``"prefix"`` or ``"load"``; ``(None, "empty")`` when no
-        candidates exist. ``session`` (a conversation id) prefers the
-        pinned replica — subject to the SAME imbalance bound as prefix
-        affinity, so a hot conversation cannot melt one replica. The
-        caller must :meth:`observe` the prompt on the chosen replica once
-        the request is actually submitted."""
+        Returns ``(replica_id, reason)`` with reason ``"fused"``,
+        ``"session"``, ``"prefix"`` or ``"load"``; ``(None, "empty")``
+        when no candidates exist. ``session`` (a conversation id)
+        prefers the pinned replica — subject to the SAME imbalance bound
+        as prefix affinity, so a hot conversation cannot melt one
+        replica. ``pinned`` is a HARD pin (reason ``"fused"``): the
+        workflow scheduler holds that replica's conversation KV parked
+        resident across a tool gap, so the imbalance bound does not
+        apply — the parked blocks are worth more than a balanced queue,
+        and the pin is already bounded by the park TTL. Ignored when the
+        replica left the candidate set. The caller must :meth:`observe`
+        the prompt on the chosen replica once the request is actually
+        submitted."""
         if not loads:
             return None, "empty"
+        if pinned is not None and pinned in loads:
+            with self._lock:
+                self._routed += 1
+                self._fused_routed += 1
+                _ROUTED.inc(reason="fused")
+                _IMBALANCE.set(float(max(loads.values())
+                                     - min(loads.values())))
+            return pinned, "fused"
         session_rate = None
         # hash the prompt ONCE, before taking the lock: under routing
         # contention every concurrent choose() used to serialize its
@@ -269,6 +285,7 @@ class PrefixAffinityRouter:
                 "indexed_chains": {r: len(i)
                                    for r, i in self._index.items()},
                 "sessions_pinned": len(self._sessions),
+                "fused_routed": self._fused_routed,
                 "session_routed": self._session_routed,
                 "session_affinity_rate": (
                     round(self._session_hits / self._session_routed, 4)
@@ -286,6 +303,7 @@ class RoundRobinRouter:
         self.page_size = page_size
         self._next = 0
         self._routed = 0
+        self._fused_routed = 0
         self._lock = threading.Lock()
 
     def observe(self, replica_id: str, tokens: Sequence[int],
@@ -302,9 +320,16 @@ class RoundRobinRouter:
         return None
 
     def choose(self, tokens: Sequence[int], loads: Dict[str, int],
-               session: Optional[str] = None) -> Tuple[Optional[str], str]:
+               session: Optional[str] = None,
+               pinned: Optional[str] = None) -> Tuple[Optional[str], str]:
         if not loads:
             return None, "empty"
+        if pinned is not None and pinned in loads:
+            with self._lock:
+                self._routed += 1
+                self._fused_routed += 1
+                _ROUTED.inc(reason="fused")
+            return pinned, "fused"
         with self._lock:
             order = sorted(loads)
             choice = order[self._next % len(order)]
@@ -317,5 +342,7 @@ class RoundRobinRouter:
         with self._lock:
             return {"routed_total": self._routed, "routed_by_prefix": 0,
                     "prefix_route_rate": 0.0, "indexed_chains": {},
-                    "sessions_pinned": 0, "session_routed": 0,
+                    "sessions_pinned": 0,
+                    "fused_routed": self._fused_routed,
+                    "session_routed": 0,
                     "session_affinity_rate": 0.0}

@@ -25,7 +25,7 @@ single engine used to sit. What it adds over one engine:
 from __future__ import annotations
 
 import threading
-from typing import List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from lzy_tpu.chaos.faults import CHAOS
 from lzy_tpu.utils.clock import SYSTEM_CLOCK
@@ -39,6 +39,11 @@ from lzy_tpu.utils.log import get_logger
 from lzy_tpu.utils.metrics import REGISTRY
 
 _LOG = get_logger(__name__)
+
+#: reserved tenant speculative next-step prefills ride: background WFQ
+#: share, and the requesting user's own per-tenant accounting never sees
+#: the speculation (it is uncharged by contract)
+SPECULATION_TENANT = "__wfsched__"
 
 _FAILOVERS = REGISTRY.counter(
     "lzy_gateway_failovers_total",
@@ -98,6 +103,7 @@ class GatewayService:
         kv_transport=None,
         clock=None,
         journal=None,
+        wf_park_ttl_s: float = 30.0,
     ):
         # injectable time (utils/clock): request deadlines, failover
         # budgets, tick cadence and the drain loop all run on it — the
@@ -176,6 +182,18 @@ class GatewayService:
         #: the global KV index from every adopted replica (the memoized
         #: advertisement identity check is skipped once)
         self._kv_force_refresh = False
+        #: workflow-aware scheduling (lzy_tpu/llm/sched.py): live fusion
+        #: leases, session -> (replica_id, expires_at). A lease means
+        #: the replica holds that conversation's KV PARKED resident
+        #: across a tool gap, so the next step hard-pins there (reason
+        #: "fused"). Leases are advisory and bounded: they expire with
+        #: the engine-side park TTL, die with the replica (failover /
+        #: health retirement drops them), and a stale one costs a lazy
+        #: cleanup — never a wrong route (the engine re-matches its own
+        #: radix tree regardless).
+        self._wf_park_ttl = float(wf_park_ttl_s)
+        self._wf_parked: Dict[str, Tuple[str, float]] = {}
+        self._wf_lock = threading.Lock()
 
     # -- request surface -----------------------------------------------------
 
@@ -530,6 +548,7 @@ class GatewayService:
                 if not req.error.startswith(_CAPACITY_ERRORS):
                     self.fleet.health.record_failure(replica.id)
                     self.router.forget(replica.id)
+                    self._drop_leases_on(replica.id)
                     self.fleet.check_health()
                     # a FAULTED replica is out for this request; a merely
                     # SQUEEZED one stays eligible — the resubmission
@@ -646,14 +665,26 @@ class GatewayService:
         loads = {rid: load for rid, load in self.fleet.loads().items()
                  if rid not in exclude}
         last_err: Optional[Exception] = None
+        # fused hard pin: a live park lease routes the conversation's
+        # next step to the replica holding its KV resident. Consumed
+        # per-attempt — once the pinned replica drops out of the
+        # candidate set (admission refusal, death) the loop degrades to
+        # the ordinary routed path and the lease is lazily dropped.
+        pinned = self._fused_pin(session) if session is not None else None
         while loads:
             rid, reason = self.router.choose(prompt, loads,
-                                             session=session)
+                                             session=session,
+                                             pinned=pinned)
             replica = self.fleet.get(rid)
             # try_route CLAIMS a half-open breaker's single probe — at
             # dispatch, not during enumeration, so listing passes that
             # route elsewhere never burn a recovered replica's probe
             if replica is None or not self.fleet.health.try_route(rid):
+                if rid == pinned:
+                    # the leased replica is gone or sick: the parked KV
+                    # died with it — fall back to ordinary routing
+                    self._drop_lease(session)
+                    pinned = None
                 loads.pop(rid, None)
                 continue
             if not self._pre_submit(
@@ -749,6 +780,149 @@ class GatewayService:
         if not (liveness is not None and self._client_gone(liveness)):
             self._stage_kv_import(replica, prompt, deadline_s=deadline_s)
         return True
+
+    # -- workflow-aware scheduling (lzy_tpu/llm/sched.py) ---------------------
+
+    def _fused_pin(self, session: Optional[str]) -> Optional[str]:
+        """The replica a live fusion lease pins ``session`` to, with
+        lazy expiry (the engine-side TTL sweep is authoritative; this
+        map only mirrors it for routing)."""
+        if session is None:
+            return None
+        with self._wf_lock:
+            lease = self._wf_parked.get(session)
+            if lease is None:
+                return None
+            rid, expires = lease
+            if self._clock.now() >= expires:
+                del self._wf_parked[session]
+                return None
+            return rid
+
+    def _drop_lease(self, session: Optional[str]) -> None:
+        if session is None:
+            return
+        with self._wf_lock:
+            self._wf_parked.pop(session, None)
+
+    def _drop_leases_on(self, replica_id: str) -> None:
+        """A dead/retired replica's parked KV died with it: drop every
+        lease pointing at it so the next steps route normally (the
+        engine's own close released the pins, or the host is gone)."""
+        with self._wf_lock:
+            for session in [s for s, (rid, _) in self._wf_parked.items()
+                            if rid == replica_id]:
+                del self._wf_parked[session]
+
+    def park_conversation(self, session: str, tokens: Sequence[int],
+                          ttl_s: Optional[float] = None) -> bool:
+        """Park ``session``'s conversation KV — the radix chain covering
+        ``tokens`` — resident on the replica that served it, for up to
+        ``ttl_s`` (the gateway default when None). Called by the
+        workflow scheduler when a ``generate -> tool-op`` step
+        completes: the following ``generate`` then hard-pins to this
+        replica ("fused" route) and prefills only its suffix. Advisory
+        end to end — False (no session pin yet, replica gone, engine
+        without a park surface, nothing cached) leaves the ordinary
+        routed path untouched."""
+        ttl = self._wf_park_ttl if ttl_s is None else float(ttl_s)
+        rid = self._fused_pin(session)
+        if rid is None:
+            rid = self.router.session_replica(session)
+        if rid is None:
+            return False
+        replica = self.fleet.get(rid)
+        park = (getattr(replica.engine, "park_chain", None)
+                if replica is not None else None)
+        if park is None:
+            return False
+        try:
+            ok = bool(park(f"conv:{session}", list(tokens), ttl_s=ttl))
+        except Exception:  # noqa: BLE001 — parking is advisory
+            ok = False
+        if ok:
+            with self._wf_lock:
+                self._wf_parked[session] = (rid, self._clock.now() + ttl)
+        else:
+            self._drop_lease(session)
+        return ok
+
+    def unpark_conversation(self, session: str) -> bool:
+        """Release ``session``'s fusion lease and its engine-side pins
+        (blocks fall back to ordinary LRU cache). Harmless when nothing
+        is parked."""
+        rid = self._fused_pin(session)
+        self._drop_lease(session)
+        if rid is None:
+            return False
+        replica = self.fleet.get(rid)
+        unpark = (getattr(replica.engine, "unpark_chain", None)
+                  if replica is not None else None)
+        if unpark is None:
+            return False
+        try:
+            return bool(unpark(f"conv:{session}"))
+        except Exception:  # noqa: BLE001 — advisory
+            return False
+
+    def speculate_prefill(self, session: str, tokens: Sequence[int], *,
+                          tenant: str = DEFAULT_TENANT,
+                          timeout_s: float = 30.0) -> bool:
+        """Speculative next-step prefill: while the tool op runs, chunk-
+        prefill the KNOWN prompt prefix of the conversation's next step
+        (``tokens`` = prompt + reply of the step that just finished) on
+        the leased replica as a 1-token greedy request at BACKGROUND
+        priority (WFQ tier 2), then re-park so the freshly cached reply
+        blocks ride the pin. The next step's TTFT becomes a suffix
+        prefill. Uncharged and uncounted by design: no SLO admission, no
+        waiter slot, no request accounting — the engine request rides a
+        reserved internal tenant so the caller's own per-tenant counters
+        and fair-queue share never pay for it. A wrong speculation is
+        cache pollution that LRU-evicts once the pin lapses. Never
+        raises."""
+        del tenant  # accepted for interface symmetry; never charged
+        rid = self._fused_pin(session)
+        if rid is None:
+            self._note_speculation("no_lease")
+            return False
+        replica = self.fleet.get(rid)
+        if replica is None:
+            self._drop_lease(session)
+            self._note_speculation("no_lease")
+            return False
+        try:
+            req = replica.engine.submit(
+                [int(t) for t in tokens], max_new_tokens=1,
+                deadline_s=timeout_s, greedy=True,
+                tenant=SPECULATION_TENANT, priority=2)
+        except Exception:  # noqa: BLE001 — speculation is advisory
+            self._note_speculation("error")
+            return False
+        if not req.wait(timeout=timeout_s):
+            req.cancel()
+            self._note_speculation("timeout")
+            return False
+        if req.status != "ok":
+            self._note_speculation("miss")
+            return False
+        # extend the pin over the blocks the speculation just cached
+        # (the reply positions — decode never tree-caches them, so this
+        # prefill is the only way they become matchable)
+        self.park_conversation(session, tokens)
+        self._note_speculation("ok")
+        return True
+
+    def _wf_parked_count(self) -> int:
+        with self._wf_lock:
+            return len(self._wf_parked)
+
+    @staticmethod
+    def _note_speculation(outcome: str) -> None:
+        # lazy leaf import, same contract as _session_rate_gauge: the
+        # gateway must not import the llm package at module scope
+        from lzy_tpu.llm.metrics import SPECULATIONS
+
+        SPECULATIONS.inc(outcome=outcome)
 
     def _reset_kv_import_meta(self) -> None:
         """Reset the PER-ATTEMPT staging meta up front (both gateways
@@ -918,11 +1092,13 @@ class GatewayService:
         now = now if now is not None else self._clock.time()
         for rid in self.fleet.check_health(now=now):
             self.router.forget(rid)
+            self._drop_leases_on(rid)
             if self.kv_index is not None:
                 self.kv_index.forget(rid)
                 self._kvtier_last_adv.pop(rid, None)
         for rid in self.fleet.reap_drained():
             self.router.forget(rid)
+            self._drop_leases_on(rid)
             if self.kv_index is not None:
                 self.kv_index.forget(rid)
                 self._kvtier_last_adv.pop(rid, None)
@@ -1138,6 +1314,9 @@ class GatewayService:
             "spec_acceptance_rate": round(spec_rate, 4),
             "spec_tokens_per_step": round(spec_tps, 4),
             "spec_draft_truncated": agg["spec_draft_truncated"],
+            # workflow-aware scheduling: conversations currently holding
+            # a fusion lease (their KV parked resident across a tool gap)
+            "wf_parked_sessions": self._wf_parked_count(),
             # per-tenant breakdown (operator view only — this branch)
             "tenants": self.fleet.aggregate_tenants(),
         }

@@ -27,6 +27,8 @@ from lzy_tpu.llm.op import (
     Conversation, DISPATCH_RETRIES_POLICY, Generation, LLM_OP_NAME,
     LlmDispatchError, generate, generate_batch, llm_generate,
     llm_generate_batch)
+from lzy_tpu.llm.sched import (
+    WorkflowScheduler, current_scheduler, scheduler_for)
 from lzy_tpu.llm.wb import (
     GENERATION_WB_NAME, GenerationRecord, record_generation)
 
@@ -41,7 +43,9 @@ __all__ = [
     "LlmBackendError",
     "LlmDispatchError",
     "ServiceBackend",
+    "WorkflowScheduler",
     "configure",
+    "current_scheduler",
     "generate",
     "generate_batch",
     "llm_generate",
@@ -49,4 +53,5 @@ __all__ = [
     "model_digest_for",
     "record_generation",
     "resolve_backend",
+    "scheduler_for",
 ]

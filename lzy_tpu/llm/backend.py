@@ -227,6 +227,11 @@ def configure(backend: Any, *, token: Optional[str] = None) -> Any:
         backend = ServiceBackend(backend, token=token)
     with _lock:
         _configured = backend
+    # a new plane must not inherit the old plane's workflow scheduler
+    # state (in-flight dedup table, fusion leases, speculation futures)
+    from lzy_tpu.llm import sched
+
+    sched.reset()
     return backend
 
 

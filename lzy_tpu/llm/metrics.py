@@ -48,3 +48,38 @@ CONVERSATION_AFFINITY_RATE = REGISTRY.gauge(
 DISPATCH_RETRIES = REGISTRY.counter(
     "lzy_llm_dispatch_retries_total",
     "llm_op dispatch attempts retried after a transient dispatch error")
+
+# -- workflow-aware scheduling (lzy_tpu/llm/sched.py) -------------------------
+# The scheduler-side lzy_wfsched_* family. The engine-side half (parked
+# chains and their releases) lives in lzy_tpu/serving/engine.py — both
+# modules are already on the dashboard generator's import list.
+
+#: every generate dispatched through the workflow scheduler's fan-in
+#: plane, by role: "leader" carried an engine request other in-flight
+#: callers adopted, "solo" had no concurrent twin, "follower" adopted a
+#: leader's reply (no engine request of its own)
+WFSCHED_DISPATCHES = REGISTRY.counter(
+    "lzy_wfsched_dispatches_total",
+    "generates through the workflow fan-in plane, by role "
+    "(role=leader|solo|follower)")
+
+#: identical in-flight greedy calls collapsed onto a leader's single
+#: engine request (sampled/streaming calls are never deduplicated)
+DEDUP_HITS = REGISTRY.counter(
+    "lzy_wfsched_dedup_hits_total",
+    "in-flight identical greedy generates collapsed to one engine "
+    "request")
+
+#: fused op-chain park attempts after a conversation step, by outcome
+PARK_ATTEMPTS = REGISTRY.counter(
+    "lzy_wfsched_park_attempts_total",
+    "conversation park attempts after an ok step, by outcome "
+    "(outcome=parked|declined|unsupported)")
+
+#: speculative next-step prefills, by outcome ("ok" = the next step's
+#: known prefix is now cached on the leased replica; wrong speculations
+#: are released uncounted as cache pollution when the pin lapses)
+SPECULATIONS = REGISTRY.counter(
+    "lzy_wfsched_speculations_total",
+    "speculative next-step prefills, by outcome "
+    "(outcome=ok|miss|timeout|error|no_lease)")

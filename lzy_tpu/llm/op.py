@@ -154,8 +154,10 @@ def llm_generate(prompt, gen_params, model_digest,
     re-dispatch an already-cached greedy generation."""
     from lzy_tpu.llm import metrics
     from lzy_tpu.llm.backend import resolve_backend
+    from lzy_tpu.llm.sched import scheduler_for
 
     backend = resolve_backend()
+    sched = scheduler_for(backend)
     params = dict(gen_params)
     opts = dict(runtime_opts or {})
     step = params.pop("step", None)
@@ -174,7 +176,7 @@ def llm_generate(prompt, gen_params, model_digest,
 
     def dispatch():
         CHAOS.hit("llm.dispatch")
-        return backend.generate(
+        return sched.dispatch(
             prompt_tokens,
             max_new_tokens=params.get("max_new_tokens", 64),
             timeout_s=opts.get("timeout_s"),
@@ -228,6 +230,14 @@ def llm_generate(prompt, gen_params, model_digest,
     status = reply.get("status", "ok")
     metrics.GENERATIONS.inc(status=status)
     metrics.GENERATED_TOKENS.inc(len(reply.get("tokens", ())))
+    if session is not None and status == "ok":
+        # fused op chain: park this conversation's KV resident on its
+        # replica and speculatively prefill the next step's known prompt
+        # prefix (this step's prompt + reply) while the tool op between
+        # steps runs — the next dispatch for this session awaits it
+        sched.note_step_done(
+            session, prompt_tokens + list(reply.get("tokens", [])),
+            tenant=tenant)
     return Generation(
         prompt=prompt_tokens,
         tokens=list(reply.get("tokens", [])),
@@ -249,22 +259,55 @@ def llm_generate(prompt, gen_params, model_digest,
 
 def llm_generate_batch(prompts, gen_params, model_digest,
                        conversation=None, runtime_opts=None):
-    """Batch body: fan the prompts into the plane concurrently (they
-    are independent — the engine batches them across slots; one op node
-    keeps them one graph edge). Conversations apply per the
+    """Batch body: fan the prompts through the workflow scheduler's
+    shared plane (they are independent — the engine batches them across
+    slots; one op node keeps them one graph edge). Greedy batches dedup
+    WITHIN the fan-out too: identical rows dispatch once and every
+    duplicate adopts a copy of the reply (and since each unique row
+    lands back in :meth:`WorkflowScheduler.dispatch`, cross-workflow
+    in-flight dedup still applies on top). Conversations apply per the
     single-prompt contract on every row; streams are rejected at the
     factory (:func:`generate`) — concurrent rows publishing divergent
     tokens at overlapping positions of ONE channel is a splice, not a
     stream."""
-    from concurrent import futures as _futures
+    from lzy_tpu.llm import metrics
+    from lzy_tpu.llm.backend import resolve_backend
+    from lzy_tpu.llm.sched import scheduler_for
 
     if not prompts:
         return []
-    with _futures.ThreadPoolExecutor(min(len(prompts), 16)) as pool:
-        return list(pool.map(
-            lambda p: llm_generate(p, gen_params, model_digest,
-                                   conversation, runtime_opts),
-            prompts))
+    sched = scheduler_for(resolve_backend())
+    greedy = dict(gen_params).get("greedy") is True
+    dedupable = sched.dedup and greedy
+    # identical greedy rows collapse before dispatch: key by prompt
+    # (params/digest are batch-constant); sampled rows stay unique —
+    # each is its own draw
+    row_keys: List[Any] = []
+    unique: Dict[Any, List[int]] = {}
+    for i, p in enumerate(prompts):
+        key = tuple(int(t) for t in p) if dedupable else ("row", i)
+        row_keys.append(key)
+        unique.setdefault(key, list(p))
+    results = sched.map(
+        lambda p: llm_generate(p, gen_params, model_digest,
+                               conversation, runtime_opts),
+        list(unique.values()))
+    by_key = dict(zip(unique.keys(), results))
+    out, adopted = [], set()
+    for key in row_keys:
+        g = by_key[key]
+        if key in adopted:
+            # duplicate row adopting its twin's reply: fresh token
+            # lists per row — siblings must never alias
+            metrics.DEDUP_HITS.inc()
+            metrics.WFSCHED_DISPATCHES.inc(role="follower")
+            sched.note_batch_dedup()
+            g = dataclasses.replace(g, prompt=list(g.prompt),
+                                    tokens=list(g.tokens),
+                                    params=dict(g.params))
+        adopted.add(key)
+        out.append(g)
+    return out
 
 
 def _resolve_stream(opts):

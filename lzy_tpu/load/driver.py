@@ -183,6 +183,10 @@ class Collector:
         self.tokens_by_tenant: Dict[str, int] = {}
         self.peak_queue_depth = 0
         self.retries = 0
+        # agent-pipeline (fused op chain) accounting
+        self.pipeline_turns = 0
+        self.parked_turns = 0
+        self.speculations_ok = 0
 
     def note_gap(self, gap: float) -> None:
         self.gaps_s.append(gap)
@@ -217,7 +221,8 @@ class LoadDriver:
                  collector: Optional[Collector] = None,
                  hammer_tenant: Optional[str] = None,
                  hammer_interval_s: float = 0.02,
-                 max_virtual_s: Optional[float] = None):
+                 max_virtual_s: Optional[float] = None,
+                 fuse_pipeline: bool = True):
         self.gateway = gateway
         self.fleet = fleet
         self.clock = clock
@@ -226,6 +231,10 @@ class LoadDriver:
         self.collector = collector if collector is not None else Collector()
         self.hammer_tenant = hammer_tenant
         self.hammer_interval_s = hammer_interval_s
+        #: False = unfused baseline: pipeline turns replay WITHOUT the
+        #: park + speculative-prefill hook (the fused-vs-unfused
+        #: comparison runs the same trace both ways)
+        self.fuse_pipeline = fuse_pipeline
         self.max_virtual_s = (max_virtual_s if max_virtual_s is not None
                               else trace_cfg.duration_s * 6 + 600.0)
         self._busy_until: Dict[str, float] = {}
@@ -326,6 +335,34 @@ class LoadDriver:
                 self.collector.records.append(rec)
                 if rec["status"] == "ok":
                     history[turn.session] = prompt + rec["tokens"]
+                    if turn.pipeline and self.fuse_pipeline:
+                        self._fuse_turn(turn, history[turn.session])
+
+    def _fuse_turn(self, turn: Turn, full_tokens: List[int]) -> None:
+        """Agent-pipeline turn finished ok: mirror the workflow
+        scheduler's fused-chain hook — park the conversation's KV
+        resident on its replica and speculatively prefill the next
+        step's known prefix while the tool gap elapses. Advisory on the
+        replay too: any failure just means the next turn pays an
+        ordinary routed prefill."""
+        self.collector.pipeline_turns += 1
+        park = getattr(self.gateway, "park_conversation", None)
+        if park is None:
+            return
+        try:
+            if not park(turn.session, full_tokens):
+                return
+        except Exception:  # noqa: BLE001 — advisory
+            return
+        self.collector.parked_turns += 1
+        speculate = getattr(self.gateway, "speculate_prefill", None)
+        if speculate is None:
+            return
+        try:
+            if speculate(turn.session, full_tokens, tenant=turn.tenant):
+                self.collector.speculations_ok += 1
+        except Exception:  # noqa: BLE001 — advisory
+            pass
 
     # -- driver side ---------------------------------------------------------
 
@@ -535,6 +572,12 @@ class LoadReport:
     #: re-leased — adopted keeps the warm caches)
     gateway_restarts: int = 0
     restart_adopted: int = 0
+    #: agent-pipeline (fused op chain) facts: ok pipeline turns, how
+    #: many parked their conversation KV across the tool gap, and how
+    #: many speculative next-step prefills landed
+    pipeline_turns: int = 0
+    parked_turns: int = 0
+    speculations_ok: int = 0
 
     @classmethod
     def build(cls, driver: LoadDriver, virtual_s: float,
@@ -584,6 +627,9 @@ class LoadReport:
             restart_adopted=(len(driver.restart_report.adopted)
                              if driver.restart_report is not None
                              else 0),
+            pipeline_turns=col.pipeline_turns,
+            parked_turns=col.parked_turns,
+            speculations_ok=col.speculations_ok,
         )
 
     def metrics(self) -> dict:
@@ -600,7 +646,8 @@ class LoadReport:
 def replay(trace_cfg: TraceConfig,
            fleet_cfg: Optional[FleetConfig] = None, *,
            hammer_tenant: Optional[str] = None,
-           max_virtual_s: Optional[float] = None) -> LoadReport:
+           max_virtual_s: Optional[float] = None,
+           fuse_pipeline: bool = True) -> LoadReport:
     """Generate + replay one trace against a fresh fleet; the one-call
     entry the sweeps (and tests) compose."""
     fleet_cfg = fleet_cfg or FleetConfig()
@@ -612,7 +659,8 @@ def replay(trace_cfg: TraceConfig,
         driver = LoadDriver(gw, fleet, clock, trace_cfg,
                             fleet_cfg=fleet_cfg, collector=collector,
                             hammer_tenant=hammer_tenant,
-                            max_virtual_s=max_virtual_s)
+                            max_virtual_s=max_virtual_s,
+                            fuse_pipeline=fuse_pipeline)
         return driver.run()
     finally:
         # through the driver: a rolling restart swapped driver.gateway,

@@ -100,6 +100,12 @@ class SimEngine:
         # chain node, whole-page prefix match, LRU eviction)
         self._cache: Dict[int, int] = {}
         self._lru = 0
+        # workflow-scheduler parking: key -> (chain hashes, expires_at).
+        # Parked chains are PINNED against LRU eviction until their TTL
+        # lapses (swept per round) or pressure sheds them — the sim's
+        # analogue of the paged engine's _ParkedChain machinery, so the
+        # load plane exercises fused op chains on the virtual clock.
+        self._parked: Dict[str, tuple] = {}
         self._closed = False
         self._finished = 0
         self._cancelled = 0
@@ -121,6 +127,7 @@ class SimEngine:
 
     def close(self, timeout: float = 10.0) -> None:
         self._closed = True
+        self._parked = {}
         for job in list(self._prefills):
             job.req.finish(error="engine shutting down")
         self._prefills = []
@@ -222,12 +229,24 @@ class SimEngine:
     def _shrink_cache(self) -> None:
         """Evict LRU cached chains past the pool budget (cached blocks
         are the overcommit slack, exactly like unreferenced radix
-        leaves)."""
+        leaves). Parked chains are pinned: under pressure the soonest-
+        expiring parked chain is shed WHOLE before any pinned page goes
+        — mirroring the paged engine's parked-before-preemption
+        ordering."""
         budget = self.profile.kv_blocks - 1 - self._active_blocks()
         while len(self._cache) > max(0, budget):
-            victim = min(self._cache, key=self._cache.get)
-            del self._cache[victim]
-            self.evictions += 1
+            pinned = {h for hashes, _ in self._parked.values()
+                      for h in hashes}
+            victims = [h for h in self._cache if h not in pinned]
+            if victims:
+                victim = min(victims, key=self._cache.get)
+                del self._cache[victim]
+                self.evictions += 1
+                continue
+            if not self._parked:
+                break
+            shed = min(self._parked, key=lambda k: self._parked[k][1])
+            del self._parked[shed]
 
     def _available(self) -> int:
         # cached chains are evictable (LRU), so they never subtract from
@@ -238,6 +257,44 @@ class SimEngine:
     def _can_admit(self, req: Request) -> bool:
         need = _blocks_for(len(req.prompt), self.profile.page_size)
         return self._available() >= need
+
+    # -- workflow-scheduler parking (gateway park_conversation) --------------
+
+    def park_chain(self, key, tokens, ttl_s: float = 30.0,
+                   timeout_s: float = 5.0) -> bool:
+        """Pin the cached whole-page prefix of ``tokens`` against LRU
+        eviction for ``ttl_s`` virtual seconds — the sim analogue of the
+        paged engine's park surface. Returns False (nothing pinned) when
+        no prefix of ``tokens`` is cached."""
+        del timeout_s                 # sync engine: parking is immediate
+        if self._closed:
+            return False
+        page = self.profile.page_size
+        tokens = list(tokens)
+        hashes, h = [], 0x5EED ^ self._seed
+        for i in range(0, len(tokens) - len(tokens) % page, page):
+            h = hash((h, tuple(tokens[i:i + page])))
+            if h not in self._cache:
+                break
+            self._lru += 1
+            self._cache[h] = self._lru
+            hashes.append(h)
+        if not hashes:
+            self._parked.pop(str(key), None)
+            return False
+        self._parked[str(key)] = (tuple(hashes),
+                                  self._clock.now() + float(ttl_s))
+        return True
+
+    def unpark_chain(self, key, timeout_s: float = 5.0) -> bool:
+        del timeout_s
+        return self._parked.pop(str(key), None) is not None
+
+    def _sweep_parked(self) -> None:
+        now = self._clock.now()
+        for key in [k for k, (_, exp) in self._parked.items()
+                    if now >= exp]:
+            del self._parked[key]
 
     def _tenant_quota(self, tenant: str) -> Optional[int]:
         if self.tenants is None:
@@ -429,6 +486,7 @@ class SimEngine:
         value before this replica's next round."""
         if self._closed:
             return 0.0
+        self._sweep_parked()
         self._reap()
         admitted = self._admit()
         cost = self._advance_prefill()
@@ -453,6 +511,9 @@ class SimEngine:
             kv_blocks_free=max(0, self._available() - len(self._cache)),
             kv_blocks_cached=len(self._cache),
             kv_evictions=self.evictions,
+            kv_parked_chains=len(self._parked),
+            kv_parked_blocks=sum(len(hs)
+                                 for hs, _ in self._parked.values()),
             prefix_hit_rate=round(
                 self.hit_tokens / self.lookup_tokens, 4)
             if self.lookup_tokens else 0.0,

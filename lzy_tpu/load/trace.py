@@ -100,6 +100,13 @@ class TraceConfig:
     burst_off_s: float = 240.0      # mean inter-burst gap
     session_turns: float = 4.0      # mean turns per conversation
     revisit_p: float = 0.3          # new session resumes an old one
+    #: share of NEW sessions that are agent pipelines — multi-step
+    #: generate → tool → generate conversations whose inter-turn gap is
+    #: a TOOL execution (seed-deterministic, mean ``tool_gap_s``), not a
+    #: human think time. 0.0 (the default) draws no extra randomness,
+    #: so pre-existing traces stay byte-identical per seed.
+    agent_pipeline_p: float = 0.0
+    tool_gap_s: float = 1.0         # mean tool-op gap inside a pipeline
     system_prompt_tokens: int = 48
     user_tokens_mean: float = 32.0
     reply_tokens_mean: float = 16.0
@@ -124,6 +131,10 @@ class Turn:
     think_s: float
     new_tokens: tuple
     max_new_tokens: int
+    #: agent-pipeline turn: the gap before the NEXT turn is a tool op,
+    #: so the replay mirrors the workflow scheduler's fused chain (park
+    #: the conversation KV + speculative next-step prefill in the gap)
+    pipeline: bool = False
 
 
 def _burst_windows(rng: np.random.Generator,
@@ -189,11 +200,21 @@ def generate_trace(cfg: TraceConfig) -> List[List[Turn]]:
                     past.pop(0)
                 fresh = True
             n_turns = 1 + int(rng.geometric(1.0 / cfg.session_turns))
+            # agent-pipeline draw: ONLY when the knob is on, so the
+            # default workload's rng stream (and therefore every
+            # pre-existing trace) is untouched per seed
+            pipeline = (cfg.agent_pipeline_p > 0.0 and fresh
+                        and float(rng.random()) < cfg.agent_pipeline_p)
             first = fresh                     # revisits keep their history
             for _ in range(n_turns):
                 scale = (1.0 / cfg.burst_factor
                          if _in_burst(windows, t) else 1.0)
-                think = float(rng.exponential(cfg.think_s)) * scale
+                if pipeline:
+                    # the inter-step gap is a TOOL op, not a human:
+                    # short, burst-immune, still seed-deterministic
+                    think = float(rng.exponential(cfg.tool_gap_s))
+                else:
+                    think = float(rng.exponential(cfg.think_s)) * scale
                 n_user = max(1, int(rng.lognormal(
                     np.log(cfg.user_tokens_mean), 0.6)))
                 n_reply = min(cfg.reply_tokens_cap, max(1, int(
@@ -205,6 +226,7 @@ def generate_trace(cfg: TraceConfig) -> List[List[Turn]]:
                     new_tokens=tuple(user_tokens(
                         cfg.seed, user, turn_idx, n_user, cfg.vocab)),
                     max_new_tokens=n_reply,
+                    pipeline=pipeline,
                 ))
                 first = False
                 turn_idx += 1

@@ -203,8 +203,27 @@ class _ExecGraphAction(OperationRunner):
             raise RuntimeError("graph stopped by user")
 
         running = sum(1 for i in tasks.values() if i["status"] == RUNNING)
-        for tid, info in tasks.items():
-            if info["status"] != WAITING or running >= self.svc.max_running_tasks:
+        # chain-hot frontier ordering: a ready task fed by a COMPLETED
+        # llm_generate step is the tool op of a generate → tool →
+        # generate chain. Launch those before unrelated ready work —
+        # the tool-gap wall time is exactly the window the workflow
+        # scheduler's parked-KV lease (and its speculative prefill)
+        # must survive, so the frontier order is a scheduling lever,
+        # not a cosmetic one. Stable sort: ties keep registration order.
+        from lzy_tpu.llm.op import LLM_OP_NAME
+
+        def _chain_hot(tid: str) -> bool:
+            return any(tasks[d]["status"] == COMPLETED
+                       and tasks[d].get("name") == LLM_OP_NAME
+                       for d in self.state["deps"][tid])
+
+        frontier = sorted(
+            (tid for tid, info in tasks.items()
+             if info["status"] == WAITING),
+            key=lambda t: not _chain_hot(t))
+        for tid in frontier:
+            info = tasks[tid]
+            if running >= self.svc.max_running_tasks:
                 continue
             if all(tasks[d]["status"] == COMPLETED for d in self.state["deps"][tid]):
                 if not self.svc._try_admit(user):

@@ -52,7 +52,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Any, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -143,6 +143,20 @@ _OVERLAP_COMMITS = REGISTRY.counter(
     "admission plans computed in the overlap window, by outcome "
     "(outcome=committed|stale|empty)")
 
+# workflow-aware scheduling (lzy_tpu/llm/sched.py): a fused
+# ``generate -> tool-op -> generate`` chain parks its conversation's
+# radix chain — blocks pinned resident — across the tool gap so step 2
+# is a suffix prefill on the same replica. Park/release events are
+# engine-owned; the scheduler-side lzy_wfsched_* counters live in
+# lzy_tpu/llm/metrics.py.
+_PARKED = REGISTRY.counter(
+    "lzy_wfsched_parked_total",
+    "conversation KV chains parked (pinned resident) across tool gaps")
+_PARKED_RELEASED = REGISTRY.counter(
+    "lzy_wfsched_parked_released_total",
+    "parked chain releases by reason "
+    "(reason=repark|ttl|pressure|explicit|shutdown)")
+
 
 @dataclasses.dataclass
 class _PrefillJob:
@@ -170,6 +184,17 @@ class _PrefillJob:
     # budget exists to keep short)
     tokens_dev: Any = None          # [1, len] prompt / suffix ids
     pt_dev: Any = None              # paged: [1, pages] page table
+
+
+@dataclasses.dataclass
+class _ParkedChain:
+    """One parked conversation prefix (workflow-aware scheduling): its
+    radix blocks carry one pinned reference each (``RadixCache.lookup``)
+    until release, so the tool gap of a fused op chain cannot evict the
+    conversation's KV out from under step 2."""
+    blocks: List[int]
+    tokens: int                 # whole-block prefix length pinned
+    expires_at: float           # engine-clock deadline (TTL sweep)
 
 
 @dataclasses.dataclass
@@ -204,6 +229,11 @@ class EngineStats:
     kv_tier_promotions: Optional[int] = None
     kv_tier_dropped: Optional[int] = None
     kv_storage_tier_blocks: Optional[int] = None
+    # workflow-aware scheduling (paged engines): conversation chains
+    # currently parked across fused op-chain tool gaps, and the blocks
+    # they pin resident
+    kv_parked_chains: Optional[int] = None
+    kv_parked_blocks: Optional[int] = None
     # speculative decoding fields (spec_tokens > 0 only; serving/spec.py)
     spec_tokens: Optional[int] = None
     spec_proposed_tokens: Optional[int] = None
@@ -1660,6 +1690,16 @@ class PagedInferenceEngine(InferenceEngine):
         # donating prefill
         self._pending_imports: List[Any] = []
         self._export_requests: List[tuple] = []
+        # parked conversation chains (workflow-aware scheduling): key ->
+        # _ParkedChain with its radix blocks pinned so a fused op
+        # chain's tool gap cannot evict the conversation KV. Mutated
+        # only on the scheduling thread (cross-thread callers queue
+        # through _park_requests, the request_kv_export pattern);
+        # bounded by the TTL sweep in step(), shed under pool pressure
+        # strictly before any resident request is preempted, and
+        # released wholesale at close().
+        self._parked: Dict[str, _ParkedChain] = {}
+        self._park_requests: List[tuple] = []
         self._kv_io_lock = threading.Lock()
         self.kv_imports = 0
         self.kv_import_blocks = 0
@@ -1821,7 +1861,12 @@ class PagedInferenceEngine(InferenceEngine):
         # pops it), and its staged import must be resident before the
         # prefill's prefix match runs. No-op when the queue is empty.
         self._apply_imports()
-        return self.kv.available() >= blocks_for(len(req.prompt), self._page)
+        need = blocks_for(len(req.prompt), self._page)
+        if self.kv.available() < need and self._parked:
+            # parked tool-gap chains yield to live admissions: shed them
+            # (soonest expiry first) before making anyone wait
+            self._shed_parked_for_pressure(need)
+        return self.kv.available() >= need
 
     def _admit_verdict(self, req: Request) -> str:
         """Tenant KV quota first (a tenant AT its quota is skipped, not
@@ -1961,6 +2006,7 @@ class PagedInferenceEngine(InferenceEngine):
         admissions, then run it — an import queued before a submit is
         always resident by the time that request prefills."""
         serviced = self._service_kv_io()
+        self._sweep_parked()
         return super().step() or serviced
 
     def _demote_block(self, chain, block: int, origin) -> None:
@@ -2157,6 +2203,100 @@ class PagedInferenceEngine(InferenceEngine):
                       "to local prefill", type(e).__name__, e)
             return 0
 
+    # -- parked conversation chains (workflow-aware scheduling) ---------------
+
+    def park_chain(self, key: str, tokens: Sequence[int],
+                   ttl_s: float = 30.0, timeout_s: float = 5.0) -> bool:
+        """Pin the longest cached whole-block prefix of ``tokens`` under
+        ``key`` for up to ``ttl_s`` so it survives the tool gap of a
+        fused ``generate -> tool-op -> generate`` chain. Re-parking a
+        key refreshes both the pin (covering newly cached blocks, e.g.
+        after a speculative prefill) and the TTL. The pin itself runs on
+        the engine's scheduling thread — same cross-thread contract as
+        :meth:`request_kv_export` — and the whole surface is advisory:
+        False (nothing cached, timeout, shutdown) degrades the caller
+        to the ordinary routed path."""
+        if self._closed:
+            return False
+        if self._thread is None:
+            # synchronous/test mode: by the engine's single-driver
+            # contract the caller IS the scheduling thread
+            try:
+                return self._park_now(str(key), list(tokens), float(ttl_s))
+            except Exception:  # noqa: BLE001 — parking is advisory
+                return False
+        holder: dict = {}
+        done = threading.Event()
+        with self._kv_io_lock:
+            self._park_requests.append(
+                ("park", str(key), list(tokens), float(ttl_s), holder,
+                 done))
+        self.queue.work_available.set()
+        if not done.wait(timeout_s):
+            return False
+        return bool(holder.get("ok"))
+
+    def unpark_chain(self, key: str, timeout_s: float = 5.0) -> bool:
+        """Release a parked chain's pins (the blocks fall back to
+        ordinary LRU-evictable cache entries). False if nothing was
+        parked under ``key`` — releasing twice is harmless."""
+        if self._closed:
+            return False
+        if self._thread is None:
+            return self._release_parked(str(key), "explicit")
+        holder: dict = {}
+        done = threading.Event()
+        with self._kv_io_lock:
+            self._park_requests.append(
+                ("unpark", str(key), None, 0.0, holder, done))
+        self.queue.work_available.set()
+        if not done.wait(timeout_s):
+            return False
+        return bool(holder.get("ok"))
+
+    def _park_now(self, key: str, tokens: List[int], ttl_s: float) -> bool:
+        old = self._parked.pop(key, None)
+        if old is not None:
+            self.kv.release(old.blocks)
+            _PARKED_RELEASED.inc(reason="repark")
+        # lookup, not match: a park must not distort the hit-rate stats
+        # or the LRU order the serving traffic established
+        blocks, matched = self.kv.lookup(tokens)
+        if not blocks:
+            return False
+        self._parked[key] = _ParkedChain(
+            blocks=blocks, tokens=matched,
+            expires_at=self._clock.now() + ttl_s)
+        _PARKED.inc()
+        return True
+
+    def _release_parked(self, key: str, reason: str) -> bool:
+        chain = self._parked.pop(key, None)
+        if chain is None:
+            return False
+        self.kv.release(chain.blocks)
+        _PARKED_RELEASED.inc(reason=reason)
+        return True
+
+    def _sweep_parked(self) -> None:
+        if not self._parked:
+            return
+        now = self._clock.now()
+        expired = [k for k, c in self._parked.items()
+                   if now >= c.expires_at]
+        for key in expired:
+            self._release_parked(key, "ttl")
+
+    def _shed_parked_for_pressure(self, need_blocks: int) -> None:
+        """Release parked chains — soonest expiry first — until
+        ``need_blocks`` are coverable. Parked chains are strictly
+        cheaper to lose than any resident request: a released pin costs
+        a future re-prefill, a preemption throws away decode work."""
+        while self._parked and self.kv.available() < need_blocks:
+            key = min(self._parked,
+                      key=lambda k: self._parked[k].expires_at)
+            self._release_parked(key, "pressure")
+
     # -- cross-replica KV import/export --------------------------------------
 
     def queue_kv_import(self, export) -> None:
@@ -2224,9 +2364,22 @@ class PagedInferenceEngine(InferenceEngine):
         thread that may read or scatter the pooled cache leaves)."""
         did = self._apply_imports()
         with self._kv_io_lock:
-            if not self._export_requests:
+            if not self._export_requests and not self._park_requests:
                 return did
             requests, self._export_requests = self._export_requests, []
+            parks, self._park_requests = self._park_requests, []
+        for kind, key, tokens, ttl_s, holder, done in parks:
+            try:
+                holder["ok"] = (self._park_now(key, tokens, ttl_s)
+                                if kind == "park"
+                                else self._release_parked(key, "explicit"))
+            except Exception as e:  # noqa: BLE001 — parking is advisory
+                _LOG.warning("park request failed (%s: %s)",
+                             type(e).__name__, e)
+                holder["ok"] = False
+            finally:
+                done.set()
+            did = True
         for tokens, holder, done in requests:
             try:
                 holder["export"] = self._export_now(tokens)
@@ -2367,6 +2520,14 @@ class PagedInferenceEngine(InferenceEngine):
                 try:
                     block = self.kv.allocate(1)[0]
                 except NoFreeBlocks:
+                    if self._parked:
+                        # parked chains are sacrificed before ANY
+                        # resident request: one release, then retry
+                        # (their blocks fall back to evictable cache)
+                        key = min(self._parked,
+                                  key=lambda k: self._parked[k].expires_at)
+                        self._release_parked(key, "pressure")
+                        continue
                     victim = self._preempt_youngest()
                     if victim == slot:
                         break     # preempted ourselves; slot is free now
@@ -2533,6 +2694,9 @@ class PagedInferenceEngine(InferenceEngine):
             kv_quant=self._kv_quant,
             kv_imports=self.kv_imports,
             kv_import_blocks=self.kv_import_blocks,
+            kv_parked_chains=len(self._parked),
+            kv_parked_blocks=sum(len(c.blocks)
+                                 for c in self._parked.values()),
         )
         if self.kv_tier is not None:
             ts = self.kv_tier.stats()
@@ -2571,9 +2735,17 @@ class PagedInferenceEngine(InferenceEngine):
         # never service again (it reads None and re-prefills locally)
         with self._kv_io_lock:
             requests, self._export_requests = self._export_requests, []
+            parks, self._park_requests = self._park_requests, []
         for _, holder, done in requests:
             holder["export"] = None
             done.set()
+        for _kind, _key, _tokens, _ttl, holder, done in parks:
+            holder["ok"] = False
+            done.set()
+        # the loop thread is joined by super().close(): releasing the
+        # parked pins here is single-threaded by construction
+        for key in list(self._parked):
+            self._release_parked(key, "shutdown")
 
     def stats_by_tenant(self) -> dict:
         out = super().stats_by_tenant()

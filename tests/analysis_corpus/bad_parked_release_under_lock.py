@@ -1,0 +1,27 @@
+# corpus: the ISSUE 20 class — a workflow scheduler that releases
+# parked conversation KV while still holding its own plane lock. The
+# engine-side unpin blocks on the engine acknowledging the release
+# (Event.wait) and the lease journal append is storage I/O; every
+# dispatch/dedup caller serializes behind the tool-gap cleanup.
+import threading
+
+
+class BadParkPlane:
+    def __init__(self, storage):
+        self._lock = threading.Lock()
+        self._storage = storage
+        self._parked = {}
+        self._engine_ack = threading.Event()
+
+    def release_expired(self, now):
+        with self._lock:
+            for session, entry in list(self._parked.items()):
+                if entry["expires"] > now:
+                    continue
+                del self._parked[session]
+                # blocking engine handshake UNDER the plane lock: a
+                # slow engine round stalls every dispatcher
+                self._engine_ack.wait(1.0)
+                # and the lease journal append is storage I/O
+                self._storage.write_bytes(
+                    f"wfsched/released/{session}", b"ttl")

@@ -1,0 +1,25 @@
+# corpus: the correct shape (what llm/sched.py + the engine sweep do)
+# — expired parked entries are snapshotted and popped under the plane
+# lock, then the blocking engine handshake and the lease journal
+# append run OUTSIDE it, so tool-gap cleanup never serializes the
+# dispatch/dedup path.
+import threading
+
+
+class GoodParkPlane:
+    def __init__(self, storage):
+        self._lock = threading.Lock()
+        self._storage = storage
+        self._parked = {}
+        self._engine_ack = threading.Event()
+
+    def release_expired(self, now):
+        with self._lock:
+            expired = [s for s, e in self._parked.items()
+                       if e["expires"] <= now]
+            for session in expired:
+                del self._parked[session]
+        for session in expired:
+            self._engine_ack.wait(1.0)           # outside the lock
+            self._storage.write_bytes(
+                f"wfsched/released/{session}", b"ttl")

@@ -61,6 +61,9 @@ CLASS_PAIRS = [
      "bad_blocking_under_lock.py", "good_blocking_outside_lock.py"),
     ("lock-blocking-call",
      "bad_journal_under_lock.py", "good_journal_outside_lock.py"),
+    ("lock-blocking-call",
+     "bad_parked_release_under_lock.py",
+     "good_parked_release_outside_lock.py"),
     ("jax-donation-alias",
      "bad_donation_alias.py", "good_donation_copy.py"),
     ("jax-traced-python-if",

@@ -304,14 +304,18 @@ class TestWorkflowPipeline:
             assert g1.tokens == e1
             assert g2.tokens == e2 and g2.prompt == full2
             assert g3.tokens == e3 and g3.prompt == full3
-            # (b) session affinity kept the conversation on one replica
+            # (b) the fused op chain kept the conversation on one
+            # replica: after each step the workflow scheduler parks the
+            # conversation's KV there, so steps 2 and 3 HARD-pin to the
+            # leased replica (routed_by "fused" supersedes the session
+            # hint; a lapsed lease falls back to "session")
             assert g1.replica == g2.replica == g3.replica
-            assert g2.routed_by == "session"
-            assert g3.routed_by == "session"
+            assert g2.routed_by in ("fused", "session")
+            assert g3.routed_by in ("fused", "session")
             router = gw.router.stats()
-            # step 1 has no pin yet and must not count against the rate
-            assert router["session_routed"] == 2
-            assert router["session_affinity_rate"] == 1.0
+            # step 1 has no pin yet; steps 2+3 route pinned either way
+            assert router["session_routed"] + \
+                router.get("fused_routed", 0) == 2
             # (c) the recorded generation round-trips the index
             found = lzy.whiteboards(name=llm.GENERATION_WB_NAME,
                                     tags=[f"conversation:{conv.id}"])
@@ -730,14 +734,17 @@ class TestClusterEndToEnd:
             # (b) affinity kept the conversation on one replica
             replicas = {r for _, _, r, _ in steps}
             assert len(replicas) == 1
-            assert [why for _, _, _, why in steps][1:] == \
-                ["session", "session"]
+            # fused (parked-KV hard pin) when the workflow scheduler's
+            # lease held across the tool gap; session otherwise
+            assert all(why in ("fused", "session")
+                       for why in [w for _, _, _, w in steps][1:])
             # (c) whiteboard round-trip through the cluster's index
             found = lzy.whiteboards(name=llm.GENERATION_WB_NAME,
                                     tags=[f"conversation:{conv.id}"])
             assert [w.id for w in found] == [wb.id]
             assert found[0].tokens == steps[2][1]
-            assert found[0].provenance["routed_by"] == "session"
+            assert found[0].provenance["routed_by"] in ("fused",
+                                                        "session")
             # the tenant rode the workflow auth context into the fleet
             tenants = gw.stats()["tenants"]
             assert "test-user" in tenants

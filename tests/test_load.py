@@ -145,6 +145,63 @@ class TestCapacitySmoke:
             gw.close()
 
 
+class TestAgentPipeline:
+    """Satellite of ISSUE 20: the agent-pipeline trace shape replayed
+    through the virtual-clock fleet. Pipeline sessions are multi-step
+    conversations whose inter-turn gap is a seed-deterministic TOOL op;
+    after each ok turn the driver mirrors the workflow scheduler's
+    fused-chain hook (park the conversation KV + speculative next-step
+    prefill), so the fused win is measurable against the unfused
+    baseline on the SAME trace."""
+
+    # a fleet with KV headroom: parking pins pages, and speculation
+    # spends engine rounds to buy next-step TTFT — on a pool already at
+    # the eviction cliff the spend outweighs the win (the bench probe
+    # sweeps that trade; here the contract under test is the win)
+    TRACE = TraceConfig(seed=3, duration_s=120.0, users=12, tenants=4,
+                        agent_pipeline_p=0.8, tool_gap_s=0.5)
+    FLEET = FleetConfig(replicas=2)
+
+    def test_pipeline_knob_off_keeps_traces_byte_identical(self):
+        """agent_pipeline_p=0 draws no extra randomness: the default
+        workload is byte-identical to what pre-pipeline seeds produced
+        (every turn non-pipeline, same rng stream)."""
+        cfg = TraceConfig(seed=11, duration_s=300.0, users=8, tenants=4)
+        users = generate_trace(cfg)
+        assert all(not t.pipeline for turns in users for t in turns)
+        assert trace_bytes(cfg) == trace_bytes(cfg)
+
+    def test_pipeline_trace_shape(self):
+        users = generate_trace(self.TRACE)
+        turns = [t for turns in users for t in turns]
+        pipe = [t for t in turns if t.pipeline]
+        assert len(pipe) > 50
+        assert any(not t.pipeline for t in turns)
+        # tool gaps are short relative to human think times
+        gaps = sorted(t.think_s for t in pipe)
+        assert gaps[len(gaps) // 2] < self.TRACE.think_s / 2
+
+    def test_fused_replay_parks_speculates_and_beats_unfused_ttft(self):
+        fused = replay(self.TRACE, self.FLEET)
+        unfused = replay(self.TRACE, self.FLEET, fuse_pipeline=False)
+        # the fused hooks actually fired: conversations parked across
+        # tool gaps and speculative next-step prefills landed
+        assert fused.pipeline_turns > 50
+        assert fused.parked_turns > 0
+        assert fused.speculations_ok > 0
+        assert unfused.parked_turns == 0
+        # the perf claim: with the next step's prefix speculatively
+        # cached, median TTFT drops vs the identical unfused trace
+        assert fused.ok > 100 and unfused.ok > 100
+        assert fused.ttft_p50_ms < unfused.ttft_p50_ms
+
+    def test_fused_replay_is_deterministic(self):
+        r1 = replay(self.TRACE, self.FLEET)
+        r2 = replay(self.TRACE, self.FLEET)
+        assert r1.parked_turns == r2.parked_turns > 0
+        assert r1.metrics() == r2.metrics()
+
+
 class TestGatewayRestart:
     """Satellite of ISSUE 15: a scheduled mid-trace ``gateway_restart``
     event (virtual-clock deterministic) performs a zero-downtime rolling

@@ -1,0 +1,579 @@
+"""Workflow-aware serving scheduler (``lzy_tpu/llm/sched.py``): the
+acceptance properties and failure paths.
+
+- **In-flight dedup**: N identical in-flight greedy calls reach the
+  fleet as exactly ONE engine request whose reply fans out to every
+  waiter; sampled/streaming calls never dedup; a cancelled or failed
+  leader is its own outcome — followers re-dispatch, they do not
+  inherit it.
+- **Fused op chains**: step 2 of a ``generate → tool-op → generate``
+  chain hard-pins to the replica holding the parked KV and re-prefills
+  NOTHING of the shared prefix (asserted via ``prefill_tokens_saved``),
+  bit-identical to the unfused oracle.
+- **Failure paths**: replica death mid-tool-gap drops the lease and
+  the chain falls back to the routed path (still bit-identical); a
+  parked chain's TTL expiry releases it at the next engine round; KV
+  pressure sheds parked chains BEFORE any resident request suffers.
+"""
+
+import threading
+import time
+
+import jax
+import pytest
+
+from lzy_tpu import Lzy, llm
+from lzy_tpu.llm.sched import WorkflowScheduler
+from lzy_tpu.gateway import GatewayService, PrefixAffinityRouter, ReplicaFleet
+from lzy_tpu.models import llama, unbox
+from lzy_tpu.models.generate import generate as oracle_generate
+from lzy_tpu.serving import PagedInferenceEngine
+from lzy_tpu.storage import DefaultStorageRegistry, StorageConfig
+from lzy_tpu.utils.clock import SYSTEM_CLOCK
+
+import jax.numpy as jnp
+import numpy as np
+
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = llama.LlamaConfig.tiny(vocab_size=64)
+    boxed, _ = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, unbox(boxed)
+
+
+@pytest.fixture(autouse=True)
+def _clean_backend():
+    yield
+    llm.configure(None)
+
+
+def _oracle_tokens(cfg, params, prompt_ids, n, **kw):
+    out = oracle_generate(cfg, params,
+                          jnp.asarray([prompt_ids], jnp.int32),
+                          max_new_tokens=n, **kw)
+    return np.asarray(out)[0, len(prompt_ids):].tolist()
+
+
+def _make_gateway(cfg, params, *, replicas=2, slots=2, **engine_kw):
+    def factory():
+        return PagedInferenceEngine(cfg, params, slots=slots,
+                                    page_size=PAGE, **engine_kw)
+
+    fleet = ReplicaFleet(factory)
+    gw = GatewayService(fleet, router=PrefixAffinityRouter(PAGE),
+                        model_name="tiny")
+    for _ in range(replicas):
+        fleet.add_replica()
+    return gw, fleet
+
+
+def _local_lzy(uri: str) -> Lzy:
+    reg = DefaultStorageRegistry()
+    reg.register_storage("default", StorageConfig(uri=uri), default=True)
+    return Lzy(storage_registry=reg)
+
+
+def _wait_until(pred, timeout=15.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def _prefill_saved(fleet) -> int:
+    return sum(r.engine.stats().prefill_tokens_saved or 0
+               for r in fleet.replicas())
+
+
+def _parked_released(reason: str) -> float:
+    from lzy_tpu.serving.engine import _PARKED_RELEASED
+
+    return sum(v for k, v in _PARKED_RELEASED._values.items()
+               if reason in str(k))
+
+
+# -- in-flight dedup (the admission fan-in plane) -----------------------------
+
+class _GatedBackend:
+    """Fake serving plane: every generate blocks on ``gate`` (so the
+    test controls overlap) and is counted."""
+
+    def __init__(self, replies=None):
+        self.calls = 0
+        self.gate = threading.Event()
+        self._lock = threading.Lock()
+        self._replies = replies
+
+    def model_digest(self):
+        return "fake-digest"
+
+    def generate(self, prompt, **kw):
+        with self._lock:
+            self.calls += 1
+            n = self.calls
+        if not self.gate.wait(30):
+            raise TimeoutError("test gate never opened")
+        if self._replies is not None:
+            return self._replies(n)
+        return {"tokens": [100 + n], "status": "ok"}
+
+
+def _dispatch_into(sched, results, i, prompt, **kw):
+    def run():
+        try:
+            results[i] = sched.dispatch(prompt, **kw)
+        except BaseException as e:  # noqa: BLE001 — asserted by the test
+            results[i] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    return t
+
+
+class TestInflightDedup:
+    def test_identical_greedy_calls_collapse_to_one_request(self):
+        """Acceptance: N identical in-flight greedy calls reach the
+        plane as exactly 1 request; every waiter gets the reply, with
+        its OWN token list."""
+        be = _GatedBackend()
+        sched = WorkflowScheduler(be, dedup=True, fuse=False)
+        try:
+            results = {}
+            threads = [_dispatch_into(sched, results, 0, [1, 2, 3],
+                                      max_new_tokens=4, greedy=True)]
+            assert _wait_until(lambda: be.calls == 1)
+            threads += [_dispatch_into(sched, results, i, [1, 2, 3],
+                                       max_new_tokens=4, greedy=True)
+                        for i in (1, 2, 3)]
+            assert _wait_until(
+                lambda: sched.stats()["dedup_waiting"] == 3)
+            be.gate.set()
+            for t in threads:
+                t.join(30)
+            assert be.calls == 1
+            assert all(results[i] == {"tokens": [101], "status": "ok"}
+                       for i in range(4))
+            # fan-out copies, never aliases: a waiter mutating its
+            # Generation's tokens must not corrupt a sibling's
+            lists = [results[i]["tokens"] for i in range(4)]
+            for i in range(4):
+                for j in range(i + 1, 4):
+                    assert lists[i] is not lists[j]
+            s = sched.stats()
+            assert s["dispatches"] == 4
+            assert s["dedup_hits"] == 3
+            assert s["dedup_waiting"] == 0
+        finally:
+            sched.close()
+
+    def test_different_slo_identity_never_dedups(self):
+        """Same prompt, different tenant: a follower must not ride a
+        reply another tenant's quota paid for."""
+        be = _GatedBackend()
+        sched = WorkflowScheduler(be, dedup=True, fuse=False)
+        try:
+            results = {}
+            t1 = _dispatch_into(sched, results, 0, [1, 2], greedy=True,
+                                max_new_tokens=4, tenant="a")
+            assert _wait_until(lambda: be.calls == 1)
+            t2 = _dispatch_into(sched, results, 1, [1, 2], greedy=True,
+                                max_new_tokens=4, tenant="b")
+            assert _wait_until(lambda: be.calls == 2)
+            be.gate.set()
+            t1.join(30)
+            t2.join(30)
+            assert sched.stats()["dedup_hits"] == 0
+        finally:
+            sched.close()
+
+    @pytest.mark.parametrize("kw", [
+        {"greedy": None},                       # sampled: a draw, not a
+        {"greedy": False},                      # function of the inputs
+        {"greedy": True, "stream": object()},   # stream: one channel
+    ])
+    def test_sampled_and_streaming_calls_never_dedup(self, kw):
+        be = _GatedBackend()
+        sched = WorkflowScheduler(be, dedup=True, fuse=False)
+        try:
+            results = {}
+            t1 = _dispatch_into(sched, results, 0, [7, 8],
+                                max_new_tokens=4, **kw)
+            assert _wait_until(lambda: be.calls == 1)
+            t2 = _dispatch_into(sched, results, 1, [7, 8],
+                                max_new_tokens=4, **kw)
+            # both are IN the backend concurrently — no rendezvous
+            assert _wait_until(lambda: be.calls == 2)
+            be.gate.set()
+            t1.join(30)
+            t2.join(30)
+            assert sched.stats()["dedup_hits"] == 0
+        finally:
+            sched.close()
+
+    def test_cancelled_leader_does_not_fail_followers(self):
+        """A deadline-truncated leader reply (status 'cancelled') is the
+        LEADER's outcome: the follower re-dispatches and completes."""
+        be = _GatedBackend(replies=lambda n: (
+            {"tokens": [1], "status": "cancelled"} if n == 1
+            else {"tokens": [7, 8], "status": "ok"}))
+        sched = WorkflowScheduler(be, dedup=True, fuse=False)
+        try:
+            results = {}
+            t1 = _dispatch_into(sched, results, 0, [5, 5],
+                                max_new_tokens=4, greedy=True)
+            assert _wait_until(lambda: be.calls == 1)
+            t2 = _dispatch_into(sched, results, 1, [5, 5],
+                                max_new_tokens=4, greedy=True)
+            assert _wait_until(
+                lambda: sched.stats()["dedup_waiting"] == 1)
+            be.gate.set()
+            t1.join(30)
+            t2.join(30)
+            assert results[0] == {"tokens": [1], "status": "cancelled"}
+            assert results[1] == {"tokens": [7, 8], "status": "ok"}
+            assert be.calls == 2
+            assert sched.stats()["dedup_hits"] == 0
+        finally:
+            sched.close()
+
+    def test_failed_leader_does_not_fail_followers(self):
+        """A leader that RAISES fails only its own caller — the
+        follower becomes the new leader and succeeds."""
+        calls = {"n": 0}
+        gate = threading.Event()
+
+        class RaiseThenOk:
+            def model_digest(self):
+                return "d"
+
+            def generate(self, prompt, **kw):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    gate.wait(30)
+                    raise RuntimeError("leader replica on fire")
+                return {"tokens": [9], "status": "ok"}
+
+        sched = WorkflowScheduler(RaiseThenOk(), dedup=True, fuse=False)
+        try:
+            results = {}
+            t1 = _dispatch_into(sched, results, 0, [3, 3],
+                                max_new_tokens=2, greedy=True)
+            assert _wait_until(lambda: calls["n"] == 1)
+            t2 = _dispatch_into(sched, results, 1, [3, 3],
+                                max_new_tokens=2, greedy=True)
+            assert _wait_until(
+                lambda: sched.stats()["dedup_waiting"] == 1)
+            gate.set()
+            t1.join(30)
+            t2.join(30)
+            assert isinstance(results[0], RuntimeError)
+            assert results[1] == {"tokens": [9], "status": "ok"}
+            assert calls["n"] == 2
+        finally:
+            sched.close()
+
+    def test_follower_timeout_falls_back_to_its_own_dispatch(self):
+        """A leader that outlives the follower's budget must not hold
+        the follower hostage: past ``timeout_s`` it dispatches for
+        itself (no dedup credit)."""
+        calls = {"n": 0}
+        gate = threading.Event()
+
+        class SlowLeader:
+            def model_digest(self):
+                return "d"
+
+            def generate(self, prompt, **kw):
+                calls["n"] += 1
+                n = calls["n"]
+                if n == 1:
+                    gate.wait(30)        # the leader, wedged
+                return {"tokens": [n], "status": "ok"}
+
+        sched = WorkflowScheduler(SlowLeader(), dedup=True, fuse=False)
+        try:
+            results = {}
+            t1 = _dispatch_into(sched, results, 0, [4, 4],
+                                max_new_tokens=2, greedy=True)
+            assert _wait_until(lambda: calls["n"] == 1)
+            t2 = _dispatch_into(sched, results, 1, [4, 4],
+                                max_new_tokens=2, greedy=True,
+                                timeout_s=0.3)
+            t2.join(30)                  # returns while leader is stuck
+            assert results[1] == {"tokens": [2], "status": "ok"}
+            assert calls["n"] == 2
+            assert sched.stats()["dedup_hits"] == 0
+            gate.set()
+            t1.join(30)
+            assert results[0] == {"tokens": [1], "status": "ok"}
+        finally:
+            sched.close()
+
+    def test_batch_rows_dedup_through_the_real_fleet(self, tiny_model):
+        """`llm.generate_batch` with identical greedy rows: the fleet
+        serves exactly the UNIQUE rows; every duplicate adopts a copy.
+        Sampled rows never collapse."""
+        cfg, params = tiny_model
+        gw, fleet = _make_gateway(cfg, params, replicas=2)
+        try:
+            llm.configure(gw)
+            lzy = _local_lzy("mem://wfsched-batch")
+            pa, pb = [5, 9, 3, 1], [7, 2, 8, 1, 4]
+            base = gw.stats()["requests_finished"]
+            with lzy.workflow("fanin"):
+                outs = llm.generate_batch([pa, pa, pb, pa],
+                                          max_new_tokens=4, greedy=True)
+            outs = list(outs)
+            assert gw.stats()["requests_finished"] - base == 2
+            ea = _oracle_tokens(cfg, params, pa, 4)
+            eb = _oracle_tokens(cfg, params, pb, 4)
+            assert [g.tokens for g in outs] == [ea, ea, eb, ea]
+            assert outs[0].status == outs[1].status == "ok"
+            sched = llm.current_scheduler()
+            assert sched.stats()["dedup_hits"] >= 2
+            # sampled rows: each is its own draw — no collapse
+            base = gw.stats()["requests_finished"]
+            with lzy.workflow("fanin-sampled"):
+                outs = llm.generate_batch([pa, pa, pa], max_new_tokens=4)
+            assert len(list(outs)) == 3
+            assert gw.stats()["requests_finished"] - base == 3
+        finally:
+            gw.close()
+
+
+# -- fused op chains against the real fleet -----------------------------------
+
+class TestFusedChain:
+    P1 = [5, 9, 3, 1, 2, 6, 7, 4, 11, 12, 13, 14]      # 12 tokens
+
+    def _run_chain(self, cfg, params, uri):
+        """One generate → tool-gap → generate conversation; returns
+        (g1, g2, step2_prefill_saved, sched_stats, gateway)."""
+        gw, fleet = _make_gateway(cfg, params, replicas=2)
+        try:
+            llm.configure(gw)
+            lzy = _local_lzy(uri)
+            conv = llm.Conversation(f"chain-{uri[-6:]}")
+            with lzy.workflow("step1"):
+                g1 = llm.generate(self.P1, max_new_tokens=5, greedy=True,
+                                  conversation=conv)
+            sched = llm.current_scheduler()
+            sched.drain()                 # park + speculation settled
+            saved0 = _prefill_saved(fleet)
+            p2 = list(g1.full_tokens()) + [41, 42]
+            with lzy.workflow("step2"):
+                g2 = llm.generate(p2, max_new_tokens=5, greedy=True,
+                                  conversation=conv)
+            return (g1, g2, _prefill_saved(fleet) - saved0,
+                    sched.stats())
+        finally:
+            gw.close()
+            llm.configure(None)
+
+    def test_fused_step_skips_the_whole_shared_prefix(
+            self, tiny_model, monkeypatch):
+        """Acceptance: with fusion on, step 2 routes 'fused' to the
+        pinned replica and its prefill matches EVERY whole page of the
+        parked + speculated chain — step-1 prompt AND reply pages (16
+        of 19 prompt tokens; 8-token pages) — where the unfused path
+        re-prefills the reply positions (8 matched). Greedy output is
+        bit-identical to the unfused oracle either way."""
+        cfg, params = tiny_model
+        monkeypatch.delenv("LZY_WFSCHED_FUSE", raising=False)
+        g1f, g2f, saved_fused, stats_f = self._run_chain(
+            cfg, params, "mem://wfsched-fused")
+        monkeypatch.setenv("LZY_WFSCHED_FUSE", "0")
+        g1u, g2u, saved_unfused, stats_u = self._run_chain(
+            cfg, params, "mem://wfsched-plain")
+        # bit-identity vs the monolithic oracle, fused and unfused
+        e1 = _oracle_tokens(cfg, params, self.P1, 5)
+        p2 = self.P1 + e1 + [41, 42]
+        e2 = _oracle_tokens(cfg, params, p2, 5)
+        assert g1f.tokens == g1u.tokens == e1
+        assert g2f.tokens == g2u.tokens == e2
+        # the fused chain pinned step 2 to the replica holding the KV
+        assert g2f.routed_by == "fused"
+        assert g2f.replica == g1f.replica
+        assert stats_f["parks"] >= 1 and stats_f["speculations"] >= 1
+        # ...and re-prefilled nothing of the shared prefix: the step-1
+        # prompt page came from the ordinary radix cache, the reply
+        # page ONLY exists because the speculation prefilled it
+        assert saved_fused == 16
+        # unfused: session affinity still finds the prompt page, but
+        # the reply positions are decode output — never tree-cached —
+        # so the shared prefix IS re-prefilled past the first page
+        assert g2u.routed_by == "session"
+        assert saved_unfused == 8
+        assert stats_u["parks"] == 0 and stats_u["speculations"] == 0
+
+    def test_replica_death_mid_gap_drops_lease_and_falls_back(
+            self, tiny_model):
+        """The pinned replica dies during the tool gap: the health tick
+        retires it, the fusion lease (and its parked KV) dies with it,
+        and step 2 serves bit-identically over the routed path."""
+        cfg, params = tiny_model
+        gw, fleet = _make_gateway(cfg, params, replicas=2)
+        try:
+            llm.configure(gw)
+            lzy = _local_lzy("mem://wfsched-kill")
+            conv = llm.Conversation("killed-gap")
+            p1 = TestFusedChain.P1
+            with lzy.workflow("step1"):
+                g1 = llm.generate(p1, max_new_tokens=5, greedy=True,
+                                  conversation=conv)
+            llm.current_scheduler().drain()
+            assert gw.stats()["wf_parked_sessions"] == 1
+            rid = gw.router.session_replica(conv.id)
+            victim = fleet.get(rid)
+            assert victim.engine.stats().kv_parked_chains == 1
+            released0 = _parked_released("shutdown")
+            victim.engine.close()         # mid-gap death
+            gw.tick()                     # health check reaps it...
+            # ...dropping the lease AND the engine-side pins
+            assert gw.stats()["wf_parked_sessions"] == 0
+            assert _parked_released("shutdown") == released0 + 1
+            assert rid not in [r.id for r in fleet.replicas()]
+            p2 = list(g1.full_tokens()) + [41]
+            with lzy.workflow("step2"):
+                g2 = llm.generate(p2, max_new_tokens=5, greedy=True,
+                                  conversation=conv)
+            assert g2.status == "ok"
+            assert g2.tokens == _oracle_tokens(cfg, params, p2, 5)
+            assert g2.replica != rid
+            assert g2.routed_by != "fused"
+        finally:
+            gw.close()
+
+    def test_replica_death_without_health_tick_still_serves(
+            self, tiny_model):
+        """No tick between the death and step 2: the stale lease points
+        at a corpse. The routed loop consumes the pin, finds the
+        replica unroutable, and degrades to ordinary routing — one
+        re-prefill, never a wrong token."""
+        cfg, params = tiny_model
+        gw, fleet = _make_gateway(cfg, params, replicas=2)
+        try:
+            llm.configure(gw)
+            lzy = _local_lzy("mem://wfsched-kill-lazy")
+            conv = llm.Conversation("killed-gap-lazy")
+            p1 = TestFusedChain.P1
+            with lzy.workflow("step1"):
+                g1 = llm.generate(p1, max_new_tokens=5, greedy=True,
+                                  conversation=conv)
+            llm.current_scheduler().drain()
+            rid = gw.router.session_replica(conv.id)
+            fleet.get(rid).engine.close()
+            p2 = list(g1.full_tokens()) + [41]
+            with lzy.workflow("step2"):
+                g2 = llm.generate(p2, max_new_tokens=5, greedy=True,
+                                  conversation=conv)
+            assert g2.status == "ok"
+            assert g2.tokens == _oracle_tokens(cfg, params, p2, 5)
+            assert g2.replica != rid
+            assert g2.routed_by != "fused"
+        finally:
+            gw.close()
+
+
+# -- engine-side park lifecycle (TTL, pressure) -------------------------------
+
+class _OffsetClock:
+    """System clock plus a test-advanced offset — park TTLs observe the
+    jump without the test sleeping through them."""
+
+    def __init__(self):
+        self.offset = 0.0
+
+    def now(self):
+        return SYSTEM_CLOCK.now() + self.offset
+
+    def time(self):
+        return SYSTEM_CLOCK.time() + self.offset
+
+    def sleep(self, seconds):
+        SYSTEM_CLOCK.sleep(seconds)
+
+    def wait(self, event, timeout=None):
+        return SYSTEM_CLOCK.wait(event, timeout)
+
+    def event(self):
+        return SYSTEM_CLOCK.event()
+
+
+def _run_to_done(eng, req, rounds=200):
+    for _ in range(rounds):
+        if req.done:
+            return
+        eng.step()
+    raise AssertionError(f"request {req.id} never finished")
+
+
+class TestEnginePark:
+    def test_park_ttl_expiry_sweeps_the_chain(self, tiny_model):
+        cfg, params = tiny_model
+        clk = _OffsetClock()
+        eng = PagedInferenceEngine(cfg, params, slots=2, page_size=PAGE,
+                                   clock=clk)
+        prompt = list(range(1, 13))
+        a = eng.submit(prompt, max_new_tokens=2, greedy=True)
+        _run_to_done(eng, a)
+        assert eng.park_chain("conv:ttl", prompt, ttl_s=5.0)
+        s = eng.stats()
+        assert s.kv_parked_chains == 1
+        assert s.kv_parked_blocks == 1       # one whole 8-token page
+        # re-park refreshes the one pin, never duplicates it
+        assert eng.park_chain("conv:ttl", prompt, ttl_s=5.0)
+        assert eng.stats().kv_parked_chains == 1
+        released0 = _parked_released("ttl")
+        clk.offset += 10.0                   # the tool gap overran
+        eng.step()                           # next round sweeps
+        assert eng.stats().kv_parked_chains == 0
+        assert _parked_released("ttl") == released0 + 1
+        assert not eng.unpark_chain("conv:ttl")   # double-release: no-op
+
+    def test_park_declines_when_nothing_is_cached(self, tiny_model):
+        cfg, params = tiny_model
+        eng = PagedInferenceEngine(cfg, params, slots=2, page_size=PAGE)
+        assert not eng.park_chain("conv:none", [60] * 12, ttl_s=5.0)
+        assert eng.stats().kv_parked_chains == 0
+
+    def test_pressure_sheds_parked_before_any_resident_request(
+            self, tiny_model):
+        """KV pressure: a parked tool-gap chain is strictly cheaper to
+        lose than resident work — the admission gate sheds it (reason
+        'pressure') and BOTH live requests finish ok, bit-identical,
+        with nobody preempted."""
+        cfg, params = tiny_model
+        eng = PagedInferenceEngine(cfg, params, slots=2, page_size=PAGE,
+                                   kv_blocks=12)
+        pa = list(range(1, 13))              # 12 tokens, 2 blocks
+        a = eng.submit(pa, max_new_tokens=2, greedy=True)
+        _run_to_done(eng, a)
+        assert eng.park_chain("conv:gap", pa, ttl_s=300.0)
+        # b occupies 6 of the 12 blocks and stays resident (41 + 7
+        # tokens fit its 6 pages exactly — no decode growth)
+        pb = [(i * 7) % 60 + 1 for i in range(41)]
+        b = eng.submit(pb, max_new_tokens=7, greedy=True)
+        for _ in range(200):
+            if b.tokens:
+                break
+            eng.step()
+        assert b.tokens and not b.done
+        # c needs 6 blocks; free pool is 5 with the pin held — the gate
+        # must shed the parked chain, not queue c behind the tool gap
+        released0 = _parked_released("pressure")
+        pc = [(i * 11) % 60 + 1 for i in range(47)]
+        c = eng.submit(pc, max_new_tokens=1, greedy=True)
+        _run_to_done(eng, b)
+        _run_to_done(eng, c)
+        assert _parked_released("pressure") == released0 + 1
+        assert eng.stats().kv_parked_chains == 0
+        # the residents never paid for it: no preemption, exact output
+        assert b.error is None and c.error is None
+        assert b.result(0) == _oracle_tokens(cfg, params, pb, 7)
+        assert c.result(0) == _oracle_tokens(cfg, params, pc, 1)
